@@ -1,0 +1,54 @@
+"""infinistore_tpu: a TPU-native distributed KV-cache store for LLM inference.
+
+Brand-new framework with the capabilities of InfiniStore (reference surface:
+/root/reference/infinistore/__init__.py:1-33), redesigned for TPU: the data
+plane is zero-copy DCN socket I/O against pinned host-DRAM pools (no ibverbs).
+"""
+
+from .config import (
+    LINK_DCN,
+    LINK_ETHERNET,
+    LINK_IB,
+    LINK_ICI,
+    TYPE_DCN,
+    TYPE_RDMA,
+    TYPE_TCP,
+    ClientConfig,
+    ServerConfig,
+)
+from .lib import (
+    InfiniStoreException,
+    InfiniStoreKeyNotFound,
+    InfinityConnection,
+    Logger,
+    evict_cache,
+    get_kvmap_len,
+    get_server_stats,
+    purge_kv_map,
+    register_server,
+    unregister_server,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "InfinityConnection",
+    "register_server",
+    "unregister_server",
+    "ClientConfig",
+    "ServerConfig",
+    "TYPE_RDMA",
+    "TYPE_TCP",
+    "TYPE_DCN",
+    "Logger",
+    "LINK_ETHERNET",
+    "LINK_IB",
+    "LINK_DCN",
+    "LINK_ICI",
+    "purge_kv_map",
+    "get_kvmap_len",
+    "get_server_stats",
+    "InfiniStoreException",
+    "InfiniStoreKeyNotFound",
+    "evict_cache",
+]

@@ -1,0 +1,141 @@
+"""ctypes loader for the native core (libinfinistore_tpu.so).
+
+Replaces the reference's pybind11 extension module
+(/root/reference/src/pybind.cpp) — see native/src/c_api.cpp for why ctypes.
+The library is built by `make -C native` (done automatically here when the .so
+is missing or older than the sources).
+"""
+
+import ctypes
+import os
+import subprocess
+from ctypes import (
+    CFUNCTYPE,
+    POINTER,
+    c_char_p,
+    c_double,
+    c_int,
+    c_int32,
+    c_int64,
+    c_uint8,
+    c_uint32,
+    c_uint64,
+    c_void_p,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+_SO_PATH = os.path.join(_HERE, "libinfinistore_tpu.so")
+_NATIVE_DIR = os.path.join(_REPO, "native")
+
+# Completion callback: (ctx, status_code). ctypes re-acquires the GIL when the
+# reactor thread calls back into Python (the pybind equivalent needed explicit
+# gil_scoped_acquire; here it is automatic).
+COMPLETION_CB = CFUNCTYPE(None, c_void_p, c_int)
+LOG_SINK_CB = CFUNCTYPE(None, c_int, c_char_p)
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO_PATH):
+        return True
+    if not os.path.isdir(_NATIVE_DIR):
+        return False  # installed wheel: .so shipped, no sources
+    so_mtime = os.path.getmtime(_SO_PATH)
+    for root, _dirs, files in os.walk(_NATIVE_DIR):
+        for f in files:
+            if f.endswith((".cpp", ".h")) and os.path.getmtime(os.path.join(root, f)) > so_mtime:
+                return True
+    return False
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s", "-j", str(os.cpu_count() or 2)],
+        cwd=_NATIVE_DIR,
+        check=True,
+        capture_output=True,
+    )
+
+
+if _needs_build():
+    _build()
+
+lib = ctypes.CDLL(_SO_PATH)
+
+# ---- logging ----
+lib.its_set_log_level.argtypes = [c_int]
+lib.its_set_log_sink.argtypes = [LOG_SINK_CB]
+lib.its_log.argtypes = [c_int, c_char_p]
+
+# ---- server ----
+lib.its_server_create.argtypes = [
+    c_char_p, c_int, c_uint64, c_uint64, c_int, c_uint64, c_int, c_double, c_double,
+]
+lib.its_server_create.restype = c_void_p
+lib.its_server_start.argtypes = [c_void_p]
+lib.its_server_start.restype = c_int
+lib.its_server_stop.argtypes = [c_void_p]
+lib.its_server_destroy.argtypes = [c_void_p]
+lib.its_server_port.argtypes = [c_void_p]
+lib.its_server_port.restype = c_int
+lib.its_server_kvmap_len.argtypes = [c_void_p]
+lib.its_server_kvmap_len.restype = c_uint64
+lib.its_server_purge.argtypes = [c_void_p]
+lib.its_server_purge.restype = c_uint64
+lib.its_server_evict.argtypes = [c_void_p, c_double, c_double]
+lib.its_server_evict.restype = c_uint64
+lib.its_server_usage.argtypes = [c_void_p]
+lib.its_server_usage.restype = c_double
+lib.its_server_stats_json.argtypes = [c_void_p, c_char_p, c_int]
+lib.its_server_stats_json.restype = c_int
+
+# ---- client ----
+lib.its_conn_create.argtypes = [c_char_p, c_int, c_int]
+lib.its_conn_create.restype = c_void_p
+lib.its_conn_connect.argtypes = [c_void_p]
+lib.its_conn_connect.restype = c_int
+lib.its_conn_close.argtypes = [c_void_p]
+lib.its_conn_destroy.argtypes = [c_void_p]
+lib.its_conn_connected.argtypes = [c_void_p]
+lib.its_conn_connected.restype = c_int
+lib.its_conn_register_mr.argtypes = [c_void_p, c_void_p, c_uint64]
+lib.its_conn_register_mr.restype = c_int
+_batch_args = [
+    c_void_p, c_char_p, c_uint64, c_uint32, POINTER(c_uint64), c_uint32, c_void_p,
+    COMPLETION_CB, c_void_p,
+]
+lib.its_conn_put_batch.argtypes = _batch_args
+lib.its_conn_put_batch.restype = c_int
+lib.its_conn_get_batch.argtypes = _batch_args
+lib.its_conn_get_batch.restype = c_int
+lib.its_conn_tcp_put.argtypes = [c_void_p, c_char_p, c_void_p, c_uint64]
+lib.its_conn_tcp_put.restype = c_int
+lib.its_conn_tcp_get.argtypes = [c_void_p, c_char_p, POINTER(POINTER(c_uint8)), POINTER(c_uint64)]
+lib.its_conn_tcp_get.restype = c_int
+lib.its_free.argtypes = [c_void_p]
+lib.its_conn_check_exist.argtypes = [c_void_p, c_char_p]
+lib.its_conn_check_exist.restype = c_int
+lib.its_conn_match_last_index.argtypes = [c_void_p, c_char_p, c_uint64, c_uint32]
+lib.its_conn_match_last_index.restype = c_int32
+lib.its_conn_delete_keys.argtypes = [c_void_p, c_char_p, c_uint64, c_uint32]
+lib.its_conn_delete_keys.restype = c_int64
+lib.its_conn_stat_json.argtypes = [c_void_p, c_char_p, c_int]
+lib.its_conn_stat_json.restype = c_int
+
+# ---- mempool (unit-test surface) ----
+lib.its_mm_create.argtypes = [c_uint64, c_uint64, c_int]
+lib.its_mm_create.restype = c_void_p
+lib.its_mm_destroy.argtypes = [c_void_p]
+lib.its_mm_allocate.argtypes = [c_void_p, c_uint64, c_uint32, POINTER(c_void_p)]
+lib.its_mm_allocate.restype = c_int
+lib.its_mm_deallocate.argtypes = [c_void_p, c_void_p, c_uint64]
+lib.its_mm_usage.argtypes = [c_void_p]
+lib.its_mm_usage.restype = c_double
+lib.its_mm_extend.argtypes = [c_void_p, c_uint64]
+lib.its_mm_extend.restype = c_int
+lib.its_mm_total_bytes.argtypes = [c_void_p]
+lib.its_mm_total_bytes.restype = c_uint64
+lib.its_mm_used_bytes.argtypes = [c_void_p]
+lib.its_mm_used_bytes.restype = c_uint64
+lib.its_mm_pinned.argtypes = [c_void_p]
+lib.its_mm_pinned.restype = c_int

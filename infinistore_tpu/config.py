@@ -1,0 +1,117 @@
+"""Configuration for client and server.
+
+Single source of truth — the reference duplicates these structs in four places
+by convention (C++ config.h:13-33, pybind.cpp, lib.py:38-152, server.py
+argparse; the maintenance rule is documented at
+/root/reference/src/config.h:7-12). Here the dataclasses below are the only
+definition; the native layer receives plain scalars over the C API.
+"""
+
+from dataclasses import dataclass, field
+
+# Connection types (reference lib.py TYPE_RDMA/TYPE_TCP). On TPU VMs there is
+# no ibverbs: TYPE_RDMA selects the batched zero-copy DCN data plane (the
+# direct successor of the reference's RDMA path — same API, same semantics),
+# TYPE_TCP the simple single-key path. Both ride the same socket.
+TYPE_RDMA = "RDMA"
+TYPE_TCP = "TCP"
+TYPE_DCN = TYPE_RDMA  # TPU-native name for the batched data plane
+
+# Link types are kept for config compatibility; they are advisory on TPU VMs
+# (reference LINK_ETHERNET/LINK_IB choose the ibverbs GID type).
+LINK_ETHERNET = "Ethernet"
+LINK_IB = "IB"
+LINK_DCN = "DCN"
+LINK_ICI = "ICI"
+
+SUPPORTED_CONN_TYPES = (TYPE_RDMA, TYPE_TCP)
+SUPPORTED_LINK_TYPES = (LINK_ETHERNET, LINK_IB, LINK_DCN, LINK_ICI)
+
+
+@dataclass
+class ClientConfig:
+    """Client-side connection config (reference ClientConfig, lib.py:38-91)."""
+
+    host_addr: str = "127.0.0.1"
+    service_port: int = 22345
+    connection_type: str = TYPE_RDMA
+    log_level: str = "warning"
+    connect_timeout_ms: int = 10000
+    # Reference-compat knobs, advisory on TPU (no ibverbs device to pick):
+    dev_name: str = ""
+    ib_port: int = 1
+    link_type: str = LINK_DCN
+    hint_gid_index: int = -1
+
+    def verify(self) -> None:
+        if self.connection_type not in SUPPORTED_CONN_TYPES:
+            raise ValueError(
+                f"connection_type must be one of {SUPPORTED_CONN_TYPES}, "
+                f"got {self.connection_type!r}"
+            )
+        if not (0 < self.service_port < 65536):
+            raise ValueError(f"invalid service_port {self.service_port}")
+        if self.log_level.lower() not in ("debug", "info", "warning", "error", "off"):
+            raise ValueError(f"invalid log_level {self.log_level!r}")
+
+
+@dataclass
+class ServerConfig:
+    """Server config (reference ServerConfig, lib.py:94-152, server.py:42-148)."""
+
+    host: str = "0.0.0.0"
+    service_port: int = 22345
+    manage_port: int = 28080
+    log_level: str = "info"
+    # Memory pool sizing (reference defaults: 16GB prealloc, 64KB min alloc).
+    prealloc_size: int = 16  # GB
+    minimal_allocate_size: int = 64  # KB
+    auto_increase: bool = False
+    extend_size: int = 10  # GB per auto-extend pool
+    pin_memory: bool = True
+    # Eviction (reference server.py: periodic 0.6/0.8 every 5s; on-demand
+    # 0.8/0.95 hardcoded in infinistore.cpp:52-53).
+    evict_enabled: bool = False
+    evict_min_threshold: float = 0.6
+    evict_max_threshold: float = 0.8
+    evict_interval: float = 5.0
+    on_demand_evict_min: float = 0.8
+    on_demand_evict_max: float = 0.95
+    # Reference-compat knobs, advisory on TPU:
+    dev_name: str = ""
+    ib_port: int = 1
+    link_type: str = LINK_DCN
+    hint_gid_index: int = -1
+    # Extra fields tolerated for CLI forward-compat.
+    extra: dict = field(default_factory=dict)
+
+    def verify(self) -> None:
+        if not (0 < self.service_port < 65536) or not (0 < self.manage_port < 65536):
+            raise ValueError("ports must be in (0, 65536)")
+        if self.service_port == self.manage_port:
+            raise ValueError("service_port and manage_port must differ")
+        if self.prealloc_size <= 0:
+            raise ValueError("prealloc_size must be positive (GB)")
+        # Reference enforces a 16KB floor (lib.py:140-152).
+        if self.minimal_allocate_size < 16:
+            raise ValueError("minimal_allocate_size must be >= 16 (KB)")
+        if (self.minimal_allocate_size & (self.minimal_allocate_size - 1)) != 0:
+            raise ValueError("minimal_allocate_size must be a power of two (KB)")
+        if not (0.0 < self.evict_min_threshold < self.evict_max_threshold <= 1.0):
+            raise ValueError("need 0 < evict_min_threshold < evict_max_threshold <= 1")
+        if not (0.0 < self.on_demand_evict_min < self.on_demand_evict_max <= 1.0):
+            raise ValueError("need 0 < on_demand_evict_min < on_demand_evict_max <= 1")
+        if self.evict_interval <= 0:
+            raise ValueError("evict_interval must be positive seconds")
+
+    @property
+    def prealloc_bytes(self) -> int:
+        return self.prealloc_size << 30
+
+    @property
+    def block_bytes(self) -> int:
+        return self.minimal_allocate_size << 10
+
+    @property
+    def extend_bytes(self) -> int:
+        return self.extend_size << 30

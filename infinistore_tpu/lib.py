@@ -1,0 +1,435 @@
+"""Public Python API: InfinityConnection + server control.
+
+TPU-native rebuild of the reference's infinistore/lib.py (surface parity:
+InfinityConnection :288, register_server :203, evict_cache :232,
+purge_kv_map/get_kvmap_len :177-201, Logger :155, exceptions :30-35). The
+asyncio bridging is the same architecture as the reference — a native
+background thread completes operations and callbacks are marshalled onto the
+event loop with call_soon_threadsafe (lib.py:462-470), with a
+BoundedSemaphore(128) inflight cap (lib.py:307) — but the native side is the
+epoll/DCN reactor in native/src/client.cpp instead of an ibverbs CQ thread,
+and the server runs its own reactor thread instead of being grafted onto
+uvloop (no uvloop/PyCapsule dance needed).
+"""
+
+import asyncio
+import ctypes
+import itertools
+import json
+import os
+import socket
+import threading
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import wire
+from ._native import COMPLETION_CB, LOG_SINK_CB, lib
+from .config import (  # noqa: F401  (re-exported reference names)
+    LINK_DCN,
+    LINK_ETHERNET,
+    LINK_IB,
+    LINK_ICI,
+    TYPE_DCN,
+    TYPE_RDMA,
+    TYPE_TCP,
+    ClientConfig,
+    ServerConfig,
+)
+
+_LOG_LEVELS = {"debug": 0, "info": 1, "warning": 2, "error": 3, "off": 4}
+
+
+class InfiniStoreException(Exception):
+    """Generic store error (reference lib.py:30)."""
+
+
+class InfiniStoreKeyNotFound(InfiniStoreException):
+    """Typed miss for read paths (reference lib.py:33)."""
+
+
+class Logger:
+    """Log facade over the native sink (reference Logger, lib.py:155-174)."""
+
+    @staticmethod
+    def debug(msg):
+        lib.its_log(0, str(msg).encode())
+
+    @staticmethod
+    def info(msg):
+        lib.its_log(1, str(msg).encode())
+
+    @staticmethod
+    def warn(msg):
+        lib.its_log(2, str(msg).encode())
+
+    @staticmethod
+    def error(msg):
+        lib.its_log(3, str(msg).encode())
+
+    @staticmethod
+    def set_log_level(level: str):
+        lib.its_set_log_level(_LOG_LEVELS[level.lower()])
+
+
+# Env override, as the reference honors INFINISTORE_LOG_LEVEL (lib.py:62-65).
+_env_level = os.environ.get("INFINISTORE_TPU_LOG_LEVEL") or os.environ.get(
+    "INFINISTORE_LOG_LEVEL"
+)
+if _env_level and _env_level.lower() in _LOG_LEVELS:
+    Logger.set_log_level(_env_level)
+
+
+def _resolve_hostname(hostname: str) -> str:
+    """Resolve to an IPv4 address (reference lib.py:336-353)."""
+    try:
+        return socket.gethostbyname(hostname)
+    except socket.gaierror as e:
+        raise InfiniStoreException(f"cannot resolve host {hostname!r}: {e}") from e
+
+
+# ---------------------------------------------------------------------------
+# Async completion plumbing: one shared ctypes callback + a registry keyed by
+# an integer token. The callback fires on the native reactor thread; ctypes
+# re-acquires the GIL, and we hop onto the owning asyncio loop.
+# ---------------------------------------------------------------------------
+
+_completions: dict = {}
+_completion_token = itertools.count(1)
+
+
+@COMPLETION_CB
+def _on_complete(ctx, code):
+    entry = _completions.pop(ctx or 0, None)
+    if entry is None:
+        return
+    loop, future, on_done = entry
+    loop.call_soon_threadsafe(on_done, future, code)
+
+
+def _extract_ptr_size(arg, size: Optional[int]) -> Tuple[int, int]:
+    """Accept an int pointer + size, a numpy array, or a (cpu) torch tensor.
+
+    The reference registers raw pointers and torch CUDA tensors
+    (lib.py:581-616); on TPU the registered region is always host memory (the
+    staging pool), so numpy arrays are the first-class citizen here.
+    """
+    if isinstance(arg, int):
+        if size is None:
+            raise ValueError("size is required when registering a raw pointer")
+        return arg, size
+    if isinstance(arg, np.ndarray):
+        if not arg.flags["C_CONTIGUOUS"]:
+            raise ValueError("numpy array must be C-contiguous")
+        return arg.ctypes.data, arg.nbytes
+    data_ptr = getattr(arg, "data_ptr", None)
+    if callable(data_ptr):  # torch tensor
+        nbytes = arg.element_size() * arg.nelement()
+        return data_ptr(), nbytes
+    raise NotImplementedError(f"register_mr: unsupported type {type(arg)}")
+
+
+class InfinityConnection:
+    """A connection to one store server (reference InfinityConnection,
+    lib.py:288)."""
+
+    MAX_INFLIGHT = 128  # reference BoundedSemaphore(128), lib.py:307
+
+    def __init__(self, config: ClientConfig):
+        config.verify()
+        self.config = config
+        self._handle = None
+        self._semaphores: dict = {}  # per-loop inflight caps
+        self._lock = threading.Lock()
+        self.rdma_connected = False  # name kept for drop-in compatibility
+        self.tcp_connected = False
+        Logger.set_log_level(config.log_level)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def connect(self):
+        ip = _resolve_hostname(self.config.host_addr)
+        handle = lib.its_conn_create(
+            ip.encode(), self.config.service_port, self.config.connect_timeout_ms
+        )
+        rc = lib.its_conn_connect(handle)
+        if rc != 0:
+            lib.its_conn_destroy(handle)
+            raise InfiniStoreException(
+                f"failed to connect to {ip}:{self.config.service_port} (rc={rc})"
+            )
+        self._handle = handle
+        if self.config.connection_type == TYPE_RDMA:
+            self.rdma_connected = True
+        else:
+            self.tcp_connected = True
+
+    async def connect_async(self):
+        await asyncio.to_thread(self.connect)
+
+    def close(self):
+        if self._handle is not None:
+            lib.its_conn_close(self._handle)
+            lib.its_conn_destroy(self._handle)
+            self._handle = None
+            self.rdma_connected = False
+            self.tcp_connected = False
+
+    # reference name (lib.py:380)
+    close_connection = close
+
+    def _require(self):
+        if self._handle is None:
+            raise InfiniStoreException("not connected")
+
+    # -- memory registration ------------------------------------------------
+
+    def register_mr(self, arg: Union[int, np.ndarray], size: Optional[int] = None):
+        """Pin + register a local staging region for batched zero-copy I/O
+        (reference register_mr, lib.py:581-616)."""
+        self._require()
+        ptr, nbytes = _extract_ptr_size(arg, size)
+        ret = lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes)
+        if ret < 0:
+            raise InfiniStoreException("register memory region failed")
+        return ret
+
+    # -- batched async data plane -------------------------------------------
+
+    def _semaphore(self, loop) -> asyncio.BoundedSemaphore:
+        with self._lock:  # loops in different threads may race the registry
+            sem = self._semaphores.get(loop)
+            if sem is None:
+                sem = asyncio.BoundedSemaphore(self.MAX_INFLIGHT)
+                self._semaphores[loop] = sem
+            return sem
+
+    async def _batch_op(self, native_fn, blocks, block_size: int, ptr: int, op_name: str):
+        self._require()
+        keys, offsets = zip(*blocks)
+        keys_blob = wire.encode_keys_blob(list(keys))
+        n = len(keys)
+        offs = (ctypes.c_uint64 * n)(*offsets)
+
+        loop = asyncio.get_running_loop()
+        sem = self._semaphore(loop)
+        await sem.acquire()
+        future = loop.create_future()
+        token = next(_completion_token)
+
+        def on_done(fut, code):
+            sem.release()
+            if fut.cancelled():
+                return
+            if code == wire.STATUS_OK:
+                fut.set_result(code)
+            elif code == wire.STATUS_KEY_NOT_FOUND:
+                fut.set_exception(InfiniStoreKeyNotFound(f"{op_name}: key not found"))
+            else:
+                fut.set_exception(InfiniStoreException(f"{op_name} failed: status={code}"))
+
+        _completions[token] = (loop, future, on_done)
+        rc = native_fn(
+            self._handle,
+            keys_blob,
+            len(keys_blob),
+            n,
+            offs,
+            block_size,
+            ctypes.c_void_p(ptr),
+            _on_complete,
+            ctypes.c_void_p(token),
+        )
+        if rc != 0:
+            _completions.pop(token, None)
+            sem.release()
+            raise InfiniStoreException(
+                f"{op_name}: submit failed (not connected, or base pointer "
+                "not inside a registered region — call register_mr first)"
+            )
+        return await future
+
+    async def rdma_write_cache_async(
+        self, blocks: List[Tuple[str, int]], block_size: int, ptr: int
+    ):
+        """Async batched block write: for each (key, offset) send block_size
+        bytes from ptr+offset (reference lib.py:425). On TPU the transport is
+        the zero-copy DCN socket plane, not ibverbs; the name is kept for
+        drop-in compatibility, write_cache_async is the native alias."""
+        return await self._batch_op(
+            lib.its_conn_put_batch, blocks, block_size, ptr, "write_cache"
+        )
+
+    async def rdma_read_cache_async(
+        self, blocks: List[Tuple[str, int]], block_size: int, ptr: int
+    ):
+        """Async batched block read into ptr+offset per key (reference
+        lib.py:483). Raises InfiniStoreKeyNotFound when any key is missing."""
+        return await self._batch_op(
+            lib.its_conn_get_batch, blocks, block_size, ptr, "read_cache"
+        )
+
+    # TPU-native aliases.
+    write_cache_async = rdma_write_cache_async
+    read_cache_async = rdma_read_cache_async
+
+    # -- single-key TCP path -------------------------------------------------
+
+    def tcp_write_cache(self, key: str, ptr: int, size: int, **kwargs):
+        """Blocking single-key put from a raw pointer (reference lib.py:399)."""
+        self._require()
+        rc = lib.its_conn_tcp_put(self._handle, key.encode(), ctypes.c_void_p(ptr), size)
+        if rc != 0:
+            raise InfiniStoreException(f"tcp_write_cache failed: status={-rc}")
+        return wire.STATUS_OK
+
+    def tcp_read_cache(self, key: str, **kwargs) -> np.ndarray:
+        """Blocking single-key get; zero-copy numpy view over the native
+        buffer (the reference zero-copies via a pybind capsule,
+        pybind.cpp:23-34; here the finalizer frees the malloc'd buffer)."""
+        self._require()
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_size = ctypes.c_uint64()
+        rc = lib.its_conn_tcp_get(
+            self._handle, key.encode(), ctypes.byref(out), ctypes.byref(out_size)
+        )
+        if rc == -wire.STATUS_KEY_NOT_FOUND:
+            raise InfiniStoreKeyNotFound(f"key not found: {key}")
+        if rc != 0:
+            raise InfiniStoreException(f"tcp_read_cache failed: status={-rc}")
+        n = out_size.value
+        arr = np.ctypeslib.as_array(out, shape=(n,))
+        # Free the native buffer when the array (base) is collected.
+        ptr_val = ctypes.cast(out, ctypes.c_void_p).value
+        import weakref
+
+        weakref.finalize(arr, lib.its_free, ptr_val)
+        return arr
+
+    # -- control ops ---------------------------------------------------------
+
+    def check_exist(self, key: str) -> bool:
+        """True if the key is committed on the server (reference lib.py:544)."""
+        self._require()
+        rc = lib.its_conn_check_exist(self._handle, key.encode())
+        if rc < 0:
+            raise InfiniStoreException(f"check_exist failed: status={-rc}")
+        return rc == 1
+
+    def get_match_last_index(self, keys: List[str]) -> int:
+        """Longest-prefix match index over a key chain (reference lib.py:562;
+        server does binary search under the prefix property, SURVEY.md §3.6)."""
+        self._require()
+        blob = wire.encode_keys_blob(keys)
+        idx = lib.its_conn_match_last_index(self._handle, blob, len(blob), len(keys))
+        if idx == -(2**31):
+            raise InfiniStoreException("get_match_last_index transport error")
+        if idx < 0:
+            raise InfiniStoreException("can't find a match")
+        return idx
+
+    def delete_keys(self, keys: List[str]) -> int:
+        """Delete keys; returns how many were present (reference lib.py:618)."""
+        self._require()
+        blob = wire.encode_keys_blob(keys)
+        ret = lib.its_conn_delete_keys(self._handle, blob, len(blob), len(keys))
+        if ret < 0:
+            raise InfiniStoreException(
+                "somethings are wrong, not all the specified keys were deleted"
+            )
+        return int(ret)
+
+    def get_stats(self) -> dict:
+        """Server-side per-op latency/throughput counters — first-class
+        observability the reference lacks (SURVEY.md §5.1)."""
+        self._require()
+        buf = ctypes.create_string_buffer(64 << 10)
+        n = lib.its_conn_stat_json(self._handle, buf, len(buf))
+        if n < 0:
+            raise InfiniStoreException("stat query failed")
+        return json.loads(buf.value.decode())
+
+
+# ---------------------------------------------------------------------------
+# Server control plane (module-level, mirroring the reference's globals:
+# register_server lib.py:203, evict_cache :232, purge_kv_map :190,
+# get_kvmap_len :177).
+# ---------------------------------------------------------------------------
+
+_server_handle = None
+_server_lock = threading.Lock()
+
+
+def register_server(loop, config: ServerConfig):
+    """Start the native store server.
+
+    Signature kept for drop-in compatibility with the reference
+    (register_server(loop, config), lib.py:203). The loop argument is accepted
+    and ignored: the reference had to graft libuv onto uvloop's uv_loop_t via
+    PyCapsule (lib.py:217-229) because its data plane shared the Python
+    thread; our native server owns a dedicated epoll reactor thread, so
+    nothing needs to be spliced into asyncio.
+    """
+    global _server_handle
+    config.verify()
+    with _server_lock:
+        if _server_handle is not None:
+            raise InfiniStoreException("server already registered in this process")
+        Logger.set_log_level(config.log_level)
+        handle = lib.its_server_create(
+            config.host.encode(),
+            config.service_port,
+            config.prealloc_bytes,
+            config.block_bytes,
+            1 if config.auto_increase else 0,
+            config.extend_bytes,
+            1 if config.pin_memory else 0,
+            config.on_demand_evict_min,
+            config.on_demand_evict_max,
+        )
+        if not handle:
+            raise InfiniStoreException("failed to create server (allocation failed?)")
+        if lib.its_server_start(handle) != 0:
+            lib.its_server_destroy(handle)
+            raise InfiniStoreException(
+                f"failed to bind {config.host}:{config.service_port}"
+            )
+        _server_handle = handle
+    return _server_handle
+
+
+def unregister_server():
+    """Stop and destroy the in-process server (teardown helper; the reference
+    relies on process exit)."""
+    global _server_handle
+    with _server_lock:
+        if _server_handle is not None:
+            lib.its_server_stop(_server_handle)
+            lib.its_server_destroy(_server_handle)
+            _server_handle = None
+
+
+def _require_server():
+    if _server_handle is None:
+        raise InfiniStoreException("no server registered in this process")
+    return _server_handle
+
+
+def get_kvmap_len() -> int:
+    return int(lib.its_server_kvmap_len(_require_server()))
+
+
+def purge_kv_map() -> int:
+    return int(lib.its_server_purge(_require_server()))
+
+
+def evict_cache(min_threshold: float, max_threshold: float) -> int:
+    return int(lib.its_server_evict(_require_server(), min_threshold, max_threshold))
+
+
+def get_server_stats() -> dict:
+    buf = ctypes.create_string_buffer(64 << 10)
+    n = lib.its_server_stats_json(_require_server(), buf, len(buf))
+    if n < 0:
+        raise InfiniStoreException("stats query failed")
+    return json.loads(buf.value.decode())

@@ -1,0 +1,167 @@
+"""Python mirror of the native wire protocol (native/include/its/protocol.h).
+
+The client/server data plane lives in C++; this module exists for (a) building
+the packed key blobs passed across the ctypes boundary, and (b) protocol unit
+tests that check the Python and C++ encoders agree byte-for-byte — coverage the
+reference lacks entirely (SURVEY.md §4: no protocol unit tests).
+"""
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+MAGIC = 0x49545055  # "ITPU" little-endian
+MAX_BODY_SIZE = 4 << 20
+
+# Op codes (native protocol.h Op).
+OP_PUT_BATCH = ord("W")
+OP_GET_BATCH = ord("R")
+OP_TCP_PUT = ord("P")
+OP_TCP_GET = ord("G")
+OP_CHECK_EXIST = ord("E")
+OP_MATCH_LAST_IDX = ord("M")
+OP_DELETE_KEYS = ord("D")
+OP_STAT = ord("S")
+
+# Status codes (reference /root/reference/src/protocol.h:55-62).
+STATUS_OK = 200
+STATUS_TASK_ACCEPTED = 202
+STATUS_INVALID_REQ = 400
+STATUS_KEY_NOT_FOUND = 404
+STATUS_RETRY = 408
+STATUS_INTERNAL = 500
+STATUS_UNAVAILABLE = 503
+STATUS_OUT_OF_MEMORY = 507
+
+_REQ_HEADER = struct.Struct("<IBI")  # magic, op, body_size (9 bytes)
+_RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 bytes)
+
+
+def pack_req_header(op: int, body_size: int) -> bytes:
+    return _REQ_HEADER.pack(MAGIC, op, body_size)
+
+
+def unpack_req_header(data: bytes) -> Tuple[int, int]:
+    magic, op, body_size = _REQ_HEADER.unpack(data[: _REQ_HEADER.size])
+    if magic != MAGIC:
+        raise ValueError("bad magic")
+    return op, body_size
+
+
+def pack_resp_header(status: int, body_size: int, payload_size: int) -> bytes:
+    return _RESP_HEADER.pack(status, body_size, payload_size)
+
+
+def unpack_resp_header(data: bytes) -> Tuple[int, int, int]:
+    return _RESP_HEADER.unpack(data[: _RESP_HEADER.size])
+
+
+def encode_str(s: str) -> bytes:
+    b = s.encode("utf-8")
+    if len(b) > 0xFFFF:
+        raise ValueError("key too long")
+    return struct.pack("<H", len(b)) + b
+
+
+def encode_keys_blob(keys: List[str]) -> bytes:
+    """Packed (u16 len, bytes) entries — the ctypes boundary format and the
+    wire string-list element encoding (WireWriter::str)."""
+    return b"".join(encode_str(k) for k in keys)
+
+
+def encode_str_list(keys: List[str]) -> bytes:
+    return struct.pack("<I", len(keys)) + encode_keys_blob(keys)
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self._d = data
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._d):
+            raise ValueError("wire body truncated")
+        out = self._d[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self._take(4))[0]
+
+    def str(self) -> str:
+        return self._take(self.u16()).decode("utf-8")
+
+    def str_list(self) -> List[str]:
+        return [self.str() for _ in range(self.u32())]
+
+    @property
+    def done(self) -> bool:
+        return self._pos == len(self._d)
+
+
+@dataclass
+class BatchMeta:
+    """Batched block metadata (native BatchMeta; reference RemoteMetaRequest,
+    /root/reference/src/meta_request.fbs:2-8)."""
+
+    block_size: int = 0
+    keys: List[str] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        return struct.pack("<I", self.block_size) + encode_str_list(self.keys)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BatchMeta":
+        r = Reader(data)
+        m = cls(block_size=r.u32(), keys=r.str_list())
+        return m
+
+
+@dataclass
+class TcpPutMeta:
+    key: str = ""
+    value_length: int = 0
+
+    def encode(self) -> bytes:
+        return encode_str(self.key) + struct.pack("<Q", self.value_length)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TcpPutMeta":
+        r = Reader(data)
+        return cls(key=r.str(), value_length=r.u64())
+
+
+@dataclass
+class KeyMeta:
+    key: str = ""
+
+    def encode(self) -> bytes:
+        return encode_str(self.key)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "KeyMeta":
+        return cls(key=Reader(data).str())
+
+
+@dataclass
+class KeyListMeta:
+    keys: List[str] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        return encode_str_list(self.keys)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "KeyListMeta":
+        return cls(keys=Reader(data).str_list())

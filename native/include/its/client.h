@@ -1,0 +1,126 @@
+// Client library: one TCP/DCN connection to a store server, with a dedicated
+// reactor thread completing pipelined async operations.
+//
+// TPU-native analogue of the reference's client
+// (/root/reference/src/libinfinistore.h:63-119, libinfinistore.cpp): the same
+// surface — connect/close, register_mr, async batched block write/read against
+// one registered base pointer with (key, offset) lists and a uniform
+// block_size, sync control ops (check_exist, get_match_last_index,
+// delete_keys), single-key TCP put/get — and the same completion architecture
+// (a background thread fires callbacks; the Python layer marshals them onto
+// asyncio with call_soon_threadsafe). What changed: the reference's CQ-polling
+// thread over ibverbs completions becomes an epoll reactor over the socket;
+// payload moves by scatter-gather writev/readv directly between user-registered
+// memory and the socket, preserving the zero-copy-on-client property of the
+// one-sided RDMA design without ibverbs.
+#pragma once
+
+#include <sys/uio.h>
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "its/iovec_util.h"
+#include "its/protocol.h"
+
+namespace its {
+
+struct ClientConfig {
+    std::string host = "127.0.0.1";
+    int port = 22345;
+    int connect_timeout_ms = 10000;
+};
+
+using CompletionCb = void (*)(void* ctx, int code);
+
+class Connection {
+  public:
+    explicit Connection(const ClientConfig& config);
+    ~Connection();
+
+    // Blocking TCP connect + reactor spawn. Returns 0 or -errno.
+    int connect();
+    void close();
+    bool connected() const { return connected_.load(); }
+
+    // Pin + register a local memory region; batched ops validate their base
+    // pointer against registered regions (reference register_mr,
+    // /root/reference/src/libinfinistore.cpp:728; unregistered base is an
+    // error, :602-605).
+    int register_mr(void* ptr, size_t size);
+
+    // Async batched block write: for each i, send block_size bytes from
+    // base_ptr+offsets[i] under keys[i]. cb fires from the reactor thread with
+    // an HTTP-like status. Returns 0 on submit, -1 if not connected /
+    // unregistered base.
+    int put_batch_async(const std::vector<std::string>& keys,
+                        const std::vector<uint64_t>& offsets, uint32_t block_size,
+                        void* base_ptr, CompletionCb cb, void* ctx);
+    // Async batched block read into base_ptr+offsets[i].
+    int get_batch_async(const std::vector<std::string>& keys,
+                        const std::vector<uint64_t>& offsets, uint32_t block_size,
+                        void* base_ptr, CompletionCb cb, void* ctx);
+
+    // Sync ops (safe to call from any thread; they ride the same pipeline).
+    int tcp_put(const std::string& key, const void* data, size_t size);
+    // On success fills *out (malloc'd — caller frees with free()) and *out_size.
+    int tcp_get(const std::string& key, uint8_t** out, size_t* out_size);
+    // Returns 1 = exists, 0 = missing, negative status on error.
+    int check_exist(const std::string& key);
+    // Returns match index (>= -1); INT32_MIN on transport error.
+    int32_t get_match_last_index(const std::vector<std::string>& keys);
+    // Returns number deleted, or negative status.
+    int64_t delete_keys(const std::vector<std::string>& keys);
+    // Server stats snapshot (JSON). Empty on error.
+    std::string stat_json();
+
+  private:
+    struct Request;
+
+    void reactor();
+    int submit(std::unique_ptr<Request> req);
+    void fail_all(int code);
+    bool flush_send();
+    bool read_ready();
+    void complete(std::unique_ptr<Request> req, int code);
+    uint32_t sync_roundtrip(std::unique_ptr<Request> req, std::vector<uint8_t>* body_out,
+                            uint8_t** payload_out, size_t* payload_size_out);
+    bool base_registered(const void* base, size_t span) const;
+
+    ClientConfig config_;
+    int fd_ = -1;
+    int wake_fd_ = -1;
+    int epoll_fd_ = -1;
+    std::thread thread_;
+    std::atomic<bool> connected_{false};
+    std::atomic<bool> stop_{false};
+
+    std::mutex submit_mu_;
+    std::vector<std::unique_ptr<Request>> submitted_;
+
+    // Reactor-owned state.
+    std::deque<std::unique_ptr<Request>> sendq_;
+    std::deque<std::unique_ptr<Request>> awaiting_;
+
+    // Response read state.
+    RespHeader rhdr_{};
+    size_t rhdr_got_ = 0;
+    std::vector<uint8_t> rbody_;
+    size_t rbody_got_ = 0;
+    std::vector<iovec> rx_iov_;
+    ScatterCursor rx_cur_;
+    uint64_t rx_discard_ = 0;
+    bool resp_in_progress_ = false;
+    bool rx_setup_done_ = false;
+
+    mutable std::mutex mr_mu_;
+    std::vector<std::pair<const char*, size_t>> regions_;
+};
+
+}  // namespace its

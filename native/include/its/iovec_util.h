@@ -1,0 +1,82 @@
+// Resumable scatter/gather iovec helpers, shared by the server and client
+// reactors. Both sides move payloads with partial readv/writev calls that must
+// resume mid-iovec; keeping the offset arithmetic in one place means a fix
+// lands everywhere at once.
+#pragma once
+
+#include <sys/uio.h>
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace its {
+
+// Progress cursor over a scatter list (receive side).
+struct ScatterCursor {
+    size_t idx = 0;
+    size_t off = 0;
+
+    void reset() { idx = off = 0; }
+    bool done(const std::vector<iovec>& v) const { return idx >= v.size(); }
+
+    // Fill `out` (capacity max_iov) with the remaining regions; returns count.
+    size_t fill(const std::vector<iovec>& v, iovec* out, size_t max_iov) const {
+        size_t n = std::min(v.size() - idx, max_iov);
+        if (n == 0) return 0;
+        out[0].iov_base = static_cast<char*>(v[idx].iov_base) + off;
+        out[0].iov_len = v[idx].iov_len - off;
+        for (size_t i = 1; i < n; i++) out[i] = v[idx + i];
+        return n;
+    }
+
+    // Consume nbytes of progress.
+    void advance(const std::vector<iovec>& v, size_t nbytes) {
+        while (nbytes > 0) {
+            size_t left = v[idx].iov_len - off;
+            size_t take = std::min(nbytes, left);
+            off += take;
+            nbytes -= take;
+            if (off == v[idx].iov_len) {
+                idx++;
+                off = 0;
+            }
+        }
+    }
+};
+
+// Build the remaining iovec view of a framed message (fixed header, metadata
+// body, then payload regions) given `sent` bytes already written.
+// Returns the number of iovecs placed in `out`.
+inline size_t build_send_iov(const void* hdr, size_t hdr_len, const std::vector<uint8_t>& body,
+                             const std::vector<iovec>& payload, size_t sent, iovec* out,
+                             size_t max_iov) {
+    size_t niov = 0;
+    size_t off = sent;
+    if (off < hdr_len) {
+        out[niov++] = iovec{const_cast<char*>(static_cast<const char*>(hdr)) + off,
+                            hdr_len - off};
+        off = 0;
+    } else {
+        off -= hdr_len;
+    }
+    if (niov < max_iov && off < body.size()) {
+        out[niov++] = iovec{const_cast<uint8_t*>(body.data()) + off, body.size() - off};
+        off = 0;
+    } else {
+        off -= std::min(off, body.size());
+    }
+    for (size_t i = 0; i < payload.size() && niov < max_iov; i++) {
+        size_t len = payload[i].iov_len;
+        if (off >= len) {
+            off -= len;
+            continue;
+        }
+        out[niov++] = iovec{static_cast<char*>(payload[i].iov_base) + off, len - off};
+        off = 0;
+    }
+    return niov;
+}
+
+}  // namespace its

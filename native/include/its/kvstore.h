@@ -1,0 +1,92 @@
+// KV map + LRU eviction + refcounted block lifetime.
+//
+// TPU-native analogue of the reference's server-side state (kv_map, lru_queue,
+// PTR intrusive refcount; /root/reference/src/infinistore.cpp:26-41,
+// infinistore.h:24-39, evict_cache infinistore.cpp:223). Data-plane discipline
+// matches the reference: all mutations happen on the single server reactor
+// thread, so no locks are needed; std::shared_ptr supplies the PTR role —
+// an in-flight streaming GET holds a reference so eviction cannot free a block
+// mid-send (reference BulkWriteCtx, infinistore.cpp:282-287).
+//
+// One deliberate improvement over the reference: the LRU is a proper
+// list+iterator structure with O(1) touch and no stale entries (the reference's
+// lru_queue retains dead entries for overwritten keys until they age out,
+// SURVEY.md §3.3 note).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <list>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "its/mempool.h"
+
+namespace its {
+
+// A committed KV block. Freed back to its pool when the last reference drops.
+class Block {
+  public:
+    Block(MM* mm, void* ptr, size_t size) : mm_(mm), ptr_(ptr), size_(size) {}
+    ~Block() {
+        if (ptr_ != nullptr) mm_->deallocate(ptr_, size_);
+    }
+    Block(const Block&) = delete;
+    Block& operator=(const Block&) = delete;
+
+    void* data() const { return ptr_; }
+    size_t size() const { return size_; }
+
+  private:
+    MM* mm_;
+    void* ptr_;
+    size_t size_;
+};
+
+using BlockRef = std::shared_ptr<Block>;
+
+class KVStore {
+  public:
+    explicit KVStore(MM* mm) : mm_(mm) {}
+
+    // Insert/overwrite. Called only after the payload transfer completed —
+    // commit-on-completion, no partially-visible keys (SURVEY.md §3.3).
+    void commit(const std::string& key, BlockRef block);
+
+    // Lookup + LRU touch. Returns nullptr when missing.
+    BlockRef get(const std::string& key);
+    // Lookup without touching the LRU.
+    BlockRef peek(const std::string& key) const;
+    bool exists(const std::string& key) const;
+
+    // Remove listed keys; returns how many were present.
+    size_t remove(const std::vector<std::string>& keys);
+    // Drop everything; returns prior count.
+    size_t purge();
+    size_t size() const { return map_.size(); }
+
+    // Longest-prefix match: binary search for the last present key, assuming
+    // the prefix property (keys[i] present => keys[j<i] present) — reference
+    // Client::get_match_last_index (/root/reference/src/infinistore.cpp:786-798).
+    // Returns -1 when keys[0] is absent.
+    int32_t match_last_index(const std::vector<std::string>& keys) const;
+
+    // If pool usage >= max_ratio, evict LRU entries until usage <= min_ratio
+    // (reference evict_cache, /root/reference/src/infinistore.cpp:223).
+    // Returns evicted entry count.
+    size_t evict(double min_ratio, double max_ratio);
+
+  private:
+    struct Entry {
+        BlockRef block;
+        std::list<std::string>::iterator lru_it;
+    };
+
+    MM* mm_;
+    std::unordered_map<std::string, Entry> map_;
+    std::list<std::string> lru_;  // front = most recently used
+};
+
+}  // namespace its

@@ -1,0 +1,40 @@
+// Logging for the native core.
+//
+// TPU-native analogue of the reference's spdlog console logger
+// (/root/reference/src/log.h:11-26, log.cpp:5-33): leveled macros where
+// WARN/ERROR carry file:line, a runtime level setter, and a bridge so Python
+// can route messages through the same sink. We use a plain stderr sink with an
+// optional C callback (installed by the Python layer) instead of spdlog, which
+// keeps the native core dependency-free.
+#pragma once
+
+#include <cstdarg>
+
+namespace its {
+
+enum class LogLevel : int {
+    kDebug = 0,
+    kInfo = 1,
+    kWarning = 2,
+    kError = 3,
+    kOff = 4,
+};
+
+using LogSink = void (*)(int level, const char* msg);
+
+void set_log_level(LogLevel level);
+LogLevel log_level();
+// Install a sink that replaces the default stderr writer (nullptr restores).
+void set_log_sink(LogSink sink);
+// printf-style; applies the level filter, then dispatches to the sink.
+void log_msg(LogLevel level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
+
+}  // namespace its
+
+#define ITS_LOG_DEBUG(fmt, ...) \
+    ::its::log_msg(::its::LogLevel::kDebug, fmt, ##__VA_ARGS__)
+#define ITS_LOG_INFO(fmt, ...) ::its::log_msg(::its::LogLevel::kInfo, fmt, ##__VA_ARGS__)
+#define ITS_LOG_WARN(fmt, ...) \
+    ::its::log_msg(::its::LogLevel::kWarning, "%s:%d " fmt, __FILE__, __LINE__, ##__VA_ARGS__)
+#define ITS_LOG_ERROR(fmt, ...) \
+    ::its::log_msg(::its::LogLevel::kError, "%s:%d " fmt, __FILE__, __LINE__, ##__VA_ARGS__)

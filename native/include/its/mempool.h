@@ -1,0 +1,110 @@
+// Pinned host-DRAM memory pool with a first-fit bitmap allocator.
+//
+// TPU-native analogue of the reference's mempool (/root/reference/src/mempool.h
+// :19-91, mempool.cpp:29-196): one 4KB-aligned region per pool, carved into
+// fixed-size blocks tracked by a uint64 bitmap (64 blocks per word, ctz scan),
+// contiguous multi-block allocation, batched n-way allocation, double-free
+// detection, and an `MM` front that manages multiple pools and signals when a
+// new pool should be added (auto-extend). Differences from the reference:
+// instead of ibv_reg_mr (no ibverbs on TPU VMs) the region is pinned with
+// mlock() so the kernel never pages it out under the DCN send/recv data plane,
+// and registration metadata is kept for the staging layer rather than for an
+// RDMA rkey.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <memory>
+#include <vector>
+
+namespace its {
+
+// Reference constants (/root/reference/src/mempool.h:11-13).
+constexpr double kBlockUsageRatio = 0.5;      // MM signals extend above this
+constexpr size_t kExtendPoolSize = 10ull << 30;  // +10GB per auto-extend pool
+constexpr size_t kExtendBlockSize = 64ull << 10;
+
+class MemoryPool {
+  public:
+    // pool_size must be a multiple of block_size; block_size a power of two.
+    MemoryPool(size_t pool_size, size_t block_size, bool pin = true);
+    ~MemoryPool();
+
+    MemoryPool(const MemoryPool&) = delete;
+    MemoryPool& operator=(const MemoryPool&) = delete;
+
+    // Allocate `size` bytes as ceil(size/block_size) *contiguous* blocks.
+    // Returns nullptr when no contiguous run is free.
+    void* allocate(size_t size);
+    // Free a pointer previously returned by allocate(). Aborts the call (logs
+    // and returns false) on double-free or foreign pointers.
+    bool deallocate(void* ptr, size_t size);
+
+    bool contains(const void* ptr) const {
+        const char* p = static_cast<const char*>(ptr);
+        return p >= base_ && p < base_ + pool_size_;
+    }
+
+    size_t block_size() const { return block_size_; }
+    size_t total_blocks() const { return total_blocks_; }
+    size_t used_blocks() const { return used_blocks_; }
+    void* base() const { return base_; }
+    bool pinned() const { return pinned_; }
+
+  private:
+    size_t find_free_run(size_t nblocks);
+    void mark(size_t first_block, size_t nblocks, bool used);
+
+    char* base_ = nullptr;
+    size_t pool_size_;
+    size_t block_size_;
+    size_t total_blocks_;
+    size_t used_blocks_ = 0;
+    bool pinned_ = false;
+    std::vector<uint64_t> bitmap_;  // 1 = used
+};
+
+// A (pool, ptr, size) lease. Deallocation goes back to the owning pool.
+struct Lease {
+    void* ptr = nullptr;
+    size_t size = 0;
+    MemoryPool* pool = nullptr;
+};
+
+// Multi-pool manager (reference MM, /root/reference/src/mempool.h:54-91).
+class MM {
+  public:
+    MM(size_t initial_pool_size, size_t block_size, bool pin = true);
+
+    // Batched n-way allocation: invokes cb(ptr, lease_index) for each of the n
+    // leases as they are placed (reference MM::allocate's callback shape,
+    // /root/reference/src/mempool.cpp:159). Returns false — allocating
+    // nothing — if the full batch cannot be satisfied.
+    bool allocate(size_t size, size_t n, const std::function<void(void*, size_t)>& cb,
+                  std::vector<Lease>* out);
+    void deallocate(const Lease& lease);
+    // Free by raw pointer: finds the owning pool. Used by the KV layer.
+    void deallocate(void* ptr, size_t size);
+
+    // Add one more pool (auto-extend). Returns false on allocation failure.
+    bool extend(size_t pool_size);
+
+    // Fraction of blocks in use across all pools, in [0, 1].
+    double usage() const;
+    // True when usage is above kBlockUsageRatio — caller should extend.
+    bool need_extend() const { return usage() > kBlockUsageRatio; }
+
+    size_t block_size() const { return block_size_; }
+    size_t total_bytes() const;
+    size_t used_bytes() const;
+    size_t pool_count() const { return pools_.size(); }
+    bool pinned() const;
+
+  private:
+    size_t block_size_;
+    bool pin_;
+    std::vector<std::unique_ptr<MemoryPool>> pools_;
+};
+
+}  // namespace its

@@ -1,0 +1,218 @@
+// Wire protocol: fixed request/response headers + compact schema'd bodies.
+//
+// TPU-native analogue of the reference's protocol layer
+// (/root/reference/src/protocol.h:38-95 + five FlatBuffers schemas): a packed
+// fixed header {magic, op, body_size}, one-byte op codes, HTTP-like status
+// codes, and a 4MB cap on metadata bodies. Instead of FlatBuffers we use a
+// hand-rolled little-endian encoding (length-prefixed strings and vectors)
+// mirrored exactly by infinistore_tpu/wire.py — the environment has no flatc,
+// and the bodies are small and fixed in shape, so a schema compiler buys
+// nothing. Payload bytes (KV-block data) are never serialized: they are moved
+// by scatter-gather I/O directly between sockets and registered memory, which
+// is how the design keeps the reference's "no extra copy" property without
+// one-sided RDMA (SURVEY.md §5.8).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace its {
+
+constexpr uint32_t kMagic = 0x49545055;  // "ITPU" little-endian
+// Metadata bodies are capped, mirroring the reference's 4MB protocol buffers
+// (/root/reference/src/protocol.h:28 PROTOCOL_BUFFER_SIZE).
+constexpr uint32_t kMaxBodySize = 4u << 20;
+// Op codes (one byte on the wire).
+enum Op : uint8_t {
+    kOpPutBatch = 'W',       // batched block write; client streams payload after body
+    kOpGetBatch = 'R',       // batched block read; server streams payload after resp body
+    kOpTcpPut = 'P',         // single-key put (reference OP_TCP_PUT)
+    kOpTcpGet = 'G',         // single-key get (reference OP_TCP_GET)
+    kOpCheckExist = 'E',     // key existence probe
+    kOpMatchLastIdx = 'M',   // longest-prefix match index (binary search)
+    kOpDeleteKeys = 'D',     // delete a list of keys
+    kOpStat = 'S',           // server stats snapshot (selftest support)
+};
+
+// HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
+enum Status : uint32_t {
+    kStatusOk = 200,
+    kStatusTaskAccepted = 202,
+    kStatusInvalidReq = 400,
+    kStatusKeyNotFound = 404,
+    kStatusRetry = 408,
+    kStatusInternal = 500,
+    kStatusUnavailable = 503,
+    kStatusOutOfMemory = 507,
+};
+
+#pragma pack(push, 1)
+struct ReqHeader {
+    uint32_t magic;
+    uint8_t op;
+    uint32_t body_size;
+};
+struct RespHeader {
+    uint32_t status;
+    uint32_t body_size;    // op-specific response body (sizes, counts, ...)
+    uint64_t payload_size; // raw KV payload streamed after the body
+};
+#pragma pack(pop)
+
+static_assert(sizeof(ReqHeader) == 9, "wire header must stay packed");
+static_assert(sizeof(RespHeader) == 16, "wire resp header must stay packed");
+
+// ---------------------------------------------------------------------------
+// Encoding helpers. Little-endian, length-prefixed. Python mirror: wire.py.
+// ---------------------------------------------------------------------------
+
+class WireWriter {
+  public:
+    explicit WireWriter(std::vector<uint8_t>& out) : out_(out) {}
+
+    void u8(uint8_t v) { out_.push_back(v); }
+    void u16(uint16_t v) { append(&v, 2); }
+    void u32(uint32_t v) { append(&v, 4); }
+    void u64(uint64_t v) { append(&v, 8); }
+    void i32(int32_t v) { append(&v, 4); }
+    void str(const std::string& s) {
+        if (s.size() > UINT16_MAX) throw std::invalid_argument("key too long");
+        u16(static_cast<uint16_t>(s.size()));
+        append(s.data(), s.size());
+    }
+    void str_list(const std::vector<std::string>& v) {
+        u32(static_cast<uint32_t>(v.size()));
+        for (const auto& s : v) str(s);
+    }
+
+  private:
+    void append(const void* p, size_t n) {
+        const uint8_t* b = static_cast<const uint8_t*>(p);
+        out_.insert(out_.end(), b, b + n);
+    }
+    std::vector<uint8_t>& out_;
+};
+
+class WireReader {
+  public:
+    WireReader(const uint8_t* data, size_t size) : data_(data), size_(size) {}
+
+    uint8_t u8() { return *take(1); }
+    uint16_t u16() { return load<uint16_t>(); }
+    uint32_t u32() { return load<uint32_t>(); }
+    uint64_t u64() { return load<uint64_t>(); }
+    int32_t i32() { return load<int32_t>(); }
+    std::string str() {
+        uint16_t n = u16();
+        const uint8_t* p = take(n);
+        return std::string(reinterpret_cast<const char*>(p), n);
+    }
+    std::vector<std::string> str_list() {
+        uint32_t n = u32();
+        std::vector<std::string> v;
+        v.reserve(n);
+        for (uint32_t i = 0; i < n; i++) v.push_back(str());
+        return v;
+    }
+    bool done() const { return pos_ == size_; }
+
+  private:
+    template <typename T>
+    T load() {
+        T v;
+        std::memcpy(&v, take(sizeof(T)), sizeof(T));
+        return v;
+    }
+    const uint8_t* take(size_t n) {
+        if (pos_ + n > size_) throw std::out_of_range("wire body truncated");
+        const uint8_t* p = data_ + pos_;
+        pos_ += n;
+        return p;
+    }
+    const uint8_t* data_;
+    size_t size_;
+    size_t pos_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// Request bodies (reference schemas: meta_request.fbs, tcp_payload_request.fbs,
+// delete_keys.fbs, get_match_last_index.fbs).
+// ---------------------------------------------------------------------------
+
+// Batched block read/write metadata (reference RemoteMetaRequest,
+// /root/reference/src/meta_request.fbs:2-8 — minus rkey/remote_addrs, which
+// were one-sided-RDMA artifacts; on the cooperative TCP/DCN data plane the
+// payload rides the same socket in key order).
+struct BatchMeta {
+    uint32_t block_size = 0;
+    std::vector<std::string> keys;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.u32(block_size);
+        w.str_list(keys);
+    }
+    static BatchMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        BatchMeta m;
+        m.block_size = r.u32();
+        m.keys = r.str_list();
+        return m;
+    }
+};
+
+// Single-key put metadata (reference TCPPayloadRequest).
+struct TcpPutMeta {
+    std::string key;
+    uint64_t value_length = 0;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.str(key);
+        w.u64(value_length);
+    }
+    static TcpPutMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        TcpPutMeta m;
+        m.key = r.str();
+        m.value_length = r.u64();
+        return m;
+    }
+};
+
+// Single key (TcpGet / CheckExist).
+struct KeyMeta {
+    std::string key;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.str(key);
+    }
+    static KeyMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        KeyMeta m;
+        m.key = r.str();
+        return m;
+    }
+};
+
+// Key list (DeleteKeys / GetMatchLastIndex).
+struct KeyListMeta {
+    std::vector<std::string> keys;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.str_list(keys);
+    }
+    static KeyListMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        KeyListMeta m;
+        m.keys = r.str_list();
+        return m;
+    }
+};
+
+}  // namespace its

@@ -1,0 +1,134 @@
+// Server data plane: single-threaded epoll reactor.
+//
+// TPU-native analogue of the reference's libuv server
+// (/root/reference/src/infinistore.cpp — Client state machine :55-109, on_read
+// :887, handle_request :837, register_server :990). The reference grafts libuv
+// onto uvloop inside the Python process and moves payloads with server-initiated
+// one-sided RDMA; TPU VMs have no ibverbs, so here the data plane is
+// cooperative zero-copy socket I/O on the DCN: requests carry metadata bodies,
+// payloads are scattered straight between the socket and pinned pool blocks
+// with readv/writev (no intermediate copies), and the server runs its own
+// reactor thread started from Python via the C API (no uvloop dependency).
+//
+// Concurrency discipline matches the reference ("single thread right now",
+// infinistore.cpp:1): every kv/pool mutation happens on the reactor thread.
+// Control-plane calls from Python are marshalled onto the loop through an
+// eventfd + closure queue and wait on a future.
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/uio.h>
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "its/kvstore.h"
+#include "its/mempool.h"
+#include "its/protocol.h"
+
+namespace its {
+
+struct ServerConfig {
+    std::string bind_addr = "0.0.0.0";
+    int service_port = 22345;
+    size_t prealloc_bytes = 16ull << 30;   // reference default 16GB prealloc
+    size_t block_size = 64ull << 10;       // reference minimal_allocate_size 64KB
+    bool auto_increase = false;            // add pools when usage > 50%
+    size_t extend_pool_bytes = kExtendPoolSize;
+    bool pin_memory = true;
+    // On-demand eviction thresholds (reference hardcodes 0.8/0.95,
+    // /root/reference/src/infinistore.cpp:52-53).
+    double evict_min_ratio = 0.8;
+    double evict_max_ratio = 0.95;
+};
+
+// Per-op service counters (SURVEY.md §5.1: the reference has no tracing at
+// all; we make latency/throughput first-class). Histogram buckets are log2 of
+// microseconds: bucket i covers [2^i, 2^(i+1)) us.
+struct OpStats {
+    uint64_t count = 0;
+    uint64_t errors = 0;
+    uint64_t bytes_in = 0;
+    uint64_t bytes_out = 0;
+    uint64_t total_us = 0;
+    uint64_t lat_buckets[32] = {0};
+
+    void record(uint64_t us, uint64_t in_bytes, uint64_t out_bytes, bool ok);
+    double p50_us() const;
+};
+
+class Server {
+  public:
+    explicit Server(const ServerConfig& config);
+    ~Server();
+
+    // Bind + listen + spawn the reactor thread. Returns false on bind failure.
+    bool start();
+    void stop();
+    bool running() const { return running_.load(); }
+    int port() const { return bound_port_; }  // actual port (0 in config = ephemeral)
+
+    // Thread-safe control plane: each call runs its body on the reactor thread
+    // and blocks the caller until done.
+    size_t kvmap_len();
+    size_t purge();
+    size_t evict(double min_ratio, double max_ratio);
+    double usage();
+    std::string stats_json();
+
+  private:
+    struct Conn;
+
+    void loop();
+    void post(std::function<void()> fn);     // enqueue onto reactor, no wait
+    void call(std::function<void()> fn);     // enqueue + wait for completion
+    void accept_ready();
+    void conn_readable(Conn* c);
+    void conn_writable(Conn* c);
+    void close_conn(Conn* c);
+    void dispatch(Conn* c);
+    void handle_put_batch(Conn* c);
+    void handle_get_batch(Conn* c);
+    void handle_tcp_put(Conn* c);
+    void handle_simple(Conn* c);
+    void finish_payload(Conn* c);
+    void send_status(Conn* c, uint32_t status);
+    void send_resp(Conn* c, uint32_t status, std::vector<uint8_t> body,
+                   std::vector<iovec> payload, std::vector<BlockRef> refs);
+    void flush_out(Conn* c);
+    void arm(Conn* c, bool want_write);
+    bool ensure_capacity(size_t need_bytes);
+
+    ServerConfig config_;
+    std::unique_ptr<MM> mm_;
+    std::unique_ptr<KVStore> kv_;
+
+    int epoll_fd_ = -1;
+    int listen_fd_ = -1;
+    int wake_fd_ = -1;
+    int bound_port_ = 0;
+    std::thread thread_;
+    std::atomic<bool> running_{false};
+    std::atomic<bool> stop_requested_{false};
+
+    std::mutex posted_mu_;
+    std::vector<std::function<void()>> posted_;
+
+    std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+    // close_conn() defers destruction here so callers holding a Conn* across
+    // a close (e.g. readable -> dispatch -> flush -> error) never dangle; the
+    // reactor clears it between epoll batches.
+    std::vector<std::unique_ptr<Conn>> graveyard_;
+    std::unordered_map<uint8_t, OpStats> stats_;
+    uint64_t conns_accepted_ = 0;
+};
+
+}  // namespace its

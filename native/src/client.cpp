@@ -1,0 +1,473 @@
+#include "its/client.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <future>
+
+#include "its/iovec_util.h"
+#include "its/log.h"
+
+namespace its {
+
+struct Connection::Request {
+    uint8_t op = 0;
+    ReqHeader hdr{};
+    std::vector<uint8_t> body;
+    std::vector<iovec> tx_payload;  // gather sources (user memory / caller buffer)
+    size_t sent = 0;
+    size_t send_total = 0;
+
+    // get-batch scatter destinations (filled sizes arrive in the resp body)
+    std::vector<char*> rx_addrs;
+    uint32_t block_size = 0;
+    bool alloc_rx = false;  // tcp_get/stat: malloc a payload buffer
+
+    // async completion
+    CompletionCb cb = nullptr;
+    void* ctx = nullptr;
+
+    // sync completion: results are written through these before set_value()
+    std::promise<void>* prom = nullptr;
+    uint32_t* out_status = nullptr;
+    std::vector<uint8_t>* out_body = nullptr;
+    uint8_t** out_payload = nullptr;
+    size_t* out_payload_size = nullptr;
+
+    // reactor-side response capture
+    uint8_t* rx_buf = nullptr;
+    size_t rx_buf_size = 0;
+};
+
+Connection::Connection(const ClientConfig& config) : config_(config) {}
+
+Connection::~Connection() { close(); }
+
+int Connection::connect() {
+    if (connected_.load()) return 0;
+
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port = std::to_string(config_.port);
+    int rc = getaddrinfo(config_.host.c_str(), port.c_str(), &hints, &res);
+    if (rc != 0 || res == nullptr) {
+        ITS_LOG_ERROR("resolve %s failed: %s", config_.host.c_str(), gai_strerror(rc));
+        return -EHOSTUNREACH;
+    }
+
+    fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) {
+        freeaddrinfo(res);
+        return -errno;
+    }
+    // Nonblocking connect with a poll() deadline (connect_timeout_ms).
+    fcntl(fd_, F_SETFL, fcntl(fd_, F_GETFL, 0) | O_NONBLOCK);
+    rc = ::connect(fd_, res->ai_addr, res->ai_addrlen);
+    freeaddrinfo(res);
+    if (rc != 0 && errno != EINPROGRESS) {
+        rc = -errno;
+        ::close(fd_);
+        fd_ = -1;
+        return rc;
+    }
+    if (rc != 0) {
+        pollfd pfd{fd_, POLLOUT, 0};
+        rc = poll(&pfd, 1, config_.connect_timeout_ms);
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(fd_, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if (rc <= 0 || err != 0) {
+            ::close(fd_);
+            fd_ = -1;
+            return rc <= 0 ? -ETIMEDOUT : -err;
+        }
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+
+    stop_.store(false);
+    connected_.store(true);
+    thread_ = std::thread([this] { reactor(); });
+    ITS_LOG_DEBUG("connected to %s:%d", config_.host.c_str(), config_.port);
+    return 0;
+}
+
+void Connection::close() {
+    if (fd_ < 0) return;
+    stop_.store(true);
+    uint64_t one = 1;
+    ssize_t rc = write(wake_fd_, &one, sizeof(one));
+    (void)rc;
+    if (thread_.joinable()) thread_.join();
+    ::close(fd_);
+    ::close(wake_fd_);
+    ::close(epoll_fd_);
+    fd_ = wake_fd_ = epoll_fd_ = -1;
+    connected_.store(false);
+}
+
+int Connection::register_mr(void* ptr, size_t size) {
+    // Best-effort pin: mlock failure (RLIMIT_MEMLOCK in containers) degrades
+    // to unpinned but the region is still registered for validation.
+    if (mlock(ptr, size) != 0) {
+        ITS_LOG_WARN("mlock(%zu) failed (%s); region registered unpinned", size,
+                     strerror(errno));
+    }
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    regions_.emplace_back(static_cast<const char*>(ptr), size);
+    return 0;
+}
+
+bool Connection::base_registered(const void* base, size_t span) const {
+    const char* p = static_cast<const char*>(base);
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    for (const auto& [start, size] : regions_) {
+        if (p >= start && p + span <= start + size) return true;
+    }
+    return false;
+}
+
+int Connection::submit(std::unique_ptr<Request> req) {
+    req->hdr = ReqHeader{kMagic, req->op, static_cast<uint32_t>(req->body.size())};
+    req->send_total = sizeof(ReqHeader) + req->body.size();
+    for (const auto& io : req->tx_payload) req->send_total += io.iov_len;
+    {
+        std::lock_guard<std::mutex> lock(submit_mu_);
+        if (!connected_.load()) return -1;
+        submitted_.push_back(std::move(req));
+    }
+    uint64_t one = 1;
+    ssize_t rc = write(wake_fd_, &one, sizeof(one));
+    (void)rc;
+    return 0;
+}
+
+int Connection::put_batch_async(const std::vector<std::string>& keys,
+                                const std::vector<uint64_t>& offsets, uint32_t block_size,
+                                void* base_ptr, CompletionCb cb, void* ctx) {
+    if (keys.empty() || keys.size() != offsets.size()) return -1;
+    uint64_t span = 0;
+    for (uint64_t off : offsets) span = std::max(span, off + block_size);
+    if (!base_registered(base_ptr, span)) {
+        ITS_LOG_ERROR("put_batch: base pointer not inside a registered region");
+        return -1;
+    }
+    auto req = std::make_unique<Request>();
+    req->op = kOpPutBatch;
+    BatchMeta meta{block_size, keys};
+    meta.encode(req->body);
+    req->tx_payload.reserve(keys.size());
+    for (uint64_t off : offsets)
+        req->tx_payload.push_back(iovec{static_cast<char*>(base_ptr) + off, block_size});
+    req->cb = cb;
+    req->ctx = ctx;
+    return submit(std::move(req));
+}
+
+int Connection::get_batch_async(const std::vector<std::string>& keys,
+                                const std::vector<uint64_t>& offsets, uint32_t block_size,
+                                void* base_ptr, CompletionCb cb, void* ctx) {
+    if (keys.empty() || keys.size() != offsets.size()) return -1;
+    uint64_t span = 0;
+    for (uint64_t off : offsets) span = std::max(span, off + block_size);
+    if (!base_registered(base_ptr, span)) {
+        ITS_LOG_ERROR("get_batch: base pointer not inside a registered region");
+        return -1;
+    }
+    auto req = std::make_unique<Request>();
+    req->op = kOpGetBatch;
+    BatchMeta meta{block_size, keys};
+    meta.encode(req->body);
+    req->block_size = block_size;
+    req->rx_addrs.reserve(keys.size());
+    for (uint64_t off : offsets) req->rx_addrs.push_back(static_cast<char*>(base_ptr) + off);
+    req->cb = cb;
+    req->ctx = ctx;
+    return submit(std::move(req));
+}
+
+uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
+                                    std::vector<uint8_t>* body_out, uint8_t** payload_out,
+                                    size_t* payload_size_out) {
+    std::promise<void> done;
+    uint32_t status = kStatusUnavailable;
+    req->prom = &done;
+    req->out_status = &status;
+    req->out_body = body_out;
+    req->out_payload = payload_out;
+    req->out_payload_size = payload_size_out;
+    auto fut = done.get_future();
+    if (submit(std::move(req)) != 0) return kStatusUnavailable;
+    fut.wait();
+    return status;
+}
+
+int Connection::tcp_put(const std::string& key, const void* data, size_t size) {
+    auto req = std::make_unique<Request>();
+    req->op = kOpTcpPut;
+    TcpPutMeta meta{key, size};
+    meta.encode(req->body);
+    req->tx_payload.push_back(iovec{const_cast<void*>(data), size});
+    uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
+    return status == kStatusOk ? 0 : -static_cast<int>(status);
+}
+
+int Connection::tcp_get(const std::string& key, uint8_t** out, size_t* out_size) {
+    auto req = std::make_unique<Request>();
+    req->op = kOpTcpGet;
+    KeyMeta meta{key};
+    meta.encode(req->body);
+    req->alloc_rx = true;
+    uint32_t status = sync_roundtrip(std::move(req), nullptr, out, out_size);
+    return status == kStatusOk ? 0 : -static_cast<int>(status);
+}
+
+int Connection::check_exist(const std::string& key) {
+    auto req = std::make_unique<Request>();
+    req->op = kOpCheckExist;
+    KeyMeta meta{key};
+    meta.encode(req->body);
+    std::vector<uint8_t> body;
+    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    if (status != kStatusOk || body.empty()) return -static_cast<int>(status);
+    return body[0] != 0 ? 1 : 0;
+}
+
+int32_t Connection::get_match_last_index(const std::vector<std::string>& keys) {
+    auto req = std::make_unique<Request>();
+    req->op = kOpMatchLastIdx;
+    KeyListMeta meta{keys};
+    meta.encode(req->body);
+    std::vector<uint8_t> body;
+    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    if (status != kStatusOk || body.size() < 4) return INT32_MIN;
+    WireReader r(body.data(), body.size());
+    return r.i32();
+}
+
+int64_t Connection::delete_keys(const std::vector<std::string>& keys) {
+    auto req = std::make_unique<Request>();
+    req->op = kOpDeleteKeys;
+    KeyListMeta meta{keys};
+    meta.encode(req->body);
+    std::vector<uint8_t> body;
+    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    if (status != kStatusOk || body.size() < 4) return -static_cast<int64_t>(status);
+    WireReader r(body.data(), body.size());
+    return r.u32();
+}
+
+std::string Connection::stat_json() {
+    auto req = std::make_unique<Request>();
+    req->op = kOpStat;
+    std::vector<uint8_t> body;
+    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    if (status != kStatusOk) return "";
+    return std::string(body.begin(), body.end());
+}
+
+void Connection::complete(std::unique_ptr<Request> req, int code) {
+    if (req->prom != nullptr) {
+        *req->out_status = static_cast<uint32_t>(code);
+        if (req->out_body != nullptr) *req->out_body = std::move(rbody_);
+        if (req->out_payload != nullptr) {
+            *req->out_payload = req->rx_buf;
+            *req->out_payload_size = req->rx_buf_size;
+            req->rx_buf = nullptr;
+        }
+        req->prom->set_value();
+    } else if (req->cb != nullptr) {
+        req->cb(req->ctx, code);
+    }
+    if (req->rx_buf != nullptr) free(req->rx_buf);
+}
+
+void Connection::fail_all(int code) {
+    {
+        std::lock_guard<std::mutex> lock(submit_mu_);
+        connected_.store(false);
+        for (auto& req : submitted_) sendq_.push_back(std::move(req));
+        submitted_.clear();
+    }
+    while (!awaiting_.empty()) {
+        auto req = std::move(awaiting_.front());
+        awaiting_.pop_front();
+        complete(std::move(req), code);
+    }
+    while (!sendq_.empty()) {
+        auto req = std::move(sendq_.front());
+        sendq_.pop_front();
+        complete(std::move(req), code);
+    }
+}
+
+bool Connection::flush_send() {
+    while (!sendq_.empty()) {
+        Request* req = sendq_.front().get();
+        iovec iov[64];
+        size_t niov = build_send_iov(&req->hdr, sizeof(ReqHeader), req->body, req->tx_payload,
+                                     req->sent, iov, 64);
+        ssize_t r = writev(fd_, iov, static_cast<int>(niov));
+        if (r < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                epoll_event ev{};
+                ev.events = EPOLLIN | EPOLLOUT;
+                ev.data.fd = fd_;
+                epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd_, &ev);
+                return true;
+            }
+            return false;
+        }
+        req->sent += static_cast<size_t>(r);
+        if (req->sent == req->send_total) {
+            awaiting_.push_back(std::move(sendq_.front()));
+            sendq_.pop_front();
+        }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, fd_, &ev);
+    return true;
+}
+
+bool Connection::read_ready() {
+    while (true) {
+        if (!resp_in_progress_) {
+            ssize_t r = read(fd_, reinterpret_cast<char*>(&rhdr_) + rhdr_got_,
+                             sizeof(RespHeader) - rhdr_got_);
+            if (r == 0) return false;
+            if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+            rhdr_got_ += static_cast<size_t>(r);
+            if (rhdr_got_ < sizeof(RespHeader)) continue;
+            if (awaiting_.empty() || rhdr_.body_size > kMaxBodySize) {
+                ITS_LOG_ERROR("protocol error: unexpected response");
+                return false;
+            }
+            rbody_.resize(rhdr_.body_size);
+            rbody_got_ = 0;
+            resp_in_progress_ = true;
+            rx_setup_done_ = false;
+        }
+
+        Request* req = awaiting_.front().get();
+        if (rbody_got_ < rbody_.size()) {
+            ssize_t r = read(fd_, rbody_.data() + rbody_got_, rbody_.size() - rbody_got_);
+            if (r == 0) return false;
+            if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+            rbody_got_ += static_cast<size_t>(r);
+            if (rbody_got_ < rbody_.size()) continue;
+        }
+        if (!rx_setup_done_) {
+            // Body complete (possibly empty): set up payload reception once.
+            rx_setup_done_ = true;
+            rx_iov_.clear();
+            rx_cur_.reset();
+            rx_discard_ = 0;
+            if (rhdr_.payload_size > 0) {
+                if (req->op == kOpGetBatch && rhdr_.status == kStatusOk) {
+                    WireReader rd(rbody_.data(), rbody_.size());
+                    uint32_t n = rd.u32();
+                    if (n != req->rx_addrs.size()) {
+                        ITS_LOG_ERROR("get_batch: size list mismatch");
+                        return false;
+                    }
+                    for (uint32_t i = 0; i < n; i++) {
+                        uint32_t sz = rd.u32();
+                        rx_iov_.push_back(iovec{req->rx_addrs[i], sz});
+                    }
+                } else if (req->alloc_rx && rhdr_.status == kStatusOk) {
+                    req->rx_buf = static_cast<uint8_t*>(malloc(rhdr_.payload_size));
+                    req->rx_buf_size = rhdr_.payload_size;
+                    rx_iov_.push_back(iovec{req->rx_buf, rhdr_.payload_size});
+                } else {
+                    rx_discard_ = rhdr_.payload_size;
+                }
+            }
+        }
+
+        // Payload phase.
+        if (rx_discard_ > 0) {
+            char scratch[64 << 10];
+            ssize_t r = read(fd_, scratch, std::min<uint64_t>(rx_discard_, sizeof(scratch)));
+            if (r == 0) return false;
+            if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+            rx_discard_ -= static_cast<uint64_t>(r);
+            if (rx_discard_ > 0) continue;
+        } else if (!rx_cur_.done(rx_iov_)) {
+            iovec iov[64];
+            size_t niov = rx_cur_.fill(rx_iov_, iov, 64);
+            ssize_t r = readv(fd_, iov, static_cast<int>(niov));
+            if (r == 0) return false;
+            if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
+            rx_cur_.advance(rx_iov_, static_cast<size_t>(r));
+            if (!rx_cur_.done(rx_iov_)) continue;
+        }
+
+        // Response fully received.
+        auto done = std::move(awaiting_.front());
+        awaiting_.pop_front();
+        resp_in_progress_ = false;
+        rhdr_got_ = 0;
+        complete(std::move(done), static_cast<int>(rhdr_.status));
+    }
+}
+
+void Connection::reactor() {
+    constexpr int kMaxEvents = 8;
+    epoll_event events[kMaxEvents];
+    bool ok = true;
+    while (ok && !stop_.load(std::memory_order_relaxed)) {
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 200);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        for (int i = 0; i < n && ok; i++) {
+            int fd = events[i].data.fd;
+            if (fd == wake_fd_) {
+                uint64_t buf;
+                while (read(wake_fd_, &buf, sizeof(buf)) > 0) {
+                }
+                {
+                    std::lock_guard<std::mutex> lock(submit_mu_);
+                    for (auto& req : submitted_) sendq_.push_back(std::move(req));
+                    submitted_.clear();
+                }
+                ok = flush_send();
+            } else {
+                if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+                    ok = false;
+                    break;
+                }
+                if (events[i].events & EPOLLOUT) ok = flush_send();
+                if (ok && (events[i].events & EPOLLIN)) ok = read_ready();
+            }
+        }
+    }
+    fail_all(kStatusUnavailable);
+}
+
+}  // namespace its

@@ -1,0 +1,88 @@
+#include "its/kvstore.h"
+
+#include "its/log.h"
+
+namespace its {
+
+void KVStore::commit(const std::string& key, BlockRef block) {
+    auto it = map_.find(key);
+    if (it != map_.end()) {
+        // Overwrite: replace the block in place and touch. The old block is
+        // freed once in-flight readers release it.
+        lru_.erase(it->second.lru_it);
+        lru_.push_front(key);
+        it->second.block = std::move(block);
+        it->second.lru_it = lru_.begin();
+        return;
+    }
+    lru_.push_front(key);
+    map_.emplace(key, Entry{std::move(block), lru_.begin()});
+}
+
+BlockRef KVStore::get(const std::string& key) {
+    auto it = map_.find(key);
+    if (it == map_.end()) return nullptr;
+    lru_.erase(it->second.lru_it);
+    lru_.push_front(key);
+    it->second.lru_it = lru_.begin();
+    return it->second.block;
+}
+
+BlockRef KVStore::peek(const std::string& key) const {
+    auto it = map_.find(key);
+    return it == map_.end() ? nullptr : it->second.block;
+}
+
+bool KVStore::exists(const std::string& key) const { return map_.count(key) != 0; }
+
+size_t KVStore::remove(const std::vector<std::string>& keys) {
+    size_t removed = 0;
+    for (const auto& key : keys) {
+        auto it = map_.find(key);
+        if (it == map_.end()) continue;
+        lru_.erase(it->second.lru_it);
+        map_.erase(it);
+        removed++;
+    }
+    return removed;
+}
+
+size_t KVStore::purge() {
+    size_t n = map_.size();
+    map_.clear();
+    lru_.clear();
+    return n;
+}
+
+int32_t KVStore::match_last_index(const std::vector<std::string>& keys) const {
+    // Binary search is only correct under the prefix property; this matches
+    // the reference's behavior exactly, including on inputs that violate it
+    // (test_infinistore.py:291-311 relies on that).
+    size_t lo = 0, hi = keys.size();
+    while (lo < hi) {
+        size_t mid = lo + (hi - lo) / 2;
+        if (exists(keys[mid])) {
+            lo = mid + 1;
+        } else {
+            hi = mid;
+        }
+    }
+    return static_cast<int32_t>(lo) - 1;
+}
+
+size_t KVStore::evict(double min_ratio, double max_ratio) {
+    if (mm_->usage() < max_ratio) return 0;
+    size_t evicted = 0;
+    while (mm_->usage() > min_ratio && !lru_.empty()) {
+        const std::string& victim = lru_.back();
+        auto it = map_.find(victim);
+        // The LRU and map are kept in lockstep; a miss here is a logic bug.
+        if (it != map_.end()) map_.erase(it);
+        lru_.pop_back();
+        evicted++;
+    }
+    if (evicted > 0) ITS_LOG_INFO("evicted %zu entries, usage now %.2f", evicted, mm_->usage());
+    return evicted;
+}
+
+}  // namespace its

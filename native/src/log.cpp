@@ -1,0 +1,61 @@
+#include "its/log.h"
+
+#include <atomic>
+#include <cstdio>
+#include <ctime>
+#include <mutex>
+
+namespace its {
+
+namespace {
+std::atomic<int> g_level{static_cast<int>(LogLevel::kInfo)};
+std::atomic<LogSink> g_sink{nullptr};
+std::mutex g_stderr_mu;
+
+const char* level_name(int level) {
+    switch (level) {
+        case 0:
+            return "DEBUG";
+        case 1:
+            return "INFO";
+        case 2:
+            return "WARN";
+        case 3:
+            return "ERROR";
+        default:
+            return "?";
+    }
+}
+}  // namespace
+
+void set_log_level(LogLevel level) { g_level.store(static_cast<int>(level)); }
+
+LogLevel log_level() { return static_cast<LogLevel>(g_level.load()); }
+
+void set_log_sink(LogSink sink) { g_sink.store(sink); }
+
+void log_msg(LogLevel level, const char* fmt, ...) {
+    int lvl = static_cast<int>(level);
+    if (lvl < g_level.load(std::memory_order_relaxed)) return;
+
+    char buf[2048];
+    va_list args;
+    va_start(args, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, args);
+    va_end(args);
+
+    LogSink sink = g_sink.load();
+    if (sink != nullptr) {
+        sink(lvl, buf);
+        return;
+    }
+    std::lock_guard<std::mutex> lock(g_stderr_mu);
+    char ts[32];
+    std::time_t now = std::time(nullptr);
+    std::tm tm_buf;
+    localtime_r(&now, &tm_buf);
+    std::strftime(ts, sizeof(ts), "%H:%M:%S", &tm_buf);
+    fprintf(stderr, "[%s] [infinistore-tpu] [%s] %s\n", ts, level_name(lvl), buf);
+}
+
+}  // namespace its

@@ -1,0 +1,214 @@
+#include "its/mempool.h"
+
+#include <strings.h>
+#include <sys/mman.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+
+#include "its/log.h"
+
+namespace its {
+
+namespace {
+constexpr size_t kAlignment = 4096;
+
+bool is_pow2(size_t v) { return v != 0 && (v & (v - 1)) == 0; }
+}  // namespace
+
+MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin)
+    : pool_size_(pool_size), block_size_(block_size) {
+    if (!is_pow2(block_size)) throw std::invalid_argument("block_size must be a power of two");
+    if (pool_size == 0 || pool_size % block_size != 0)
+        throw std::invalid_argument("pool_size must be a positive multiple of block_size");
+    total_blocks_ = pool_size / block_size;
+
+    void* mem = nullptr;
+    if (posix_memalign(&mem, kAlignment, pool_size) != 0)
+        throw std::bad_alloc();
+    base_ = static_cast<char*>(mem);
+
+    if (pin) {
+        // Pin so DCN send/recv never faults mid-transfer. Containers commonly
+        // cap RLIMIT_MEMLOCK, so a failure downgrades to unpinned, not fatal.
+        if (mlock(base_, pool_size_) == 0) {
+            pinned_ = true;
+        } else {
+            ITS_LOG_WARN("mlock(%zu bytes) failed; pool is unpinned", pool_size_);
+        }
+    }
+    bitmap_.assign((total_blocks_ + 63) / 64, 0);
+    ITS_LOG_INFO("mempool: %zu MB, block %zu KB, %zu blocks, pinned=%d",
+                 pool_size_ >> 20, block_size_ >> 10, total_blocks_, (int)pinned_);
+}
+
+MemoryPool::~MemoryPool() {
+    if (base_ != nullptr) {
+        if (pinned_) munlock(base_, pool_size_);
+        free(base_);
+    }
+}
+
+size_t MemoryPool::find_free_run(size_t nblocks) {
+    // First-fit scan. Fast path: skip fully-used words, find the first zero
+    // bit with ffsll (reference uses ctz the same way,
+    // /root/reference/src/mempool.cpp:55-112), then verify run length.
+    size_t idx = 0;
+    while (idx < total_blocks_) {
+        size_t word = idx / 64;
+        if (bitmap_[word] == ~0ull) {
+            idx = (word + 1) * 64;
+            continue;
+        }
+        uint64_t inv = ~bitmap_[word] & (~0ull << (idx % 64));
+        if (inv == 0) {
+            idx = (word + 1) * 64;
+            continue;
+        }
+        size_t start = word * 64 + static_cast<size_t>(__builtin_ctzll(inv));
+        if (start >= total_blocks_) break;
+        // Check the run [start, start+nblocks).
+        size_t run = 0;
+        while (run < nblocks && start + run < total_blocks_) {
+            size_t b = start + run;
+            if (bitmap_[b / 64] & (1ull << (b % 64))) break;
+            run++;
+        }
+        if (run == nblocks) return start;
+        idx = start + run + 1;
+    }
+    return SIZE_MAX;
+}
+
+void MemoryPool::mark(size_t first_block, size_t nblocks, bool used) {
+    for (size_t i = first_block; i < first_block + nblocks; i++) {
+        uint64_t bit = 1ull << (i % 64);
+        if (used) {
+            bitmap_[i / 64] |= bit;
+        } else {
+            bitmap_[i / 64] &= ~bit;
+        }
+    }
+}
+
+void* MemoryPool::allocate(size_t size) {
+    if (size == 0) return nullptr;
+    size_t nblocks = (size + block_size_ - 1) / block_size_;
+    size_t start = find_free_run(nblocks);
+    if (start == SIZE_MAX) return nullptr;
+    mark(start, nblocks, /*used=*/true);
+    used_blocks_ += nblocks;
+    return base_ + start * block_size_;
+}
+
+bool MemoryPool::deallocate(void* ptr, size_t size) {
+    char* p = static_cast<char*>(ptr);
+    if (!contains(p) || (p - base_) % block_size_ != 0) {
+        ITS_LOG_ERROR("deallocate of foreign/misaligned pointer %p", ptr);
+        return false;
+    }
+    size_t first = static_cast<size_t>(p - base_) / block_size_;
+    size_t nblocks = (size + block_size_ - 1) / block_size_;
+    if (first + nblocks > total_blocks_) {
+        ITS_LOG_ERROR("deallocate past pool end (%zu blocks at %zu)", nblocks, first);
+        return false;
+    }
+    // Double-free detection (reference /root/reference/src/mempool.cpp:114-156).
+    for (size_t i = first; i < first + nblocks; i++) {
+        if (!(bitmap_[i / 64] & (1ull << (i % 64)))) {
+            ITS_LOG_ERROR("double free detected at block %zu", i);
+            return false;
+        }
+    }
+    mark(first, nblocks, /*used=*/false);
+    used_blocks_ -= nblocks;
+    return true;
+}
+
+MM::MM(size_t initial_pool_size, size_t block_size, bool pin)
+    : block_size_(block_size), pin_(pin) {
+    pools_.push_back(std::make_unique<MemoryPool>(initial_pool_size, block_size, pin));
+}
+
+bool MM::allocate(size_t size, size_t n, const std::function<void(void*, size_t)>& cb,
+                  std::vector<Lease>* out) {
+    std::vector<Lease> leases;
+    leases.reserve(n);
+    for (size_t i = 0; i < n; i++) {
+        void* ptr = nullptr;
+        MemoryPool* owner = nullptr;
+        for (auto& pool : pools_) {
+            ptr = pool->allocate(size);
+            if (ptr != nullptr) {
+                owner = pool.get();
+                break;
+            }
+        }
+        if (ptr == nullptr) {
+            // All-or-nothing, as in the reference: roll back this batch.
+            for (const auto& l : leases) l.pool->deallocate(l.ptr, l.size);
+            return false;
+        }
+        leases.push_back(Lease{ptr, size, owner});
+        if (cb) cb(ptr, i);
+    }
+    if (out != nullptr) {
+        out->insert(out->end(), leases.begin(), leases.end());
+    }
+    return true;
+}
+
+void MM::deallocate(const Lease& lease) { lease.pool->deallocate(lease.ptr, lease.size); }
+
+void MM::deallocate(void* ptr, size_t size) {
+    for (auto& pool : pools_) {
+        if (pool->contains(ptr)) {
+            pool->deallocate(ptr, size);
+            return;
+        }
+    }
+    ITS_LOG_ERROR("deallocate: pointer %p not owned by any pool", ptr);
+}
+
+bool MM::extend(size_t pool_size) {
+    try {
+        pools_.push_back(std::make_unique<MemoryPool>(pool_size, block_size_, pin_));
+        ITS_LOG_INFO("mempool extended: now %zu pools, %zu MB total", pools_.size(),
+                     total_bytes() >> 20);
+        return true;
+    } catch (const std::exception& e) {
+        ITS_LOG_ERROR("mempool extend failed: %s", e.what());
+        return false;
+    }
+}
+
+double MM::usage() const {
+    size_t used = 0, total = 0;
+    for (const auto& pool : pools_) {
+        used += pool->used_blocks();
+        total += pool->total_blocks();
+    }
+    return total == 0 ? 0.0 : static_cast<double>(used) / static_cast<double>(total);
+}
+
+size_t MM::total_bytes() const {
+    size_t total = 0;
+    for (const auto& pool : pools_) total += pool->total_blocks() * pool->block_size();
+    return total;
+}
+
+size_t MM::used_bytes() const {
+    size_t used = 0;
+    for (const auto& pool : pools_) used += pool->used_blocks() * pool->block_size();
+    return used;
+}
+
+bool MM::pinned() const {
+    for (const auto& pool : pools_) {
+        if (!pool->pinned()) return false;
+    }
+    return !pools_.empty();
+}
+
+}  // namespace its

@@ -1,0 +1,714 @@
+#include "its/server.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <future>
+
+#include "its/iovec_util.h"
+#include "its/log.h"
+
+namespace its {
+
+namespace {
+
+uint64_t now_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000ull + ts.tv_nsec / 1000;
+}
+
+int log2_bucket(uint64_t us) {
+    int b = 0;
+    while (us > 1 && b < 31) {
+        us >>= 1;
+        b++;
+    }
+    return b;
+}
+
+}  // namespace
+
+void OpStats::record(uint64_t us, uint64_t in_bytes, uint64_t out_bytes, bool ok) {
+    count++;
+    if (!ok) errors++;
+    bytes_in += in_bytes;
+    bytes_out += out_bytes;
+    total_us += us;
+    lat_buckets[log2_bucket(us)]++;
+}
+
+double OpStats::p50_us() const {
+    if (count == 0) return 0.0;
+    uint64_t seen = 0, half = (count + 1) / 2;
+    for (int i = 0; i < 32; i++) {
+        seen += lat_buckets[i];
+        if (seen >= half) return static_cast<double>(1ull << i);
+    }
+    return 0.0;
+}
+
+// Per-connection state machine (reference Client,
+// /root/reference/src/infinistore.cpp:55-109; read states :43-47).
+struct Server::Conn {
+    enum class RState { kHeader, kBody, kPayload, kDrain };
+
+    int fd = -1;
+    bool dead = false;
+    RState rstate = RState::kHeader;
+    ReqHeader hdr{};
+    size_t hdr_got = 0;
+    std::vector<uint8_t> body;
+    size_t body_got = 0;
+
+    // Payload scatter targets for put paths: socket bytes land directly in
+    // pool blocks (the zero-copy half of the old server-side RDMA READ).
+    std::vector<iovec> rx_iov;
+    ScatterCursor rx_cur;
+    std::vector<std::string> pending_keys;
+    std::vector<BlockRef> pending_blocks;
+    uint64_t drain_remaining = 0;
+    uint32_t drain_status = kStatusOk;
+
+    uint8_t cur_op = 0;
+    uint64_t op_start_us = 0;
+
+    struct OutMsg {
+        RespHeader hdr;
+        std::vector<uint8_t> body;
+        std::vector<iovec> payload;
+        std::vector<BlockRef> refs;  // keeps blocks alive while streaming
+        size_t sent = 0;
+        size_t total = 0;
+    };
+    std::deque<OutMsg> outq;
+    bool epollout_armed = false;
+
+    void reset_read() {
+        rstate = RState::kHeader;
+        hdr_got = 0;
+        body.clear();
+        body_got = 0;
+        rx_iov.clear();
+        rx_cur.reset();
+        pending_keys.clear();
+        pending_blocks.clear();
+        drain_remaining = 0;
+    }
+};
+
+Server::Server(const ServerConfig& config) : config_(config) {
+    mm_ = std::make_unique<MM>(config.prealloc_bytes, config.block_size, config.pin_memory);
+    kv_ = std::make_unique<KVStore>(mm_.get());
+}
+
+Server::~Server() { stop(); }
+
+bool Server::start() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(config_.service_port));
+    if (inet_pton(AF_INET, config_.bind_addr.c_str(), &addr.sin_addr) != 1) {
+        ITS_LOG_ERROR("bad bind address %s", config_.bind_addr.c_str());
+        close(listen_fd_);
+        listen_fd_ = -1;
+        return false;
+    }
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        listen(listen_fd_, 128) != 0) {
+        ITS_LOG_ERROR("bind/listen on %s:%d failed: %s", config_.bind_addr.c_str(),
+                      config_.service_port, strerror(errno));
+        close(listen_fd_);
+        listen_fd_ = -1;
+        return false;
+    }
+    socklen_t len = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    bound_port_ = ntohs(addr.sin_port);
+
+    epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
+    wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.fd = listen_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, listen_fd_, &ev);
+    ev.data.fd = wake_fd_;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, wake_fd_, &ev);
+
+    running_.store(true);
+    stop_requested_.store(false);
+    thread_ = std::thread([this] { loop(); });
+    ITS_LOG_INFO("server listening on %s:%d (pool %zu MB, block %zu KB)",
+                 config_.bind_addr.c_str(), bound_port_, config_.prealloc_bytes >> 20,
+                 config_.block_size >> 10);
+    return true;
+}
+
+void Server::stop() {
+    if (!running_.load()) return;
+    stop_requested_.store(true);
+    uint64_t one = 1;
+    ssize_t rc = write(wake_fd_, &one, sizeof(one));
+    (void)rc;
+    if (thread_.joinable()) thread_.join();
+    running_.store(false);
+}
+
+void Server::post(std::function<void()> fn) {
+    {
+        std::lock_guard<std::mutex> lock(posted_mu_);
+        posted_.push_back(std::move(fn));
+    }
+    uint64_t one = 1;
+    ssize_t rc = write(wake_fd_, &one, sizeof(one));
+    (void)rc;
+}
+
+void Server::call(std::function<void()> fn) {
+    if (std::this_thread::get_id() == thread_.get_id()) {
+        fn();
+        return;
+    }
+    if (!running_.load()) {
+        // Reactor joined (or never started): state is single-threaded now,
+        // run inline instead of posting to a loop that will never drain.
+        fn();
+        return;
+    }
+    std::promise<void> done;
+    auto fut = done.get_future();
+    post([&fn, &done] {
+        fn();
+        done.set_value();
+    });
+    fut.wait();
+}
+
+size_t Server::kvmap_len() {
+    size_t n = 0;
+    call([&] { n = kv_->size(); });
+    return n;
+}
+
+size_t Server::purge() {
+    size_t n = 0;
+    call([&] { n = kv_->purge(); });
+    return n;
+}
+
+size_t Server::evict(double min_ratio, double max_ratio) {
+    size_t n = 0;
+    call([&] { n = kv_->evict(min_ratio, max_ratio); });
+    return n;
+}
+
+double Server::usage() {
+    double u = 0;
+    call([&] { u = mm_->usage(); });
+    return u;
+}
+
+std::string Server::stats_json() {
+    std::string out;
+    call([&] {
+        out = "{\"kvmap_len\":" + std::to_string(kv_->size()) +
+              ",\"usage\":" + std::to_string(mm_->usage()) +
+              ",\"total_bytes\":" + std::to_string(mm_->total_bytes()) +
+              ",\"used_bytes\":" + std::to_string(mm_->used_bytes()) +
+              ",\"pools\":" + std::to_string(mm_->pool_count()) +
+              ",\"pinned\":" + (mm_->pinned() ? std::string("true") : std::string("false")) +
+              ",\"connections\":" + std::to_string(conns_.size()) +
+              ",\"conns_accepted\":" + std::to_string(conns_accepted_) + ",\"ops\":{";
+        bool first = true;
+        for (const auto& [op, s] : stats_) {
+            if (!first) out += ",";
+            first = false;
+            out += "\"" + std::string(1, static_cast<char>(op)) + "\":{" +
+                   "\"count\":" + std::to_string(s.count) +
+                   ",\"errors\":" + std::to_string(s.errors) +
+                   ",\"bytes_in\":" + std::to_string(s.bytes_in) +
+                   ",\"bytes_out\":" + std::to_string(s.bytes_out) +
+                   ",\"total_us\":" + std::to_string(s.total_us) +
+                   ",\"p50_us\":" + std::to_string(s.p50_us()) + "}";
+        }
+        out += "}}";
+    });
+    return out;
+}
+
+void Server::loop() {
+    constexpr int kMaxEvents = 64;
+    epoll_event events[kMaxEvents];
+    while (!stop_requested_.load(std::memory_order_relaxed)) {
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 200);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            ITS_LOG_ERROR("epoll_wait: %s", strerror(errno));
+            break;
+        }
+        for (int i = 0; i < n; i++) {
+            int fd = events[i].data.fd;
+            if (fd == listen_fd_) {
+                accept_ready();
+            } else if (fd == wake_fd_) {
+                uint64_t buf;
+                while (read(wake_fd_, &buf, sizeof(buf)) > 0) {
+                }
+                std::vector<std::function<void()>> fns;
+                {
+                    std::lock_guard<std::mutex> lock(posted_mu_);
+                    fns.swap(posted_);
+                }
+                for (auto& fn : fns) fn();
+            } else {
+                auto it = conns_.find(fd);
+                if (it == conns_.end()) continue;
+                Conn* c = it->second.get();
+                if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+                    close_conn(c);
+                    continue;
+                }
+                if (events[i].events & EPOLLOUT) conn_writable(c);
+                // conn_writable may close on error; re-check liveness.
+                if (!c->dead && (events[i].events & EPOLLIN)) conn_readable(c);
+            }
+        }
+        graveyard_.clear();
+    }
+    // Drain control closures posted during shutdown so no caller hangs.
+    {
+        std::vector<std::function<void()>> fns;
+        {
+            std::lock_guard<std::mutex> lock(posted_mu_);
+            fns.swap(posted_);
+        }
+        for (auto& fn : fns) fn();
+    }
+    // Teardown on the reactor thread.
+    for (auto& [fd, c] : conns_) close(fd);
+    conns_.clear();
+    close(listen_fd_);
+    close(wake_fd_);
+    close(epoll_fd_);
+    listen_fd_ = wake_fd_ = epoll_fd_ = -1;
+}
+
+void Server::accept_ready() {
+    while (true) {
+        int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK | SOCK_CLOEXEC);
+        if (fd < 0) return;
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto conn = std::make_unique<Conn>();
+        conn->fd = fd;
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.fd = fd;
+        epoll_ctl(epoll_fd_, EPOLL_CTL_ADD, fd, &ev);
+        conns_.emplace(fd, std::move(conn));
+        conns_accepted_++;
+        ITS_LOG_DEBUG("accepted connection fd=%d", fd);
+    }
+}
+
+void Server::close_conn(Conn* c) {
+    if (c->dead) return;
+    c->dead = true;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
+    close(c->fd);
+    auto it = conns_.find(c->fd);
+    if (it != conns_.end()) {
+        graveyard_.push_back(std::move(it->second));
+        conns_.erase(it);
+    }
+}
+
+void Server::arm(Conn* c, bool want_write) {
+    if (c->epollout_armed == want_write) return;
+    epoll_event ev{};
+    ev.events = want_write ? (EPOLLIN | EPOLLOUT) : EPOLLIN;
+    ev.data.fd = c->fd;
+    epoll_ctl(epoll_fd_, EPOLL_CTL_MOD, c->fd, &ev);
+    c->epollout_armed = want_write;
+}
+
+void Server::conn_readable(Conn* c) {
+    while (true) {
+        switch (c->rstate) {
+            case Conn::RState::kHeader: {
+                ssize_t r = read(c->fd, reinterpret_cast<char*>(&c->hdr) + c->hdr_got,
+                                 sizeof(ReqHeader) - c->hdr_got);
+                if (r == 0) {
+                    close_conn(c);
+                    return;
+                }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    close_conn(c);
+                    return;
+                }
+                c->hdr_got += static_cast<size_t>(r);
+                if (c->hdr_got < sizeof(ReqHeader)) break;
+                // Bad magic / oversized body closes the connection, as in the
+                // reference (/root/reference/src/infinistore.cpp:910-915).
+                if (c->hdr.magic != kMagic || c->hdr.body_size > kMaxBodySize) {
+                    ITS_LOG_WARN("bad header from fd=%d, closing", c->fd);
+                    close_conn(c);
+                    return;
+                }
+                c->cur_op = c->hdr.op;
+                c->op_start_us = now_us();
+                c->body.resize(c->hdr.body_size);
+                c->body_got = 0;
+                c->rstate = Conn::RState::kBody;
+                if (c->hdr.body_size == 0) {
+                    dispatch(c);
+                    if (c->dead) return;
+                }
+                break;
+            }
+            case Conn::RState::kBody: {
+                ssize_t r =
+                    read(c->fd, c->body.data() + c->body_got, c->body.size() - c->body_got);
+                if (r == 0) {
+                    close_conn(c);
+                    return;
+                }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    close_conn(c);
+                    return;
+                }
+                c->body_got += static_cast<size_t>(r);
+                if (c->body_got == c->body.size()) {
+                    dispatch(c);
+                    if (c->dead) return;
+                }
+                break;
+            }
+            case Conn::RState::kPayload: {
+                iovec iov[64];
+                size_t niov = c->rx_cur.fill(c->rx_iov, iov, 64);
+                ssize_t r = readv(c->fd, iov, static_cast<int>(niov));
+                if (r == 0) {
+                    close_conn(c);
+                    return;
+                }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    close_conn(c);
+                    return;
+                }
+                c->rx_cur.advance(c->rx_iov, static_cast<size_t>(r));
+                if (c->rx_cur.done(c->rx_iov)) {
+                    finish_payload(c);
+                    if (c->dead) return;
+                }
+                break;
+            }
+            case Conn::RState::kDrain: {
+                // OOM path: the client already streamed its payload; consume
+                // and discard it so the connection stays usable, then report.
+                char scratch[64 << 10];
+                size_t want = std::min(c->drain_remaining, sizeof(scratch));
+                ssize_t r = read(c->fd, scratch, want);
+                if (r == 0) {
+                    close_conn(c);
+                    return;
+                }
+                if (r < 0) {
+                    if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+                    close_conn(c);
+                    return;
+                }
+                c->drain_remaining -= static_cast<size_t>(r);
+                if (c->drain_remaining == 0) {
+                    uint32_t status = c->drain_status;
+                    c->reset_read();
+                    send_status(c, status);
+                    if (c->dead) return;
+                }
+                break;
+            }
+        }
+    }
+}
+
+void Server::dispatch(Conn* c) {
+    try {
+        switch (c->hdr.op) {
+            case kOpPutBatch:
+                handle_put_batch(c);
+                break;
+            case kOpGetBatch:
+                handle_get_batch(c);
+                break;
+            case kOpTcpPut:
+                handle_tcp_put(c);
+                break;
+            case kOpTcpGet:
+            case kOpCheckExist:
+            case kOpMatchLastIdx:
+            case kOpDeleteKeys:
+            case kOpStat:
+                handle_simple(c);
+                break;
+            default:
+                ITS_LOG_WARN("unknown op %c from fd=%d, closing", c->hdr.op, c->fd);
+                close_conn(c);
+                return;
+        }
+    } catch (const std::exception& e) {
+        ITS_LOG_WARN("malformed %c request (%s), closing fd=%d", c->hdr.op, e.what(), c->fd);
+        close_conn(c);
+    }
+}
+
+bool Server::ensure_capacity(size_t need_bytes) {
+    (void)need_bytes;
+    // Proactive auto-extend above BLOCK_USAGE_RATIO, as the reference's MM
+    // signals (/root/reference/src/infinistore.cpp:445, mempool.h:68-78).
+    if (config_.auto_increase && mm_->need_extend()) {
+        return mm_->extend(config_.extend_pool_bytes);
+    }
+    return true;
+}
+
+void Server::handle_put_batch(Conn* c) {
+    BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+    size_t n = m.keys.size();
+    if (n == 0 || m.block_size == 0) {
+        c->reset_read();
+        send_status(c, kStatusInvalidReq);
+        return;
+    }
+    uint64_t need = static_cast<uint64_t>(n) * m.block_size;
+    kv_->evict(config_.evict_min_ratio, config_.evict_max_ratio);
+    ensure_capacity(need);
+
+    std::vector<Lease> leases;
+    bool ok = mm_->allocate(m.block_size, n, nullptr, &leases);
+    if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
+        ok = mm_->allocate(m.block_size, n, nullptr, &leases);
+    }
+    if (!ok) {
+        // Client streams payload back-to-back with the metadata (no extra
+        // RTT), so on OOM we must drain it before answering 507.
+        c->body.clear();
+        c->rstate = Conn::RState::kDrain;
+        c->drain_remaining = need;
+        c->drain_status = kStatusOutOfMemory;
+        return;
+    }
+    c->pending_keys = std::move(m.keys);
+    c->pending_blocks.reserve(n);
+    c->rx_iov.reserve(n);
+    for (const auto& lease : leases) {
+        c->pending_blocks.push_back(std::make_shared<Block>(mm_.get(), lease.ptr, lease.size));
+        c->rx_iov.push_back(iovec{lease.ptr, m.block_size});
+    }
+    c->rstate = Conn::RState::kPayload;
+    c->rx_cur.reset();
+}
+
+void Server::handle_tcp_put(Conn* c) {
+    TcpPutMeta m = TcpPutMeta::decode(c->body.data(), c->body.size());
+    if (m.value_length == 0) {
+        c->reset_read();
+        send_status(c, kStatusInvalidReq);
+        return;
+    }
+    kv_->evict(config_.evict_min_ratio, config_.evict_max_ratio);
+    ensure_capacity(m.value_length);
+
+    std::vector<Lease> leases;
+    bool ok = mm_->allocate(m.value_length, 1, nullptr, &leases);
+    if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
+        ok = mm_->allocate(m.value_length, 1, nullptr, &leases);
+    }
+    if (!ok) {
+        c->body.clear();
+        c->rstate = Conn::RState::kDrain;
+        c->drain_remaining = m.value_length;
+        c->drain_status = kStatusOutOfMemory;
+        return;
+    }
+    c->pending_keys = {std::move(m.key)};
+    c->pending_blocks = {std::make_shared<Block>(mm_.get(), leases[0].ptr, leases[0].size)};
+    c->rx_iov = {iovec{leases[0].ptr, m.value_length}};
+    c->rstate = Conn::RState::kPayload;
+    c->rx_cur.reset();
+}
+
+void Server::finish_payload(Conn* c) {
+    // Commit-on-transfer-complete: keys become visible only now (reference
+    // commits on RDMA READ completion, /root/reference/src/infinistore.cpp:405-418).
+    uint64_t in_bytes = 0;
+    for (size_t i = 0; i < c->pending_keys.size(); i++) {
+        in_bytes += c->pending_blocks[i]->size();
+        kv_->commit(c->pending_keys[i], std::move(c->pending_blocks[i]));
+    }
+    uint8_t op = c->cur_op;
+    uint64_t us = now_us() - c->op_start_us;
+    stats_[op].record(us, in_bytes, 0, true);
+    c->reset_read();
+    send_resp(c, kStatusOk, {}, {}, {});
+}
+
+void Server::handle_get_batch(Conn* c) {
+    BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+    if (m.keys.empty() || m.block_size == 0) {
+        c->reset_read();
+        send_status(c, kStatusInvalidReq);
+        return;
+    }
+    // All keys must exist (reference read_rdma_cache,
+    // /root/reference/src/infinistore.cpp:612-617)...
+    for (const auto& key : m.keys) {
+        if (!kv_->exists(key)) {
+            c->reset_read();
+            send_status(c, kStatusKeyNotFound);
+            return;
+        }
+    }
+    std::vector<BlockRef> refs;
+    std::vector<iovec> payload;
+    std::vector<uint8_t> body;
+    WireWriter w(body);
+    w.u32(static_cast<uint32_t>(m.keys.size()));
+    uint64_t total = 0;
+    for (const auto& key : m.keys) {
+        BlockRef b = kv_->get(key);  // touches LRU (reference :629-634)
+        // ...and each stored size must fit the client's block stride (:620-624).
+        if (b->size() > m.block_size) {
+            c->reset_read();
+            send_status(c, kStatusInvalidReq);
+            return;
+        }
+        w.u32(static_cast<uint32_t>(b->size()));
+        payload.push_back(iovec{b->data(), b->size()});
+        total += b->size();
+        refs.push_back(std::move(b));
+    }
+    uint8_t op = c->cur_op;
+    uint64_t us = now_us() - c->op_start_us;
+    stats_[op].record(us, 0, total, true);
+    c->reset_read();
+    send_resp(c, kStatusOk, std::move(body), std::move(payload), std::move(refs));
+}
+
+void Server::handle_simple(Conn* c) {
+    std::vector<uint8_t> body;
+    std::vector<iovec> payload;
+    std::vector<BlockRef> refs;
+    uint32_t status = kStatusOk;
+    WireWriter w(body);
+
+    switch (c->hdr.op) {
+        case kOpTcpGet: {
+            KeyMeta m = KeyMeta::decode(c->body.data(), c->body.size());
+            BlockRef b = kv_->get(m.key);
+            if (b == nullptr) {
+                status = kStatusKeyNotFound;
+            } else {
+                payload.push_back(iovec{b->data(), b->size()});
+                refs.push_back(std::move(b));
+            }
+            break;
+        }
+        case kOpCheckExist: {
+            KeyMeta m = KeyMeta::decode(c->body.data(), c->body.size());
+            w.u8(kv_->exists(m.key) ? 1 : 0);
+            break;
+        }
+        case kOpMatchLastIdx: {
+            KeyListMeta m = KeyListMeta::decode(c->body.data(), c->body.size());
+            w.i32(kv_->match_last_index(m.keys));
+            break;
+        }
+        case kOpDeleteKeys: {
+            KeyListMeta m = KeyListMeta::decode(c->body.data(), c->body.size());
+            w.u32(static_cast<uint32_t>(kv_->remove(m.keys)));
+            break;
+        }
+        case kOpStat: {
+            // stats_json() runs inline: we are on the reactor thread.
+            std::string s = stats_json();
+            body.assign(s.begin(), s.end());
+            break;
+        }
+        default:
+            status = kStatusInvalidReq;
+    }
+    uint64_t out_bytes = 0;
+    for (const auto& io : payload) out_bytes += io.iov_len;
+    uint8_t op = c->cur_op;
+    uint64_t us = now_us() - c->op_start_us;
+    stats_[op].record(us, 0, out_bytes, status == kStatusOk);
+    c->reset_read();
+    send_resp(c, status, std::move(body), std::move(payload), std::move(refs));
+}
+
+void Server::send_status(Conn* c, uint32_t status) {
+    if (status != kStatusOk) stats_[c->cur_op].record(now_us() - c->op_start_us, 0, 0, false);
+    send_resp(c, status, {}, {}, {});
+}
+
+void Server::send_resp(Conn* c, uint32_t status, std::vector<uint8_t> body,
+                       std::vector<iovec> payload, std::vector<BlockRef> refs) {
+    Conn::OutMsg msg;
+    msg.hdr.status = status;
+    msg.hdr.body_size = static_cast<uint32_t>(body.size());
+    uint64_t ptotal = 0;
+    for (const auto& io : payload) ptotal += io.iov_len;
+    msg.hdr.payload_size = ptotal;
+    msg.body = std::move(body);
+    msg.payload = std::move(payload);
+    msg.refs = std::move(refs);
+    msg.total = sizeof(RespHeader) + msg.body.size() + ptotal;
+    c->outq.push_back(std::move(msg));
+    flush_out(c);
+}
+
+void Server::flush_out(Conn* c) {
+    while (!c->outq.empty()) {
+        Conn::OutMsg& msg = c->outq.front();
+        iovec iov[64];
+        size_t niov =
+            build_send_iov(&msg.hdr, sizeof(RespHeader), msg.body, msg.payload, msg.sent, iov, 64);
+        if (niov == 0) {
+            c->outq.pop_front();
+            continue;
+        }
+        ssize_t r = writev(c->fd, iov, static_cast<int>(niov));
+        if (r < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                arm(c, true);
+                return;
+            }
+            close_conn(c);
+            return;
+        }
+        msg.sent += static_cast<size_t>(r);
+        if (msg.sent == msg.total) c->outq.pop_front();
+    }
+    arm(c, false);
+}
+
+void Server::conn_writable(Conn* c) { flush_out(c); }
+
+}  // namespace its

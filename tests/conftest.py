@@ -1,0 +1,62 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
+available in CI); the env vars must be set before jax is first imported.
+The store's TCP/DCN paths need no accelerator at all — unlike the reference,
+whose entire test suite is gated on real RDMA NICs + CUDA GPUs
+(/root/reference/infinistore/test_infinistore.py:20-87, SURVEY.md §4).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+import infinistore_tpu as its  # noqa: E402
+
+
+@pytest.fixture()
+def server():
+    """An in-process store server on an ephemeral loopback port with a small
+    unpinned pool (64MB, 16KB blocks)."""
+    cfg = its.ServerConfig(
+        host="127.0.0.1",
+        service_port=0,
+        manage_port=1,  # unused placeholder; verify() needs it distinct
+        prealloc_size=1,
+        minimal_allocate_size=16,
+        pin_memory=False,
+        log_level="error",
+    )
+    # Shrink below the dataclass's GB units for tests: build directly.
+    from infinistore_tpu._native import lib
+
+    handle = lib.its_server_create(
+        b"127.0.0.1", 0, 64 << 20, 16 << 10, 0, 64 << 20, 0, 0.8, 0.95
+    )
+    assert handle
+    assert lib.its_server_start(handle) == 0
+    port = lib.its_server_port(handle)
+    yield {"handle": handle, "port": port, "lib": lib, "config": cfg}
+    lib.its_server_stop(handle)
+    lib.its_server_destroy(handle)
+
+
+@pytest.fixture()
+def conn(server):
+    cfg = its.ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=server["port"],
+        connection_type=its.TYPE_RDMA,
+        log_level="error",
+    )
+    c = its.InfinityConnection(cfg)
+    c.connect()
+    yield c
+    c.close()

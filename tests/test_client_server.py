@@ -1,0 +1,235 @@
+"""End-to-end loopback integration: InfinityConnection against the native
+server. Mirrors the reference's behavioral coverage
+(/root/reference/infinistore/test_infinistore.py) without needing RDMA NICs or
+GPUs: roundtrips per dtype, batched async write/read, check_exist,
+get_match_last_index, typed KeyNotFound, delete_keys, TCP put/get, overwrite,
+concurrent clients."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+
+
+def _staging(nbytes: int) -> np.ndarray:
+    return np.zeros(nbytes, dtype=np.uint8)
+
+
+# ---- single-key TCP path (reference test_basic_read_write_cache etc.) ------
+
+
+def test_tcp_roundtrip(conn):
+    data = np.random.randint(0, 256, size=256 << 10, dtype=np.uint8)
+    conn.tcp_write_cache("tcp-key", data.ctypes.data, data.nbytes)
+    out = conn.tcp_read_cache("tcp-key")
+    assert np.array_equal(out, data)
+
+
+def test_tcp_overwrite(conn):
+    a = np.full(4096, 1, dtype=np.uint8)
+    b = np.full(8192, 2, dtype=np.uint8)
+    conn.tcp_write_cache("ow", a.ctypes.data, a.nbytes)
+    conn.tcp_write_cache("ow", b.ctypes.data, b.nbytes)
+    out = conn.tcp_read_cache("ow")
+    assert out.nbytes == 8192
+    assert np.array_equal(out, b)
+
+
+def test_tcp_read_missing_raises(conn):
+    with pytest.raises(its.InfiniStoreKeyNotFound):
+        conn.tcp_read_cache("never-written")
+
+
+# ---- batched async data plane (reference test_batch_read_write_cache) ------
+
+
+@pytest.mark.parametrize("dtype", [np.float16, np.float32])
+def test_batch_roundtrip_dtypes(conn, dtype):
+    block_elems = 4096
+    nblocks = 10
+    src = np.random.randn(nblocks, block_elems).astype(dtype)
+    block_size = src.itemsize * block_elems
+    conn.register_mr(src)
+
+    blocks = [(f"dt-{dtype.__name__}-{i}", i * block_size) for i in range(nblocks)]
+
+    async def run():
+        await conn.rdma_write_cache_async(blocks, block_size, src.ctypes.data)
+        dst = np.zeros_like(src)
+        conn.register_mr(dst)
+        await conn.rdma_read_cache_async(blocks, block_size, dst.ctypes.data)
+        return dst
+
+    dst = asyncio.run(run())
+    assert np.array_equal(src, dst)
+
+
+def test_batch_requires_registered_mr(conn):
+    src = _staging(4096)
+
+    async def run():
+        await conn.rdma_write_cache_async([("k", 0)], 4096, src.ctypes.data)
+
+    with pytest.raises(its.InfiniStoreException):
+        asyncio.run(run())
+
+
+def test_batch_read_missing_raises_typed(conn):
+    buf = _staging(4096)
+    conn.register_mr(buf)
+
+    async def run():
+        await conn.rdma_read_cache_async([("missing-key", 0)], 4096, buf.ctypes.data)
+
+    with pytest.raises(its.InfiniStoreKeyNotFound):
+        asyncio.run(run())
+
+
+def test_many_inflight_gather(conn):
+    """1000-key asyncio.gather batch (reference example/client_async.py)."""
+    n = 1000
+    block = 1024
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+
+    async def run():
+        writes = [
+            conn.rdma_write_cache_async([(f"g{i}", i * block)], block, src.ctypes.data)
+            for i in range(n)
+        ]
+        await asyncio.gather(*writes)
+        reads = [
+            conn.rdma_read_cache_async([(f"g{i}", i * block)], block, dst.ctypes.data)
+            for i in range(n)
+        ]
+        await asyncio.gather(*reads)
+
+    asyncio.run(run())
+    assert np.array_equal(src, dst)
+
+
+# ---- control ops -----------------------------------------------------------
+
+
+def test_check_exist(conn):
+    data = _staging(1024)
+    conn.tcp_write_cache("exists", data.ctypes.data, data.nbytes)
+    assert conn.check_exist("exists") is True
+    assert conn.check_exist("nope") is False
+
+
+def test_get_match_last_index(conn):
+    buf = np.ones(4 * 4096, dtype=np.uint8)
+    conn.register_mr(buf)
+
+    async def run():
+        blocks = [(f"chain-{i}", i * 4096) for i in range(4)]
+        await conn.rdma_write_cache_async(blocks, 4096, buf.ctypes.data)
+
+    asyncio.run(run())
+    keys = [f"chain-{i}" for i in range(8)]  # only first 4 present
+    assert conn.get_match_last_index(keys) == 3
+
+
+def test_get_match_no_match_raises(conn):
+    with pytest.raises(its.InfiniStoreException):
+        conn.get_match_last_index(["m1", "m2"])
+
+
+def test_delete_keys(conn):
+    data = _staging(1024)
+    for i in range(3):
+        conn.tcp_write_cache(f"del-{i}", data.ctypes.data, data.nbytes)
+    assert conn.delete_keys(["del-0", "del-1", "not-there"]) == 2
+    assert conn.check_exist("del-0") is False
+    assert conn.check_exist("del-2") is True
+
+
+def test_stats(conn):
+    data = _staging(1024)
+    conn.tcp_write_cache("stat-key", data.ctypes.data, data.nbytes)
+    stats = conn.get_stats()
+    assert stats["kvmap_len"] >= 1
+    assert "P" in stats["ops"]
+    assert stats["ops"]["P"]["count"] >= 1
+
+
+# ---- server control API ----------------------------------------------------
+
+
+def test_server_purge_and_len(server, conn):
+    lib, handle = server["lib"], server["handle"]
+    data = _staging(1024)
+    for i in range(5):
+        conn.tcp_write_cache(f"p-{i}", data.ctypes.data, data.nbytes)
+    assert lib.its_server_kvmap_len(handle) == 5
+    assert lib.its_server_purge(handle) == 5
+    assert lib.its_server_kvmap_len(handle) == 0
+
+
+def test_oom_returns_507_and_connection_survives(server, conn):
+    """A write bigger than the whole pool must fail with 507 (eviction cannot
+    help) but the connection stays usable because the server drains the
+    streamed payload before answering."""
+    big = _staging(96 << 20)  # > 64MB pool
+    conn.register_mr(big)
+
+    async def run():
+        with pytest.raises(its.InfiniStoreException):
+            await conn.rdma_write_cache_async([("big-0", 0)], 96 << 20, big.ctypes.data)
+
+    asyncio.run(run())
+    # Connection still works.
+    small = _staging(1024)
+    conn.tcp_write_cache("after-oom", small.ctypes.data, small.nbytes)
+    assert conn.check_exist("after-oom") is True
+
+
+def test_eviction_makes_room(server, conn):
+    """On-demand LRU eviction: overfilling with small blocks evicts the oldest
+    (reference evict_cache, infinistore.cpp:223)."""
+    lib, handle = server["lib"], server["handle"]
+    chunk = _staging(1 << 20)
+    # 64MB pool; write 80 x 1MB so eviction must kick in (threshold 0.95).
+    for i in range(80):
+        conn.tcp_write_cache(f"ev-{i}", chunk.ctypes.data, chunk.nbytes)
+    assert lib.its_server_usage(handle) <= 0.96
+    # Oldest keys evicted, newest present.
+    assert conn.check_exist("ev-79") is True
+    assert conn.check_exist("ev-0") is False
+
+
+def test_concurrent_clients(server):
+    """Two client connections interleaving (reference runs two processes,
+    test_infinistore.py:217-268; threads exercise the same server paths)."""
+    import threading
+
+    errors = []
+
+    def worker(tag):
+        try:
+            cfg = its.ClientConfig(
+                host_addr="127.0.0.1", service_port=server["port"], log_level="error"
+            )
+            c = its.InfinityConnection(cfg)
+            c.connect()
+            data = np.full(4096, ord(tag[0]) % 256, dtype=np.uint8)
+            for i in range(50):
+                c.tcp_write_cache(f"{tag}-{i}", data.ctypes.data, data.nbytes)
+            for i in range(50):
+                out = c.tcp_read_cache(f"{tag}-{i}")
+                assert np.array_equal(out, data)
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in ("alpha", "beta")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors

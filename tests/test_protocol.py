@@ -1,0 +1,106 @@
+"""Wire protocol unit tests: Python encoders round-trip, and the server
+understands hand-built frames (so the Python mirror and the C++ codec agree).
+The reference has no protocol tests (SURVEY.md §4)."""
+
+import socket
+import struct
+
+import pytest
+
+from infinistore_tpu import wire
+
+
+def test_req_header_roundtrip():
+    hdr = wire.pack_req_header(wire.OP_PUT_BATCH, 1234)
+    assert len(hdr) == 9
+    op, body_size = wire.unpack_req_header(hdr)
+    assert op == wire.OP_PUT_BATCH
+    assert body_size == 1234
+
+
+def test_req_header_bad_magic():
+    bad = b"\x00" * 9
+    with pytest.raises(ValueError):
+        wire.unpack_req_header(bad)
+
+
+def test_resp_header_roundtrip():
+    hdr = wire.pack_resp_header(wire.STATUS_OK, 8, 1 << 40)
+    assert len(hdr) == 16
+    assert wire.unpack_resp_header(hdr) == (wire.STATUS_OK, 8, 1 << 40)
+
+
+@pytest.mark.parametrize(
+    "meta",
+    [
+        wire.BatchMeta(block_size=4096, keys=["a", "b" * 100, "unicode-ключ"]),
+        wire.BatchMeta(block_size=1, keys=[]),
+    ],
+)
+def test_batch_meta_roundtrip(meta):
+    out = wire.BatchMeta.decode(meta.encode())
+    assert out.block_size == meta.block_size
+    assert out.keys == meta.keys
+
+
+def test_tcp_put_meta_roundtrip():
+    m = wire.TcpPutMeta(key="k1", value_length=7 << 30)
+    out = wire.TcpPutMeta.decode(m.encode())
+    assert (out.key, out.value_length) == ("k1", 7 << 30)
+
+
+def test_key_list_roundtrip():
+    m = wire.KeyListMeta(keys=[f"key-{i}" for i in range(1000)])
+    assert wire.KeyListMeta.decode(m.encode()).keys == m.keys
+
+
+def test_truncated_body_raises():
+    body = wire.BatchMeta(block_size=64, keys=["abc"]).encode()
+    with pytest.raises(ValueError):
+        wire.BatchMeta.decode(body[:-1])
+
+
+def test_server_speaks_python_wire(server):
+    """Drive the C++ server with frames built by the Python mirror: proves the
+    two codecs agree on the wire format, not just with themselves."""
+    with socket.create_connection(("127.0.0.1", server["port"]), timeout=5) as s:
+        # Single-key put via raw frames.
+        payload = b"\xab" * 1000
+        body = wire.TcpPutMeta(key="wire-key", value_length=len(payload)).encode()
+        s.sendall(wire.pack_req_header(wire.OP_TCP_PUT, len(body)) + body + payload)
+        resp = _recv_exact(s, 16)
+        status, body_size, payload_size = wire.unpack_resp_header(resp)
+        assert (status, body_size, payload_size) == (wire.STATUS_OK, 0, 0)
+
+        # Existence probe.
+        body = wire.KeyMeta(key="wire-key").encode()
+        s.sendall(wire.pack_req_header(wire.OP_CHECK_EXIST, len(body)) + body)
+        status, body_size, payload_size = wire.unpack_resp_header(_recv_exact(s, 16))
+        assert status == wire.STATUS_OK
+        assert _recv_exact(s, body_size) == b"\x01"
+
+        # Get the value back.
+        body = wire.KeyMeta(key="wire-key").encode()
+        s.sendall(wire.pack_req_header(wire.OP_TCP_GET, len(body)) + body)
+        status, body_size, payload_size = wire.unpack_resp_header(_recv_exact(s, 16))
+        assert status == wire.STATUS_OK
+        assert payload_size == len(payload)
+        assert _recv_exact(s, payload_size) == payload
+
+
+def test_server_closes_on_bad_magic(server):
+    with socket.create_connection(("127.0.0.1", server["port"]), timeout=5) as s:
+        s.sendall(struct.pack("<IBI", 0xDEADBEEF, 0, 0))
+        # Server must close the connection (reference behavior,
+        # /root/reference/src/infinistore.cpp:910-915).
+        assert s.recv(1) == b""
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("server closed early")
+        buf += chunk
+    return buf
