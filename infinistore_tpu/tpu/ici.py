@@ -1,0 +1,101 @@
+"""ICI fast path: intra-pod KV-block transfer between devices of one SPMD mesh.
+
+The reference has exactly one transport — client socket to server socket over
+the NIC. On TPU pods there is a second, much faster interconnect: ICI. When
+the producer (prefill) and consumer (decode) of a KV block live on devices of
+the same jitted mesh program — e.g. interleaved prefill/decode in one engine,
+or a disaggregated engine pair launched as one SPMD job — blocks can move
+HBM->HBM over ICI with XLA collectives, skipping host staging and DCN
+entirely. The store API degrades gracefully: callers use this path when a
+mesh is shared, and fall back to the DCN client (lib.InfinityConnection)
+when it is not (SURVEY.md §7 hard part 4).
+
+Implementation: shard_map over the transfer axis + lax.ppermute — the
+canonical JAX way to express point-to-point device moves; XLA lowers it to
+direct ICI sends with no host involvement.
+"""
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ppermute_fn(axis_name: str, perm: Tuple[Tuple[int, int], ...]):
+    def fn(x):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    return fn
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis_name", "perm")
+)
+def _permute_sharded(blocks, *, mesh, axis_name, perm):
+    spec = P(axis_name)
+    return shard_map(
+        _ppermute_fn(axis_name, perm),
+        mesh=mesh,
+        in_specs=spec,
+        out_specs=spec,
+    )(blocks)
+
+
+class IciBlockTransfer:
+    """Point-to-point KV-block moves across one mesh axis.
+
+    `perm` is a list of (src_index, dst_index) pairs along `axis_name` —
+    typically [(prefill_idx, decode_idx)] for a disaggregated pair. Data on
+    devices not named as a destination comes back zeroed (ppermute
+    semantics), so callers scatter only the destination shard's blocks."""
+
+    def __init__(self, mesh: Mesh, axis_name: str, perm: Sequence[Tuple[int, int]]):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.perm = tuple((int(s), int(d)) for s, d in perm)
+        self.sharding = NamedSharding(mesh, P(axis_name))
+
+    def transfer(self, blocks_by_device: jax.Array) -> jax.Array:
+        """blocks_by_device: [axis_size, n_blocks, *block_shape] sharded (or
+        shardable) over axis 0. Returns the same shape with row dst holding
+        what row src sent."""
+        blocks = jax.device_put(blocks_by_device, self.sharding)
+        return _permute_sharded(
+            blocks, mesh=self.mesh, axis_name=self.axis_name, perm=self.perm
+        )
+
+    def send_blocks(
+        self, cache: jax.Array, block_ids, src: int, dst: int
+    ) -> jax.Array:
+        """Convenience: gather `block_ids` from the per-device paged `cache`
+        ([axis_size, num_blocks, ...], sharded over axis 0) on shard `src` and
+        deliver them to shard `dst`. Returns [n, *block_shape] living on the
+        dst device's shard row."""
+        ids = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
+        mesh, axis = self.mesh, self.axis_name
+        perm = ((int(src), int(dst)),)
+
+        def step(local_cache, local_ids):
+            # Every shard gathers its own ids (SPMD; ids are replicated via
+            # P()), only src's payload survives the permute.
+            blocks = jax.numpy.take(local_cache[0], local_ids, axis=0)
+            out = jax.lax.ppermute(blocks[None], axis, perm)
+            return out
+
+        fn = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(axis),
+        )
+        out = jax.jit(fn)(jax.device_put(cache, self.sharding), ids)
+        return out
+
+
+def mesh_from_devices(devices: List = None, axis_name: str = "store") -> Mesh:
+    """A 1-D mesh over all local devices (helper for tests/examples)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (axis_name,))

@@ -1,0 +1,184 @@
+"""Layer-wise streaming of paged KV blocks between TPU HBM and the store.
+
+This is the TPU realization of the reference's core latency trick: stream the
+KV cache layer by layer so network transfer overlaps per-layer compute, which
+is how it keeps prefill network overhead "no more than 1%"
+(/root/reference/docs/source/design.rst:54-63; the benchmark models it as
+--steps "layers", benchmark.py:188-193). Here the overlap is two-level:
+device->host copies (async, overlap with TPU compute) and DCN puts (async,
+overlap with the next layer's D2H) are pipelined through a double-buffered
+staging region.
+
+Key naming follows the reference's convention of hash-chain keys per block
+(design.rst:50): one key per (request-chain hash, layer, k/v, block index), so
+`get_match_last_index` gives longest-prefix reuse across requests.
+"""
+
+import asyncio
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
+from .staging import HostStagingPool
+
+KeyFn = Callable[[int, str, int], str]  # (layer, "k"|"v", block_index) -> key
+
+
+def kv_block_key(model: str, chain_hash: str, layer: int, kind: str, block: int) -> str:
+    """Default key scheme: model/chain-hash/layer/k|v/block."""
+    return f"{model}/{chain_hash}/L{layer}/{kind}{block}"
+
+
+class _LayerRegions:
+    """Double-buffered staging layout: region r holds this layer's K blocks
+    then V blocks, each block in its own slot."""
+
+    def __init__(self, pool: HostStagingPool, spec: PagedKVCacheSpec, max_blocks: int):
+        if spec.block_nbytes > pool.block_size:
+            raise ValueError(
+                f"staging pool block_size {pool.block_size} < KV block "
+                f"{spec.block_nbytes}"
+            )
+        self.pool = pool
+        self.spec = spec
+        self.max_blocks = max_blocks
+        # 2 regions x (K + V) x max_blocks slots.
+        if pool.num_slots < 4 * max_blocks:
+            raise ValueError(
+                f"staging pool too small: need {4 * max_blocks} slots of "
+                f"{pool.block_size}B, have {pool.num_slots}"
+            )
+
+    def slots(self, region: int, kind: str, n: int) -> List[int]:
+        base = region * 2 * self.max_blocks + (0 if kind == "k" else self.max_blocks)
+        return list(range(base, base + n))
+
+    def offsets(self, region: int, kind: str, n: int) -> List[int]:
+        return [self.pool.slot_offset(s) for s in self.slots(region, kind, n)]
+
+
+class LayerwiseKVWriter:
+    """Stream a request's KV blocks to the store, one layer at a time.
+
+    Pipeline per layer: Pallas-gather blocks from the paged cache (device),
+    start the async D2H into staging region r, and while it lands, the
+    previous layer's staged region (1-r) is in flight on the DCN socket."""
+
+    def __init__(self, conn, pool: HostStagingPool, spec: PagedKVCacheSpec,
+                 max_blocks: int):
+        self.conn = conn
+        self.spec = spec
+        self.regions = _LayerRegions(pool, spec, max_blocks)
+
+    async def write(
+        self,
+        caches: Sequence[Tuple[jax.Array, jax.Array]],
+        block_ids: np.ndarray,
+        key_fn: KeyFn,
+    ) -> int:
+        """Returns total blocks written (K+V across layers)."""
+        n = len(block_ids)
+        if n == 0:
+            return 0
+        if n > self.regions.max_blocks:
+            raise ValueError(f"{n} blocks > writer capacity {self.regions.max_blocks}")
+        ids_dev = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
+        pool = self.regions.pool
+        bn = self.spec.block_nbytes
+        pending = None  # (blocks list of (key, offset)) awaiting network put
+        total = 0
+        for layer, (k_cache, v_cache) in enumerate(caches):
+            region = layer % 2
+            # Device-side gather + async D2H into this region.
+            k_blocks = gather_blocks(k_cache, ids_dev)
+            v_blocks = gather_blocks(v_cache, ids_dev)
+            k_off = self.regions.offsets(region, "k", 1)[0]
+            v_off = self.regions.offsets(region, "v", 1)[0]
+            transfer = pool.stage_out(
+                [k_blocks, v_blocks],
+                [self.regions.slots(region, "k", 1)[0], self.regions.slots(region, "v", 1)[0]],
+            )
+            # Previous layer's staged bytes ride the network while this
+            # layer's D2H completes.
+            if pending is not None:
+                await self.conn.write_cache_async(pending, bn, pool.base_ptr)
+                total += len(pending)
+            transfer.wait()
+            pending = [
+                (key_fn(layer, "k", i), k_off + i * bn) for i in range(n)
+            ] + [
+                (key_fn(layer, "v", i), v_off + i * bn) for i in range(n)
+            ]
+        if pending is not None:
+            await self.conn.write_cache_async(pending, bn, pool.base_ptr)
+            total += len(pending)
+        return total
+
+
+class LayerwiseKVReader:
+    """Fetch a request's KV blocks from the store layer by layer, scattering
+    into the paged cache; network get of layer l+1 overlaps the device upload
+    + scatter of layer l."""
+
+    def __init__(self, conn, pool: HostStagingPool, spec: PagedKVCacheSpec,
+                 max_blocks: int):
+        self.conn = conn
+        self.spec = spec
+        self.regions = _LayerRegions(pool, spec, max_blocks)
+
+    async def read(
+        self,
+        caches: Sequence[Tuple[jax.Array, jax.Array]],
+        block_ids: np.ndarray,
+        key_fn: KeyFn,
+    ) -> List[Tuple[jax.Array, jax.Array]]:
+        """Returns the updated per-layer (K, V) cache list."""
+        n = len(block_ids)
+        num_layers = len(caches)
+        if n == 0:
+            return list(caches)
+        if n > self.regions.max_blocks:
+            raise ValueError(f"{n} blocks > reader capacity {self.regions.max_blocks}")
+        ids_dev = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
+        pool = self.regions.pool
+        bn = self.spec.block_nbytes
+
+        def fetch(layer: int):
+            region = layer % 2
+            k_off = self.regions.offsets(region, "k", 1)[0]
+            v_off = self.regions.offsets(region, "v", 1)[0]
+            blocks = [
+                (key_fn(layer, "k", i), k_off + i * bn) for i in range(n)
+            ] + [
+                (key_fn(layer, "v", i), v_off + i * bn) for i in range(n)
+            ]
+            return asyncio.ensure_future(
+                self.conn.read_cache_async(blocks, bn, pool.base_ptr)
+            )
+
+        out: List[Tuple[jax.Array, jax.Array]] = list(caches)
+        inflight = fetch(0)
+        for layer in range(num_layers):
+            await inflight
+            if layer + 1 < num_layers:
+                inflight = fetch(layer + 1)  # next layer rides the network now
+            region = layer % 2
+            shape = (n, *self.spec.block_shape)
+            k_host = pool.slot_view(self.regions.slots(region, "k", 1)[0], n * bn)
+            v_host = pool.slot_view(self.regions.slots(region, "v", 1)[0], n * bn)
+            k_blocks = jax.device_put(
+                k_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
+            )
+            v_blocks = jax.device_put(
+                v_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
+            )
+            k_cache, v_cache = out[layer]
+            new_k = scatter_blocks(k_cache, ids_dev, k_blocks)
+            new_v = scatter_blocks(v_cache, ids_dev, v_blocks)
+            # The staging region is reused two layers later; make sure the H2D
+            # copies consumed it before then.
+            jax.block_until_ready((new_k, new_v))
+            out[layer] = (new_k, new_v)
+        return out

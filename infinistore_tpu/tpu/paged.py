@@ -1,0 +1,163 @@
+"""Paged KV-cache block ops: Pallas gather/scatter between the paged HBM cache
+and contiguous staging-bound buffers.
+
+The reference never touches KV layout — CUDA engines hand it raw device
+pointers and GPUDirect does the rest. On TPU the engine's KV cache is a paged
+jax.Array of shape [num_blocks, block_tokens, num_kv_heads, head_dim] (the
+layout used by TPU ragged paged attention kernels, per PAPERS.md), and
+extracting a request's blocks for offload — or re-inserting fetched blocks —
+is a gather/scatter over dynamic block ids. Those are the hot device-side ops
+of the store, so they get Pallas kernels (scalar-prefetched block ids drive
+the DMA index maps; the copy itself is a pipelined HBM->VMEM->HBM move with no
+compute) with pure-XLA fallbacks for non-TPU backends and debugging.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+@dataclass(frozen=True)
+class PagedKVCacheSpec:
+    """Shape contract for one model's paged KV cache."""
+
+    num_layers: int
+    num_blocks: int
+    block_tokens: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def block_shape(self) -> Tuple[int, int, int]:
+        return (self.block_tokens, self.num_kv_heads, self.head_dim)
+
+    @property
+    def cache_shape(self) -> Tuple[int, int, int, int]:
+        return (self.num_blocks, *self.block_shape)
+
+    @property
+    def block_nbytes(self) -> int:
+        return int(np.prod(self.block_shape)) * jnp.dtype(self.dtype).itemsize
+
+    def make_caches(self) -> List[Tuple[jax.Array, jax.Array]]:
+        """Fresh zeroed (K, V) cache pair per layer."""
+        z = jnp.zeros(self.cache_shape, dtype=self.dtype)
+        return [(z, z) for _ in range(self.num_layers)]
+
+
+# ---------------------------------------------------------------------------
+# Pure-XLA paths (work on any backend; also the semantic reference for tests).
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def gather_blocks_xla(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """out[i] = cache[block_ids[i]]."""
+    return jnp.take(cache, block_ids, axis=0)
+
+
+@jax.jit
+def scatter_blocks_xla(
+    cache: jax.Array, block_ids: jax.Array, blocks: jax.Array
+) -> jax.Array:
+    """cache[block_ids[i]] = blocks[i]; returns the updated cache (donate the
+    input under jit for in-place update)."""
+    return cache.at[block_ids].set(blocks)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels. Grid = one program per block; the scalar-prefetched id array
+# feeds the BlockSpec index maps, so the pipeline DMAs cache[ids[i]] directly
+# — the kernel body is a VMEM copy, and consecutive blocks double-buffer.
+# ---------------------------------------------------------------------------
+
+
+def _copy_kernel(ids_ref, in_ref, out_ref):
+    del ids_ref
+    out_ref[...] = in_ref[...]
+
+
+def _scatter_kernel(ids_ref, blocks_ref, cache_ref, out_ref):
+    # cache_ref is the aliased full cache (stays in HBM, never DMA'd); only
+    # the ids-addressed output blocks are written.
+    del ids_ref, cache_ref
+    out_ref[...] = blocks_ref[...]
+
+
+def _block_spec_shape(spec_shape):
+    # One cache block per grid step: leading index 1, full trailing dims.
+    return (1, *spec_shape[1:])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gather_blocks_pallas(cache, block_ids, *, interpret):
+    n = block_ids.shape[0]
+    block = _block_spec_shape(cache.shape)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(block, lambda i, ids: (ids[i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(block, lambda i, ids: (i, 0, 0, 0)),
+    )
+    return pl.pallas_call(
+        _copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, *cache.shape[1:]), cache.dtype),
+        interpret=interpret,
+    )(block_ids, cache)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",), donate_argnums=(0,))
+def _scatter_blocks_pallas(cache, block_ids, blocks, *, interpret):
+    n = block_ids.shape[0]
+    block = _block_spec_shape(cache.shape)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec(block, lambda i, ids: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # aliased cache, not DMA'd
+        ],
+        out_specs=pl.BlockSpec(block, lambda i, ids: (ids[i], 0, 0, 0)),
+    )
+    # Aliasing cache -> output makes this an in-place update: grid steps only
+    # write the targeted blocks, everything else keeps its bytes. The alias
+    # index counts the scalar-prefetch operand (ids=0, blocks=1, cache=2).
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(cache.shape, cache.dtype),
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(block_ids, blocks, cache)
+
+
+def _use_pallas() -> bool:
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def gather_blocks(cache: jax.Array, block_ids: jax.Array) -> jax.Array:
+    """Gather cache blocks by dynamic id. Pallas on TPU, XLA elsewhere."""
+    if _use_pallas():
+        return _gather_blocks_pallas(cache, block_ids, interpret=False)
+    return gather_blocks_xla(cache, block_ids)
+
+
+def scatter_blocks(cache: jax.Array, block_ids: jax.Array, blocks: jax.Array) -> jax.Array:
+    """Scatter blocks into the cache by dynamic id (in-place when donated)."""
+    if _use_pallas():
+        return _scatter_blocks_pallas(cache, block_ids, blocks, interpret=False)
+    return scatter_blocks_xla(cache, block_ids, blocks)

@@ -1,0 +1,123 @@
+"""HBM <-> pinned-host staging.
+
+The TPU replacement for the reference's GPUDirect path: where the reference
+registers CUDA tensor memory with the NIC and lets the server RDMA straight
+into HBM (/root/reference/src/libinfinistore.cpp:728 register_mr on
+data_ptr), TPU VMs require an explicit device<->host hop. This module owns
+that hop: one pinned, MR-registered host pool per connection, asynchronous
+device->host copies (jax.Array.copy_to_host_async, so transfer overlaps
+compute exactly like the reference's per-layer streaming), and slot-based
+block placement so the network layer does zero-copy scatter/gather out of the
+same buffer the device copies land in.
+"""
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+class StagedTransfer:
+    """Handle for an in-flight device->host copy into staging slots."""
+
+    def __init__(self, arrays: Sequence[jax.Array], views: Sequence[np.ndarray]):
+        self._arrays = list(arrays)
+        self._views = list(views)
+        # Kick off all D2H copies without blocking; jax overlaps them with
+        # ongoing device computation.
+        for arr in self._arrays:
+            arr.copy_to_host_async()
+        self._done = False
+
+    def wait(self) -> List[np.ndarray]:
+        """Block until device data is host-visible and placed in the pinned
+        slots; returns the staged views."""
+        if not self._done:
+            for arr, view in zip(self._arrays, self._views):
+                # np.asarray reuses the buffer copy_to_host_async produced
+                # (no second D2H); the copyto lands it in pinned memory that
+                # the NIC-facing reactor reads with zero further copies.
+                host = np.asarray(arr)
+                np.copyto(view.view(host.dtype).reshape(host.shape), host)
+            self._done = True
+        return self._views
+
+
+class HostStagingPool:
+    """A pinned, connection-registered host buffer carved into uniform block
+    slots (the client-side mirror of the server's mempool; reference clients
+    allocate their own torch tensors instead and register each one,
+    /root/reference/infinistore/benchmark.py:144-173)."""
+
+    def __init__(self, nbytes: int, block_size: int, conn=None, align: int = 4096):
+        if block_size <= 0 or nbytes < block_size:
+            raise ValueError("need nbytes >= block_size > 0")
+        self.block_size = block_size
+        self.num_slots = nbytes // block_size
+        # Over-allocate to align the base: DCN readv/writev and mlock both
+        # like page-aligned bases.
+        raw = np.zeros(nbytes + align, dtype=np.uint8)
+        base_off = (-raw.ctypes.data) % align
+        self._raw = raw  # keep alive
+        self.buf = raw[base_off : base_off + nbytes]
+        self.conn = conn
+        if conn is not None:
+            conn.register_mr(self.buf.ctypes.data, nbytes)
+
+    @property
+    def base_ptr(self) -> int:
+        return self.buf.ctypes.data
+
+    def slot_offset(self, slot: int) -> int:
+        if not (0 <= slot < self.num_slots):
+            raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
+        return slot * self.block_size
+
+    def slot_view(self, slot: int, nbytes: Optional[int] = None) -> np.ndarray:
+        off = self.slot_offset(slot)
+        return self.buf[off : off + (nbytes or self.block_size)]
+
+    def slots_for(self, arr_nbytes: int) -> int:
+        """How many slots one array of arr_nbytes occupies."""
+        return math.ceil(arr_nbytes / self.block_size)
+
+    # -- device -> staging ---------------------------------------------------
+
+    def stage_out(
+        self, arrays: Sequence[jax.Array], slots: Sequence[int]
+    ) -> StagedTransfer:
+        """Start async D2H copies of `arrays` into consecutive slots starting
+        at slots[i]. Returns a handle; call .wait() before shipping."""
+        views = []
+        for arr, slot in zip(arrays, slots):
+            nbytes = arr.size * arr.dtype.itemsize
+            needed = self.slots_for(nbytes)
+            if slot + needed > self.num_slots:
+                raise IndexError("array does not fit in staging pool")
+            views.append(self.slot_view(slot, nbytes))
+        return StagedTransfer(arrays, views)
+
+    # -- staging -> device ---------------------------------------------------
+
+    def stage_in(
+        self,
+        slots: Sequence[int],
+        shape: Tuple[int, ...],
+        dtype,
+        device=None,
+        sharding=None,
+    ) -> List[jax.Array]:
+        """Upload staged blocks back to device memory. One jax.Array per slot
+        run; `device`/`sharding` select placement (defaults to the default
+        device)."""
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        out = []
+        target = sharding if sharding is not None else device
+        for slot in slots:
+            host = self.slot_view(slot, nbytes).view(dtype).reshape(shape)
+            if target is not None:
+                out.append(jax.device_put(host, target))
+            else:
+                out.append(jax.device_put(host))
+        return out

@@ -9,12 +9,24 @@ whose entire test suite is gated on real RDMA NICs + CUDA GPUs
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the CPU backend with 8 virtual devices. The environment pins
+# JAX_PLATFORMS=axon (remote TPU tunnel) and its sitecustomize registers the
+# plugin whenever PALLAS_AXON_POOL_IPS is set, so both must be overridden
+# before jax is first imported.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The axon plugin's register() overrides the platform list via
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start, which
+# beats the env var — override it back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
