@@ -1,0 +1,28 @@
+"""Demo model family: a small Llama-style transformer with a paged KV cache.
+
+The reference ships no model code — it serves engines like vLLM through
+LMCache (/root/reference/README.md:22). This package plays that engine's role
+for the TPU build: a real (if small) paged-KV transformer whose prefill/decode
+steps produce and consume the exact block layout the store moves, so the
+prefill->decode disaggregation flow (BASELINE.md config 5) can run end-to-end
+in tests and benchmarks, and the driver's graft entry has a jittable flagship
+step to compile.
+"""
+
+from .llama import (
+    LlamaConfig,
+    decode_step,
+    init_params,
+    loss_fn,
+    prefill,
+    train_step,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "prefill",
+    "decode_step",
+    "loss_fn",
+    "train_step",
+]

@@ -1,0 +1,260 @@
+"""Small Llama-style transformer with a paged KV cache, in plain JAX.
+
+TPU-idiomatic by construction: einsum everywhere (MXU), bfloat16 activations,
+static shapes, GQA attention, RoPE, RMSNorm, SwiGLU. The KV cache uses the
+paged layout of infinistore_tpu.tpu.paged ([num_blocks, block_tokens,
+n_kv_heads, head_dim] per layer), so prefill output can be streamed to the
+store with LayerwiseKVWriter and decode can resume from fetched blocks — the
+role vLLM plays for the reference store.
+
+Sharding conventions (used by __graft_entry__.dryrun_multichip and the
+train_step): logical axes are ("dp", "tp") — batch over dp, attention heads /
+ffn hidden over tp, with sequence-sharded activations where XLA chooses.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tpu.paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
+
+Params = Dict[str, jax.Array]
+Caches = List[Tuple[jax.Array, jax.Array]]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 512
+    dim: int = 128
+    n_layers: int = 2
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    ffn_dim: int = 256
+    block_tokens: int = 8
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def kv_spec(self, num_blocks: int) -> PagedKVCacheSpec:
+        return PagedKVCacheSpec(
+            num_layers=self.n_layers,
+            num_blocks=num_blocks,
+            block_tokens=self.block_tokens,
+            num_kv_heads=self.n_kv_heads,
+            head_dim=self.head_dim,
+            dtype=self.dtype,
+        )
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """He-scaled dense params as a flat dict (layer-prefixed keys)."""
+    keys = iter(jax.random.split(key, 4 + 7 * config.n_layers))
+
+    def dense(k, shape):
+        scale = 1.0 / np.sqrt(shape[0])
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(
+            config.dtype
+        )
+
+    p: Params = {
+        "embed": dense(next(keys), (config.vocab, config.dim)),
+        "final_norm": jnp.ones((config.dim,), dtype=config.dtype),
+        "lm_head": dense(next(keys), (config.dim, config.vocab)),
+    }
+    hd = config.head_dim
+    for layer in range(config.n_layers):
+        pre = f"l{layer}."
+        p[pre + "attn_norm"] = jnp.ones((config.dim,), dtype=config.dtype)
+        p[pre + "wq"] = dense(next(keys), (config.dim, config.n_heads, hd))
+        p[pre + "wk"] = dense(next(keys), (config.dim, config.n_kv_heads, hd))
+        p[pre + "wv"] = dense(next(keys), (config.dim, config.n_kv_heads, hd))
+        p[pre + "wo"] = dense(next(keys), (config.n_heads, hd, config.dim))
+        p[pre + "ffn_norm"] = jnp.ones((config.dim,), dtype=config.dtype)
+        p[pre + "w_gate_up"] = dense(next(keys), (config.dim, 2, config.ffn_dim))
+        p[pre + "w_down"] = dense(next(keys), (config.ffn_dim, config.dim))
+    return p
+
+
+def _rms_norm(x: jax.Array, w: jax.Array) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * w
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """x: [..., seq, heads, head_dim], positions: [..., seq]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, T, KVH, D]
+    v: jax.Array,  # [B, T, KVH, D]
+    mask: jax.Array,  # [B, S, T] True = attend
+) -> jax.Array:
+    groups = q.shape[2] // k.shape[2]
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask[:, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
+    """Shared transformer block math given already-materialized K/V context.
+
+    x: [B, S, dim]; k/v: [B, T, KVH, D] (full attention context); returns the
+    block output and this segment's (k_new, v_new) before cache insertion."""
+    pre = f"l{layer}."
+    h = _rms_norm(x, params[pre + "attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, params[pre + "wq"])
+    q = _rope(q, q_positions, config.rope_theta)
+    attn = _attention(q, k, v, mask)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
+    h = _rms_norm(x, params[pre + "ffn_norm"])
+    gate_up = jnp.einsum("bsd,dcf->bscf", h, params[pre + "w_gate_up"])
+    ffn = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
+    return x + jnp.einsum("bsf,fd->bsd", ffn, params[pre + "w_down"])
+
+
+def _kv_proj(params: Params, layer: int, x, positions, config):
+    pre = f"l{layer}."
+    h = _rms_norm(x, params[pre + "attn_norm"])
+    k = jnp.einsum("bsd,dhk->bshk", h, params[pre + "wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, params[pre + "wv"])
+    k = _rope(k, positions, config.rope_theta)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache inference. Batch = 1 sequence per call (engine loops/vmaps);
+# the cache is shared across sequences via the block table, exactly the
+# paged-attention model the store serves.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def prefill(
+    params: Params,
+    tokens: jax.Array,  # [S] int32, S % block_tokens == 0
+    caches: Caches,  # per layer (K, V) paged arrays
+    block_table: jax.Array,  # [S // block_tokens] int32 cache block ids
+    config: LlamaConfig,
+) -> Tuple[jax.Array, Caches]:
+    """Full prompt pass; writes K/V into the paged cache blocks listed in
+    block_table. Returns (last-token logits, updated caches)."""
+    s = tokens.shape[0]
+    bt = config.block_tokens
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, S, dim]
+    mask = (positions[:, :, None] >= positions[:, None, :])  # causal [1, S, S]
+
+    new_caches: Caches = []
+    for layer, (k_cache, v_cache) in enumerate(caches):
+        k, v = _kv_proj(params, layer, x, positions, config)
+        x = _block(params, layer, x, k, v, positions, mask, config)
+        # Scatter this prompt's K/V into its cache blocks.
+        k_blocks = k[0].reshape(s // bt, bt, config.n_kv_heads, config.head_dim)
+        v_blocks = v[0].reshape(s // bt, bt, config.n_kv_heads, config.head_dim)
+        new_caches.append(
+            (
+                scatter_blocks(k_cache, block_table, k_blocks),
+                scatter_blocks(v_cache, block_table, v_blocks),
+            )
+        )
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits[0, -1], new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
+def decode_step(
+    params: Params,
+    token: jax.Array,  # [] int32
+    position: jax.Array,  # [] int32 absolute position of `token`
+    caches: Caches,
+    block_table: jax.Array,  # [max_blocks] int32 (padded with any valid id)
+    config: LlamaConfig,
+    max_blocks: int,
+) -> Tuple[jax.Array, Caches]:
+    """One decode token against the paged cache: append this token's K/V into
+    its block slot, attend over all context blocks. Returns (logits, caches)."""
+    bt = config.block_tokens
+    pos = position[None]  # [1]
+    x = jnp.take(params["embed"], token[None], axis=0)[None]  # [1, 1, dim]
+
+    block_idx = block_table[position // bt]
+    slot = position % bt
+    ctx = max_blocks * bt
+    ctx_positions = jnp.arange(ctx, dtype=jnp.int32)
+    mask = (ctx_positions <= position)[None, None, :]  # [1, 1, T]
+
+    new_caches: Caches = []
+    for layer, (k_cache, v_cache) in enumerate(caches):
+        k, v = _kv_proj(params, layer, x, pos[None], config)
+        # Insert the new token's K/V at (block_idx, slot).
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (block_idx, slot, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (block_idx, slot, 0, 0)
+        )
+        # Gather the sequence's context blocks and attend.
+        k_ctx = gather_blocks(k_cache, block_table).reshape(
+            1, ctx, config.n_kv_heads, config.head_dim
+        )
+        v_ctx = gather_blocks(v_cache, block_table).reshape(
+            1, ctx, config.n_kv_heads, config.head_dim
+        )
+        x = _block(params, layer, x, k_ctx, v_ctx, pos[None], mask, config)
+        new_caches.append((k_cache, v_cache))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits[0, 0], new_caches
+
+
+# ---------------------------------------------------------------------------
+# Training step (dense attention, no cache) — exercised by the multichip
+# dryrun with dp/tp shardings.
+# ---------------------------------------------------------------------------
+
+
+def loss_fn(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array:
+    """Next-token cross entropy over [B, S] token batches."""
+    b, s = tokens.shape
+    positions = jnp.arange(s, dtype=jnp.int32)[None].repeat(b, axis=0)
+    x = jnp.take(params["embed"], tokens, axis=0)
+    mask = positions[:, :, None] >= positions[:, None, :]
+    for layer in range(config.n_layers):
+        k, v = _kv_proj(params, layer, x, positions, config)
+        x = _block(params, layer, x, k, v, positions, mask, config)
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(0,))
+def train_step(
+    params: Params, tokens: jax.Array, config: LlamaConfig, lr: float = 1e-3
+) -> Tuple[Params, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, config)
+    new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
+    return new_params, loss

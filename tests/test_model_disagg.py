@@ -1,0 +1,120 @@
+"""Model + disaggregation tests: the flagship E2E — prefill on one engine,
+KV blocks through the store, decode resumes on a second engine (the
+single-host shape of BASELINE.md config 5 / the reference's
+prefill->decode-disaggregation scenario, README.md:13-16)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu.models import LlamaConfig, decode_step, init_params, prefill, train_step
+from infinistore_tpu.tpu import (
+    HostStagingPool,
+    LayerwiseKVReader,
+    LayerwiseKVWriter,
+    kv_block_key,
+)
+
+CFG = LlamaConfig(
+    vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+    block_tokens=8, dtype=jnp.float32,  # float32 for exact comparisons
+)
+NUM_BLOCKS = 16
+MAX_BLOCKS = 4  # 32-token max context in these tests
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _fresh_caches():
+    return CFG.kv_spec(NUM_BLOCKS).make_caches()
+
+
+def test_prefill_shapes(params):
+    tokens = jnp.arange(16, dtype=jnp.int32) % CFG.vocab
+    table = jnp.array([3, 7], dtype=jnp.int32)
+    logits, caches = prefill(params, tokens, _fresh_caches(), table, CFG)
+    assert logits.shape == (CFG.vocab,)
+    assert len(caches) == CFG.n_layers
+    # Written blocks are non-zero, untouched blocks stay zero.
+    k0 = np.asarray(caches[0][0])
+    assert np.abs(k0[3]).sum() > 0 and np.abs(k0[7]).sum() > 0
+    assert np.abs(k0[0]).sum() == 0
+
+
+def test_decode_matches_prefill(params):
+    """Paged incremental decode must reproduce full-prefill logits."""
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, CFG.vocab)
+    table = jnp.array([0, 1, 2, 3], dtype=jnp.int32)
+
+    # Ground truth: prefill over 24 tokens.
+    full = jax.random.randint(jax.random.PRNGKey(2), (24,), 0, CFG.vocab)
+    full = full.at[:16].set(prompt)
+    ref_logits, _ = prefill(params, full, _fresh_caches(), table[:3], CFG)
+
+    # Incremental: prefill 16, then decode tokens 16..23 one at a time.
+    logits, caches = prefill(params, prompt, _fresh_caches(), table[:2], CFG)
+    for pos in range(16, 24):
+        logits, caches = decode_step(
+            params, full[pos], jnp.int32(pos), caches, table, CFG, MAX_BLOCKS
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_disagg_prefill_store_decode(conn, params):
+    """Prefill engine -> store -> fresh decode engine, logits must match the
+    non-disaggregated continuation."""
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (16,), 0, CFG.vocab)
+    next_tok = jnp.int32(42)
+    table = jnp.array([5, 9], dtype=jnp.int32)  # prefill engine's blocks
+
+    # --- prefill engine ---
+    _, prefill_caches = prefill(params, prompt, _fresh_caches(), table, CFG)
+    spec = CFG.kv_spec(NUM_BLOCKS)
+    pool = HostStagingPool(
+        nbytes=4 * 2 * spec.block_nbytes * 2, block_size=spec.block_nbytes, conn=conn
+    )
+    writer = LayerwiseKVWriter(conn, pool, spec, max_blocks=2)
+    key_fn = lambda l, k, i: kv_block_key("demo", "prompt-hash", l, k, i)
+    asyncio.run(writer.write(prefill_caches, np.asarray(table), key_fn))
+
+    # --- decode engine (different block layout!) ---
+    decode_table = jnp.array([1, 2, 14, 3], dtype=jnp.int32)
+    reader = LayerwiseKVReader(conn, pool, spec, max_blocks=2)
+    decode_caches = asyncio.run(
+        reader.read(_fresh_caches(), np.asarray(decode_table[:2]), key_fn)
+    )
+    logits_disagg, _ = decode_step(
+        params, next_tok, jnp.int32(16), decode_caches, decode_table, CFG, MAX_BLOCKS
+    )
+
+    # --- reference: continue on the prefill engine directly ---
+    ref_table = jnp.array([5, 9, 12, 13], dtype=jnp.int32)
+    logits_ref, _ = decode_step(
+        params, next_tok, jnp.int32(16), prefill_caches, ref_table, CFG, MAX_BLOCKS
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_disagg), np.asarray(logits_ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_train_step_runs(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 32), 0, CFG.vocab)
+    import copy
+
+    p = jax.tree.map(jnp.copy, params)
+    p2, loss = train_step(p, tokens, CFG)
+    assert np.isfinite(float(loss))
+    # Params actually moved.
+    moved = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2))
+    )
+    assert moved
