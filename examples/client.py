@@ -1,0 +1,38 @@
+"""Batched write/read roundtrip (reference example/client.py: sync-over-async
+RDMA write/read of block lists; the cuda/cpu source-destination combos become
+host staging buffers on TPU VMs)."""
+
+import asyncio
+
+import numpy as np
+
+from common import get_connection, parse_args
+
+
+def main():
+    args = parse_args()
+    conn, cleanup = get_connection(args)
+    try:
+        block_size = 64 << 10
+        nblocks = 16
+        src = np.random.randint(0, 256, size=nblocks * block_size, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+
+        blocks = [(f"example-key-{i}", i * block_size) for i in range(nblocks)]
+        asyncio.run(conn.write_cache_async(blocks, block_size, src.ctypes.data))
+        print(f"wrote {nblocks} x {block_size >> 10}KB blocks")
+
+        asyncio.run(conn.read_cache_async(blocks, block_size, dst.ctypes.data))
+        assert np.array_equal(src, dst)
+        print("read back and verified")
+
+        print("exists:", conn.check_exist("example-key-0"))
+        print("deleted:", conn.delete_keys([k for k, _ in blocks]))
+    finally:
+        cleanup()
+
+
+if __name__ == "__main__":
+    main()

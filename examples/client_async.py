@@ -1,0 +1,45 @@
+"""Fully-async 1000-key batch (reference example/client_async.py: 1000 keys
+written/read with asyncio.gather over the async connection)."""
+
+import asyncio
+
+import numpy as np
+
+from common import get_connection, parse_args
+
+
+async def run(conn):
+    n, block = 1000, 4 << 10
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+
+    await asyncio.gather(
+        *(
+            conn.write_cache_async([(f"async-{i}", i * block)], block, src.ctypes.data)
+            for i in range(n)
+        )
+    )
+    print(f"wrote {n} keys")
+    await asyncio.gather(
+        *(
+            conn.read_cache_async([(f"async-{i}", i * block)], block, dst.ctypes.data)
+            for i in range(n)
+        )
+    )
+    assert np.array_equal(src, dst)
+    print(f"read {n} keys, verified")
+
+
+def main():
+    args = parse_args()
+    conn, cleanup = get_connection(args)
+    try:
+        asyncio.run(run(conn))
+    finally:
+        cleanup()
+
+
+if __name__ == "__main__":
+    main()

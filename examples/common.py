@@ -1,0 +1,48 @@
+"""Shared example plumbing: connect to a running server, or spin up an
+in-process one so every example is self-contained (the reference examples
+assume `infinistore` is already running on localhost;
+/root/reference/infinistore/example/client.py)."""
+
+import argparse
+import os
+import sys
+
+# Allow running straight from a repo checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import infinistore_tpu as its
+from infinistore_tpu._native import lib
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--service-port", type=int, default=0,
+        help="port of a running server; 0 = start one in-process",
+    )
+    return p.parse_args()
+
+
+def get_connection(args):
+    handle = None
+    port = args.service_port
+    if port == 0:
+        handle = lib.its_server_create(
+            b"127.0.0.1", 0, 256 << 20, 64 << 10, 0, 0, 0, 0.8, 0.95
+        )
+        assert handle and lib.its_server_start(handle) == 0
+        port = lib.its_server_port(handle)
+        print(f"(started in-process server on :{port})")
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr=args.host, service_port=port)
+    )
+    conn.connect()
+
+    def cleanup():
+        conn.close()
+        if handle is not None:
+            lib.its_server_stop(handle)
+            lib.its_server_destroy(handle)
+
+    return conn, cleanup

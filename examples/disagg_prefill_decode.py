@@ -1,0 +1,77 @@
+"""Prefill->decode disaggregation through the store — the flagship flow
+(reference scenario 1, README.md:13-14, served there by vLLM+LMCache; here the
+demo paged-KV Llama plays the engine on both sides).
+
+Prefill 'host': runs the prompt, streams per-layer KV blocks to the store.
+Decode 'host': fetches the blocks into its own cache layout and continues
+generating, never having seen the prompt computation.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from common import get_connection, parse_args
+
+from infinistore_tpu.models import LlamaConfig, decode_step, init_params, prefill
+from infinistore_tpu.tpu import (
+    HostStagingPool,
+    LayerwiseKVReader,
+    LayerwiseKVWriter,
+    kv_block_key,
+)
+
+
+def main():
+    args = parse_args()
+    conn, cleanup = get_connection(args)
+    try:
+        cfg = LlamaConfig(
+            vocab=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, block_tokens=8, dtype=jnp.float32,
+        )
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        spec = cfg.kv_spec(num_blocks=32)
+        n_prompt_blocks = 2
+        pool = HostStagingPool(
+            nbytes=4 * n_prompt_blocks * 2 * spec.block_nbytes,
+            block_size=spec.block_nbytes,
+            conn=conn,
+        )
+        key_fn = lambda l, k, i: kv_block_key("demo-llama", "req-hash-001", l, k, i)
+
+        # --- prefill host ---
+        prompt = jnp.arange(16, dtype=jnp.int32) % cfg.vocab
+        table = jnp.array([4, 11], dtype=jnp.int32)
+        _, caches = prefill(params, prompt, spec.make_caches(), table, cfg)
+        writer = LayerwiseKVWriter(conn, pool, spec, max_blocks=n_prompt_blocks)
+        written = asyncio.run(writer.write(caches, np.asarray(table), key_fn))
+        print(f"prefill host: streamed {written} KV blocks to the store")
+
+        # --- decode host (fresh process in real deployments) ---
+        decode_table = jnp.array([0, 1, 2, 3], dtype=jnp.int32)
+        reader = LayerwiseKVReader(conn, pool, spec, max_blocks=n_prompt_blocks)
+        decode_caches = asyncio.run(
+            reader.read(spec.make_caches(), np.asarray(decode_table[:2]), key_fn)
+        )
+        print("decode host: fetched prompt KV from the store")
+
+        token, position = jnp.int32(1), 16
+        generated = []
+        for step in range(8):
+            logits, decode_caches = decode_step(
+                params, token, jnp.int32(position), decode_caches, decode_table,
+                cfg, 4,
+            )
+            token = jnp.argmax(logits).astype(jnp.int32)
+            generated.append(int(token))
+            position += 1
+        print("decode host: generated tokens", generated)
+    finally:
+        cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""Single-key TCP put/get loop (reference example/tcp_client.py: 1000 keys
+over the simple TCP path)."""
+
+import numpy as np
+
+from common import get_connection, parse_args
+
+
+def main():
+    args = parse_args()
+    conn, cleanup = get_connection(args)
+    try:
+        n = 1000
+        data = np.random.randint(0, 256, size=4096, dtype=np.uint8)
+        for i in range(n):
+            conn.tcp_write_cache(f"tcp-{i}", data.ctypes.data, data.nbytes)
+        print(f"put {n} keys")
+        for i in range(n):
+            out = conn.tcp_read_cache(f"tcp-{i}")
+            assert np.array_equal(out, data)
+        print(f"got {n} keys, verified")
+    finally:
+        cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,10 @@ void set_log_sink(LogSink sink);
 // printf-style; applies the level filter, then dispatches to the sink.
 void log_msg(LogLevel level, const char* fmt, ...) __attribute__((format(printf, 2, 3)));
 
+// Install SIGSEGV/ABRT/BUS/FPE/ILL handlers that print a backtrace before
+// dying (reference utils.cpp:216-223). Idempotent.
+void install_crash_handler();
+
 }  // namespace its
 
 #define ITS_LOG_DEBUG(fmt, ...) \

@@ -62,6 +62,8 @@ static auto guarded(F&& f, decltype(f()) err) -> decltype(f()) {
 
 extern "C" {
 
+void its_install_crash_handler() { its::install_crash_handler(); }
+
 // ---- logging ----
 void its_set_log_level(int level) { its::set_log_level(static_cast<its::LogLevel>(level)); }
 void its_set_log_sink(its::LogSink sink) { its::set_log_sink(sink); }

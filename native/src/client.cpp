@@ -55,6 +55,7 @@ Connection::Connection(const ClientConfig& config) : config_(config) {}
 Connection::~Connection() { close(); }
 
 int Connection::connect() {
+    install_crash_handler();  // reference installs in setup (:245-249)
     if (connected_.load()) return 0;
 
     addrinfo hints{};

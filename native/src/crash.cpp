@@ -1,0 +1,44 @@
+// Fatal-signal stacktrace handler (reference utils.cpp:94-101 + 216-223
+// installs boost::stacktrace printers for SIGSEGV/ABRT/BUS/FPE/ILL on both
+// server and client; we use glibc backtrace() — async-signal-unsafe in
+// theory, as is boost's, but this only runs on the way down).
+#include <execinfo.h>
+#include <signal.h>
+#include <string.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <initializer_list>
+
+namespace its {
+
+namespace {
+
+void crash_handler(int sig) {
+    void* frames[64];
+    int n = backtrace(frames, 64);
+    dprintf(STDERR_FILENO, "\n[infinistore-tpu] fatal signal %d (%s); backtrace:\n", sig,
+            strsignal(sig));
+    backtrace_symbols_fd(frames, n, STDERR_FILENO);
+    // Restore default and re-raise so the exit status reflects the signal.
+    signal(sig, SIG_DFL);
+    raise(sig);
+}
+
+}  // namespace
+
+void install_crash_handler() {
+    static bool installed = false;
+    if (installed) return;
+    installed = true;
+    for (int sig : {SIGSEGV, SIGABRT, SIGBUS, SIGFPE, SIGILL}) {
+        struct sigaction sa{};
+        sa.sa_handler = crash_handler;
+        sigemptyset(&sa.sa_mask);
+        sa.sa_flags = SA_RESETHAND;
+        sigaction(sig, &sa, nullptr);
+    }
+}
+
+}  // namespace its

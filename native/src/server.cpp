@@ -114,6 +114,7 @@ Server::Server(const ServerConfig& config) : config_(config) {
 Server::~Server() { stop(); }
 
 bool Server::start() {
+    install_crash_handler();  // reference installs on register_server (:994-998)
     listen_fd_ = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (listen_fd_ < 0) return false;
     int one = 1;

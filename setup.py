@@ -1,0 +1,47 @@
+"""Packaging: builds the native core via make (the reference shells out to
+meson+ninja the same way, /root/reference/setup.py:30-50) and ships the .so
+inside the wheel. Console entry point mirrors the reference's `infinistore`
+script (setup.py:74-78)."""
+
+import os
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class BuildNative(build_py):
+    def run(self):
+        native = os.path.join(HERE, "native")
+        if os.path.isdir(native):
+            subprocess.run(
+                ["make", "-j", str(os.cpu_count() or 2)], cwd=native, check=True
+            )
+        super().run()
+
+
+setup(
+    name="infinistore-tpu",
+    version="0.1.0",
+    description="TPU-native distributed KV-cache store for LLM inference clusters",
+    packages=[
+        "infinistore_tpu",
+        "infinistore_tpu._native",
+        "infinistore_tpu.tpu",
+        "infinistore_tpu.models",
+    ],
+    package_data={"infinistore_tpu._native": ["libinfinistore_tpu.so"]},
+    include_package_data=True,
+    python_requires=">=3.10",
+    install_requires=["numpy"],
+    extras_require={"tpu": ["jax"]},
+    cmdclass={"build_py": BuildNative},
+    entry_points={
+        "console_scripts": [
+            "infinistore-tpu = infinistore_tpu.server:main",
+            "infinistore-tpu-benchmark = infinistore_tpu.benchmark:main",
+        ]
+    },
+)
