@@ -3,7 +3,7 @@
 
 Measures BASELINE.md config 2 — async batched write+read of 1K keys x 64KB
 blocks against a loopback server (the reference's client_async.py analogue,
-which its benchmark.py measures as MB/s; /root/reference/infinistore/
+which its benchmark.py measures as MB/s; reference
 benchmark.py:258-269). Metric is aggregate data-plane throughput (bytes moved
 in both directions / wall time) in GB/s per host.
 
@@ -35,16 +35,13 @@ def main() -> int:
     import numpy as np
 
     import infinistore_tpu as its
-    from infinistore_tpu._native import lib
 
     # In-process server: 1GB pool, 64KB blocks (reference bench defaults are
-    # 64KB minimal_allocate_size), unpinned tolerated in containers.
-    handle = lib.its_server_create(
-        b"127.0.0.1", 0, 1 << 30, 64 << 10, 0, 0, 1, 0.8, 0.95
+    # 64KB minimal_allocate_size), pinned if RLIMIT_MEMLOCK allows.
+    srv = its.start_local_server(
+        prealloc_bytes=1 << 30, block_bytes=64 << 10, pin_memory=True
     )
-    assert handle, "server create failed"
-    assert lib.its_server_start(handle) == 0
-    port = lib.its_server_port(handle)
+    port = srv.port
 
     conn = its.InfinityConnection(
         its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error")
@@ -91,8 +88,7 @@ def main() -> int:
     gbps = moved / dt / (1 << 30)
 
     conn.close()
-    lib.its_server_stop(handle)
-    lib.its_server_destroy(handle)
+    srv.stop()
 
     print(
         json.dumps(

@@ -1,7 +1,7 @@
 """Shared example plumbing: connect to a running server, or spin up an
 in-process one so every example is self-contained (the reference examples
 assume `infinistore` is already running on localhost;
-/root/reference/infinistore/example/client.py)."""
+reference example/client.py)."""
 
 import argparse
 import os
@@ -11,7 +11,6 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import infinistore_tpu as its
-from infinistore_tpu._native import lib
 
 
 def parse_args():
@@ -25,14 +24,11 @@ def parse_args():
 
 
 def get_connection(args):
-    handle = None
+    srv = None
     port = args.service_port
     if port == 0:
-        handle = lib.its_server_create(
-            b"127.0.0.1", 0, 256 << 20, 64 << 10, 0, 0, 0, 0.8, 0.95
-        )
-        assert handle and lib.its_server_start(handle) == 0
-        port = lib.its_server_port(handle)
+        srv = its.start_local_server()
+        port = srv.port
         print(f"(started in-process server on :{port})")
     conn = its.InfinityConnection(
         its.ClientConfig(host_addr=args.host, service_port=port)
@@ -41,8 +37,7 @@ def get_connection(args):
 
     def cleanup():
         conn.close()
-        if handle is not None:
-            lib.its_server_stop(handle)
-            lib.its_server_destroy(handle)
+        if srv is not None:
+            srv.stop()
 
     return conn, cleanup

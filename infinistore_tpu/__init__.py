@@ -1,7 +1,7 @@
 """infinistore_tpu: a TPU-native distributed KV-cache store for LLM inference.
 
 Brand-new framework with the capabilities of InfiniStore (reference surface:
-/root/reference/infinistore/__init__.py:1-33), redesigned for TPU: the data
+reference infinistore/__init__.py:1-33), redesigned for TPU: the data
 plane is zero-copy DCN socket I/O against pinned host-DRAM pools (no ibverbs).
 """
 
@@ -26,6 +26,7 @@ from .lib import (
     get_server_stats,
     purge_kv_map,
     register_server,
+    start_local_server,
     unregister_server,
 )
 
@@ -34,6 +35,7 @@ __version__ = "0.1.0"
 __all__ = [
     "InfinityConnection",
     "register_server",
+    "start_local_server",
     "unregister_server",
     "ClientConfig",
     "ServerConfig",

@@ -1,7 +1,7 @@
 """ctypes loader for the native core (libinfinistore_tpu.so).
 
 Replaces the reference's pybind11 extension module
-(/root/reference/src/pybind.cpp) — see native/src/c_api.cpp for why ctypes.
+(reference src/pybind.cpp) — see native/src/c_api.cpp for why ctypes.
 The library is built by `make -C native` (done automatically here when the .so
 is missing or older than the sources).
 """

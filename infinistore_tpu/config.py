@@ -3,7 +3,7 @@
 Single source of truth — the reference duplicates these structs in four places
 by convention (C++ config.h:13-33, pybind.cpp, lib.py:38-152, server.py
 argparse; the maintenance rule is documented at
-/root/reference/src/config.h:7-12). Here the dataclasses below are the only
+reference src/config.h:7-12). Here the dataclasses below are the only
 definition; the native layer receives plain scalars over the C API.
 """
 

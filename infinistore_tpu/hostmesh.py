@@ -1,0 +1,44 @@
+"""Force an n-device virtual CPU backend for tests and sharding dryruns.
+
+Must run before JAX initializes any backend: XLA flags are consumed once, at
+first backend creation. Handles the axon TPU-tunnel sitecustomize, which
+force-registers its single-chip plugin whenever PALLAS_AXON_POOL_IPS is set
+and overrides JAX_PLATFORMS from the environment.
+"""
+
+import os
+
+
+def force_cpu_devices(n_devices: int = 8) -> None:
+    """Pin JAX to a CPU backend with ``n_devices`` virtual devices.
+
+    Safe to call on any host: pops the axon tunnel env var, pins the platform
+    list to cpu, and sets ``--xla_force_host_platform_device_count``. A
+    caller-provided count >= ``n_devices`` is honored (e.g. running tests on
+    a bigger virtual mesh); a smaller one can't satisfy the requirement and
+    is replaced with a warning. No-op for the flag if backends are already
+    initialized (too late to change — invoke before the first jax operation).
+    """
+    import re
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    m = re.search(r"--?xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + " " + flag).strip()
+    elif int(m.group(1)) < n_devices:
+        import warnings
+
+        warnings.warn(
+            f"XLA_FLAGS forces {m.group(1)} host devices but {n_devices} are "
+            f"required; overriding to {n_devices}"
+        )
+        flags = flags[: m.start()] + flag + flags[m.end():]
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    # The axon plugin's register() runs jax.config.update("jax_platforms",
+    # "axon,cpu") at interpreter start, which beats the env var.
+    jax.config.update("jax_platforms", "cpu")

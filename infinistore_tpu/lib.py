@@ -19,6 +19,7 @@ import json
 import os
 import socket
 import threading
+from dataclasses import dataclass
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
@@ -396,6 +397,60 @@ def register_server(loop, config: ServerConfig):
             )
         _server_handle = handle
     return _server_handle
+
+
+@dataclass
+class LocalServer:
+    """Handle to an in-process server started by ``start_local_server``."""
+
+    handle: object
+    port: int
+    _stopped: bool = False
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            lib.its_server_stop(self.handle)
+            lib.its_server_destroy(self.handle)
+
+
+def start_local_server(
+    *,
+    host: str = "127.0.0.1",
+    service_port: int = 0,
+    prealloc_bytes: int = 256 << 20,
+    block_bytes: int = 64 << 10,
+    auto_increase: bool = False,
+    extend_bytes: int = 0,
+    pin_memory: bool = False,
+    evict_min: float = 0.8,
+    evict_max: float = 0.95,
+):
+    """Start an anonymous in-process server; returns a ``LocalServer``.
+
+    Byte-granular convenience wrapper over the C API for tests, benchmarks,
+    and self-contained examples (``register_server`` is the reference-shaped
+    GB-granular entry point for the one long-lived server per process). The
+    result carries ``.port``, the raw ``.handle`` for C-API introspection,
+    and ``.stop()`` which shuts the reactor down and frees the pools.
+    """
+    handle = lib.its_server_create(
+        host.encode(),
+        service_port,
+        prealloc_bytes,
+        block_bytes,
+        1 if auto_increase else 0,
+        extend_bytes,
+        1 if pin_memory else 0,
+        evict_min,
+        evict_max,
+    )
+    if not handle:
+        raise InfiniStoreException("failed to create server (allocation failed?)")
+    if lib.its_server_start(handle) != 0:
+        lib.its_server_destroy(handle)
+        raise InfiniStoreException(f"failed to bind {host}:{service_port}")
+    return LocalServer(handle=handle, port=lib.its_server_port(handle))
 
 
 def unregister_server():

@@ -1,7 +1,7 @@
 """Demo model family: a small Llama-style transformer with a paged KV cache.
 
 The reference ships no model code — it serves engines like vLLM through
-LMCache (/root/reference/README.md:22). This package plays that engine's role
+LMCache (reference README.md:22). This package plays that engine's role
 for the TPU build: a real (if small) paged-KV transformer whose prefill/decode
 steps produce and consume the exact block layout the store moves, so the
 prefill->decode disaggregation flow (BASELINE.md config 5) can run end-to-end

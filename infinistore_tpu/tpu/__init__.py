@@ -3,12 +3,12 @@ and the intra-pod ICI fast path.
 
 This package is the genuinely new part of the TPU build (SURVEY.md §5.8): the
 reference moves KV blocks with GPUDirect RDMA straight out of CUDA tensors
-(ibv_reg_mr on torch data_ptr, /root/reference/infinistore/test_infinistore.py
+(ibv_reg_mr on torch data_ptr, reference infinistore/test_infinistore.py
 :120-122); TPU VMs expose no such path, so blocks hop HBM -> pinned host DRAM
 -> DCN socket, with the HBM hop done by JAX device transfers and Pallas
 gather/scatter kernels, overlapped layer-by-layer with compute the same way
 the reference overlaps NIC transfer with per-layer prefill
-(/root/reference/docs/source/design.rst:54-63).
+(reference docs/source/design.rst:54-63).
 """
 
 from .paged import (

@@ -3,7 +3,7 @@
 This is the TPU realization of the reference's core latency trick: stream the
 KV cache layer by layer so network transfer overlaps per-layer compute, which
 is how it keeps prefill network overhead "no more than 1%"
-(/root/reference/docs/source/design.rst:54-63; the benchmark models it as
+(reference docs/source/design.rst:54-63; the benchmark models it as
 --steps "layers", benchmark.py:188-193). Here the overlap is two-level:
 device->host copies (async, overlap with TPU compute) and DCN puts (async,
 overlap with the next layer's D2H) are pipelined through a double-buffered

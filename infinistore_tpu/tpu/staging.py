@@ -2,7 +2,7 @@
 
 The TPU replacement for the reference's GPUDirect path: where the reference
 registers CUDA tensor memory with the NIC and lets the server RDMA straight
-into HBM (/root/reference/src/libinfinistore.cpp:728 register_mr on
+into HBM (reference src/libinfinistore.cpp:728 register_mr on
 data_ptr), TPU VMs require an explicit device<->host hop. This module owns
 that hop: one pinned, MR-registered host pool per connection, asynchronous
 device->host copies (jax.Array.copy_to_host_async, so transfer overlaps
@@ -48,7 +48,7 @@ class HostStagingPool:
     """A pinned, connection-registered host buffer carved into uniform block
     slots (the client-side mirror of the server's mempool; reference clients
     allocate their own torch tensors instead and register each one,
-    /root/reference/infinistore/benchmark.py:144-173)."""
+    reference infinistore/benchmark.py:144-173)."""
 
     def __init__(self, nbytes: int, block_size: int, conn=None, align: int = 4096):
         if block_size <= 0 or nbytes < block_size:

@@ -23,7 +23,7 @@ OP_MATCH_LAST_IDX = ord("M")
 OP_DELETE_KEYS = ord("D")
 OP_STAT = ord("S")
 
-# Status codes (reference /root/reference/src/protocol.h:55-62).
+# Status codes (reference src/protocol.h:55-62).
 STATUS_OK = 200
 STATUS_TASK_ACCEPTED = 202
 STATUS_INVALID_REQ = 400
@@ -114,7 +114,7 @@ class Reader:
 @dataclass
 class BatchMeta:
     """Batched block metadata (native BatchMeta; reference RemoteMetaRequest,
-    /root/reference/src/meta_request.fbs:2-8)."""
+    reference src/meta_request.fbs:2-8)."""
 
     block_size: int = 0
     keys: List[str] = field(default_factory=list)

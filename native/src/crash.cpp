@@ -32,7 +32,17 @@ void install_crash_handler() {
     static bool installed = false;
     if (installed) return;
     installed = true;
+    // Warm up backtrace(): the first call dlopen()s libgcc_s, which mallocs —
+    // doing that lazily inside the handler can deadlock if the crash happened
+    // under malloc's arena lock.
+    void* warm[1];
+    backtrace(warm, 1);
     for (int sig : {SIGSEGV, SIGABRT, SIGBUS, SIGFPE, SIGILL}) {
+        struct sigaction old{};
+        sigaction(sig, nullptr, &old);
+        // Don't clobber handlers the embedding application (faulthandler,
+        // absl, JAX) already installed; only claim unhandled signals.
+        if (old.sa_handler != SIG_DFL || (old.sa_flags & SA_SIGINFO)) continue;
         struct sigaction sa{};
         sa.sa_handler = crash_handler;
         sigemptyset(&sa.sa_mask);

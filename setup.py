@@ -1,5 +1,5 @@
 """Packaging: builds the native core via make (the reference shells out to
-meson+ninja the same way, /root/reference/setup.py:30-50) and ships the .so
+meson+ninja the same way, reference setup.py:30-50) and ships the .so
 inside the wheel. Console entry point mirrors the reference's `infinistore`
 script (setup.py:74-78)."""
 
@@ -15,9 +15,17 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 class BuildNative(build_py):
     def run(self):
         native = os.path.join(HERE, "native")
+        so = os.path.join(
+            HERE, "infinistore_tpu", "_native", "libinfinistore_tpu.so"
+        )
         if os.path.isdir(native):
             subprocess.run(
                 ["make", "-j", str(os.cpu_count() or 2)], cwd=native, check=True
+            )
+        elif not os.path.exists(so):
+            raise RuntimeError(
+                "native/ sources missing and no prebuilt libinfinistore_tpu.so; "
+                "the sdist must include native/** (see MANIFEST.in)"
             )
         super().run()
 

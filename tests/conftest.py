@@ -4,29 +4,12 @@ Tests run on a virtual 8-device CPU mesh (multi-chip TPU hardware is not
 available in CI); the env vars must be set before jax is first imported.
 The store's TCP/DCN paths need no accelerator at all — unlike the reference,
 whose entire test suite is gated on real RDMA NICs + CUDA GPUs
-(/root/reference/infinistore/test_infinistore.py:20-87, SURVEY.md §4).
+(reference infinistore/test_infinistore.py:20-87, SURVEY.md §4).
 """
 
-import os
+from infinistore_tpu.hostmesh import force_cpu_devices
 
-# Force the CPU backend with 8 virtual devices. The environment pins
-# JAX_PLATFORMS=axon (remote TPU tunnel) and its sitecustomize registers the
-# plugin whenever PALLAS_AXON_POOL_IPS is set, so both must be overridden
-# before jax is first imported.
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-# The axon plugin's register() overrides the platform list via
-# jax.config.update("jax_platforms", "axon,cpu") at interpreter start, which
-# beats the env var — override it back before any backend initializes.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_devices(8)
 
 import pytest  # noqa: E402
 
@@ -46,18 +29,13 @@ def server():
         pin_memory=False,
         log_level="error",
     )
-    # Shrink below the dataclass's GB units for tests: build directly.
     from infinistore_tpu._native import lib
 
-    handle = lib.its_server_create(
-        b"127.0.0.1", 0, 64 << 20, 16 << 10, 0, 64 << 20, 0, 0.8, 0.95
+    srv = its.start_local_server(
+        prealloc_bytes=64 << 20, block_bytes=16 << 10, extend_bytes=64 << 20
     )
-    assert handle
-    assert lib.its_server_start(handle) == 0
-    port = lib.its_server_port(handle)
-    yield {"handle": handle, "port": port, "lib": lib, "config": cfg}
-    lib.its_server_stop(handle)
-    lib.its_server_destroy(handle)
+    yield {"handle": srv.handle, "port": srv.port, "lib": lib, "config": cfg}
+    srv.stop()
 
 
 @pytest.fixture()

@@ -1,6 +1,6 @@
 """End-to-end loopback integration: InfinityConnection against the native
 server. Mirrors the reference's behavioral coverage
-(/root/reference/infinistore/test_infinistore.py) without needing RDMA NICs or
+(reference infinistore/test_infinistore.py) without needing RDMA NICs or
 GPUs: roundtrips per dtype, batched async write/read, check_exist,
 get_match_last_index, typed KeyNotFound, delete_keys, TCP put/get, overwrite,
 concurrent clients."""

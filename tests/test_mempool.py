@@ -1,6 +1,6 @@
 """Allocator unit tests — coverage the reference lacks entirely (SURVEY.md §4:
 "no C++ unit tests at all"; the bitmap allocator under test mirrors
-/root/reference/src/mempool.cpp:55-156 behavior)."""
+reference src/mempool.cpp:55-156 behavior)."""
 
 import ctypes
 

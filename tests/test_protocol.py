@@ -92,7 +92,7 @@ def test_server_closes_on_bad_magic(server):
     with socket.create_connection(("127.0.0.1", server["port"]), timeout=5) as s:
         s.sendall(struct.pack("<IBI", 0xDEADBEEF, 0, 0))
         # Server must close the connection (reference behavior,
-        # /root/reference/src/infinistore.cpp:910-915).
+        # reference src/infinistore.cpp:910-915).
         assert s.recv(1) == b""
 
 

@@ -1,6 +1,6 @@
 """Server CLI subprocess + HTTP management plane + benchmark CLI (reference
 launches the server as a subprocess the same way,
-/root/reference/infinistore/test_infinistore.py:29-54, and exercises
+reference infinistore/test_infinistore.py:29-54, and exercises
 /purge + /kvmap_len; /selftest is new — advertised in the reference README but
 never implemented there)."""
 
