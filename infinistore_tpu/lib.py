@@ -151,7 +151,10 @@ class InfinityConnection:
     def connect(self):
         ip = _resolve_hostname(self.config.host_addr)
         handle = lib.its_conn_create(
-            ip.encode(), self.config.service_port, self.config.connect_timeout_ms
+            ip.encode(),
+            self.config.service_port,
+            self.config.connect_timeout_ms,
+            1 if self.config.enable_shm else 0,
         )
         rc = lib.its_conn_connect(handle)
         if rc != 0:
@@ -164,6 +167,11 @@ class InfinityConnection:
             self.rdma_connected = True
         else:
             self.tcp_connected = True
+
+    @property
+    def shm_active(self) -> bool:
+        """True when the same-host shm fast path is in use for batched ops."""
+        return self._handle is not None and lib.its_conn_shm_active(self._handle) == 1
 
     async def connect_async(self):
         await asyncio.to_thread(self.connect)
@@ -387,6 +395,7 @@ def register_server(loop, config: ServerConfig):
             1 if config.pin_memory else 0,
             config.on_demand_evict_min,
             config.on_demand_evict_max,
+            1 if config.enable_shm else 0,
         )
         if not handle:
             raise InfiniStoreException("failed to create server (allocation failed?)")
@@ -425,6 +434,7 @@ def start_local_server(
     pin_memory: bool = False,
     evict_min: float = 0.8,
     evict_max: float = 0.95,
+    enable_shm: bool = True,
 ):
     """Start an anonymous in-process server; returns a ``LocalServer``.
 
@@ -444,6 +454,7 @@ def start_local_server(
         1 if pin_memory else 0,
         evict_min,
         evict_max,
+        1 if enable_shm else 0,
     )
     if not handle:
         raise InfiniStoreException("failed to create server (allocation failed?)")

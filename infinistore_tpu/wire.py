@@ -22,6 +22,13 @@ OP_CHECK_EXIST = ord("E")
 OP_MATCH_LAST_IDX = ord("M")
 OP_DELETE_KEYS = ord("D")
 OP_STAT = ord("S")
+# Same-host shm fast path (native protocol.h: allocate-then-commit writes,
+# locate-then-release reads; payload never touches the socket).
+OP_SHM_HELLO = ord("H")
+OP_PUT_ALLOC = ord("p")
+OP_PUT_COMMIT = ord("c")
+OP_GET_LOC = ord("g")
+OP_RELEASE = ord("r")
 
 # Status codes (reference src/protocol.h:55-62).
 STATUS_OK = 200
@@ -141,6 +148,49 @@ class TcpPutMeta:
     def decode(cls, data: bytes) -> "TcpPutMeta":
         r = Reader(data)
         return cls(key=r.str(), value_length=r.u64())
+
+
+@dataclass
+class TicketMeta:
+    """Shm fast-path ticket (native TicketMeta: PutCommit / Release)."""
+
+    ticket: int = 0
+
+    def encode(self) -> bytes:
+        return struct.pack("<Q", self.ticket)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "TicketMeta":
+        return cls(ticket=Reader(data).u64())
+
+
+@dataclass
+class ShmLocResp:
+    """PutAlloc/GetLoc/ShmHello response body (native ShmLocResp):
+    {ticket, locations, shm pool directory}."""
+
+    ticket: int = 0
+    locs: List[Tuple[int, int, int]] = field(default_factory=list)  # (pool, off, size)
+    pools: List[Tuple[int, str, int]] = field(default_factory=list)  # (pool, name, size)
+
+    def encode(self) -> bytes:
+        out = [struct.pack("<QI", self.ticket, len(self.locs))]
+        for pool_id, off, size in self.locs:
+            out.append(struct.pack("<HQI", pool_id, off, size))
+        out.append(struct.pack("<H", len(self.pools)))
+        for pool_id, name, size in self.pools:
+            out.append(struct.pack("<H", pool_id) + encode_str(name) + struct.pack("<Q", size))
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ShmLocResp":
+        r = Reader(data)
+        m = cls(ticket=r.u64())
+        for _ in range(r.u32()):
+            m.locs.append((r.u16(), r.u64(), r.u32()))
+        for _ in range(r.u16()):
+            m.pools.append((r.u16(), r.str(), r.u64()))
+        return m
 
 
 @dataclass

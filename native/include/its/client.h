@@ -24,6 +24,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "its/iovec_util.h"
@@ -35,6 +36,11 @@ struct ClientConfig {
     std::string host = "127.0.0.1";
     int port = 22345;
     int connect_timeout_ms = 10000;
+    // Try the same-host shm fast path at connect: map the server's shm-backed
+    // pools and move batched payloads with one memcpy instead of the socket.
+    // Degrades automatically to the socket path when the server is remote or
+    // shm-less.
+    bool enable_shm = true;
 };
 
 using CompletionCb = void (*)(void* ctx, int code);
@@ -80,8 +86,15 @@ class Connection {
     // Server stats snapshot (JSON). Empty on error.
     std::string stat_json();
 
+    // True when the same-host shm fast path is active for batched ops.
+    bool shm_active() const { return shm_ok_.load(); }
+
   private:
     struct Request;
+    struct ShmMap {
+        char* base = nullptr;
+        size_t size = 0;
+    };
 
     void reactor();
     int submit(std::unique_ptr<Request> req);
@@ -92,6 +105,12 @@ class Connection {
     uint32_t sync_roundtrip(std::unique_ptr<Request> req, std::vector<uint8_t>* body_out,
                             uint8_t** payload_out, size_t* payload_size_out);
     bool base_registered(const void* base, size_t span) const;
+    void shm_handshake();
+    char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);
+    // Reactor-side: handle a PutAlloc/GetLoc response. Returns the request
+    // back if it must be re-queued (put commit phase), nullptr when done.
+    std::unique_ptr<Request> shm_phase(std::unique_ptr<Request> req, uint32_t status);
+    void queue_release(uint64_t ticket);
 
     ClientConfig config_;
     int fd_ = -1;
@@ -121,6 +140,12 @@ class Connection {
 
     mutable std::mutex mr_mu_;
     std::vector<std::pair<const char*, size_t>> regions_;
+
+    // Shm fast-path state. Written at connect (handshake) and by the reactor
+    // (on-demand mapping of auto-extended pools); guarded for the overlap.
+    std::atomic<bool> shm_ok_{false};
+    mutable std::mutex shm_mu_;
+    std::unordered_map<uint16_t, ShmMap> shm_pools_;
 };
 
 }  // namespace its

@@ -16,6 +16,7 @@
 #include <cstdint>
 #include <functional>
 #include <memory>
+#include <string>
 #include <vector>
 
 namespace its {
@@ -28,7 +29,13 @@ constexpr size_t kExtendBlockSize = 64ull << 10;
 class MemoryPool {
   public:
     // pool_size must be a multiple of block_size; block_size a power of two.
-    MemoryPool(size_t pool_size, size_t block_size, bool pin = true);
+    // When shm_name is non-empty the region is a named POSIX shm segment
+    // (shm_open + mmap MAP_SHARED) so same-host clients can map the pool and
+    // move payloads with one memcpy, no socket — the TPU-host analogue of the
+    // reference's GPUDirect zero-copy registration (ibv_reg_mr on device
+    // pointers). Empty name = anonymous private memory as before.
+    MemoryPool(size_t pool_size, size_t block_size, bool pin = true,
+               const std::string& shm_name = "");
     ~MemoryPool();
 
     MemoryPool(const MemoryPool&) = delete;
@@ -50,7 +57,10 @@ class MemoryPool {
     size_t total_blocks() const { return total_blocks_; }
     size_t used_blocks() const { return used_blocks_; }
     void* base() const { return base_; }
+    size_t size() const { return pool_size_; }
     bool pinned() const { return pinned_; }
+    // Empty when the pool is anonymous (shm backing unavailable/disabled).
+    const std::string& shm_name() const { return shm_name_; }
 
   private:
     size_t find_free_run(size_t nblocks);
@@ -62,6 +72,8 @@ class MemoryPool {
     size_t total_blocks_;
     size_t used_blocks_ = 0;
     bool pinned_ = false;
+    bool shm_backed_ = false;
+    std::string shm_name_;
     std::vector<uint64_t> bitmap_;  // 1 = used
 };
 
@@ -72,10 +84,35 @@ struct Lease {
     MemoryPool* pool = nullptr;
 };
 
+// Crash-safety for named shm segments: every live segment is tracked in a
+// small global registry so the fatal-signal handler can unlink them (tmpfs
+// pages otherwise outlive the process). SIGKILL can't be caught, so MM also
+// sweeps /dev/shm for segments of dead pids at startup.
+void shm_registry_add(const char* name);
+void shm_registry_remove(const char* name);
+void shm_registry_unlink_all();  // async-signal-safe
+void shm_sweep_stale();
+
+// One entry of the shm pool directory advertised to same-host clients.
+struct PoolDirEntry {
+    uint16_t pool_id = 0;
+    std::string shm_name;  // empty = not mappable (anonymous pool)
+    uint64_t size = 0;
+};
+
+// A (pool_id, offset) pair locating a block inside the shm directory.
+struct PoolLoc {
+    uint16_t pool_id = 0;
+    uint64_t offset = 0;
+    bool found = false;
+};
+
 // Multi-pool manager (reference MM, /root/reference/src/mempool.h:54-91).
 class MM {
   public:
-    MM(size_t initial_pool_size, size_t block_size, bool pin = true);
+    // use_shm: back pools with named shm segments (falls back to anonymous
+    // memory with a warning if /dev/shm is unavailable).
+    MM(size_t initial_pool_size, size_t block_size, bool pin = true, bool use_shm = false);
 
     // Batched n-way allocation: invokes cb(ptr, lease_index) for each of the n
     // leases as they are placed (reference MM::allocate's callback shape,
@@ -101,9 +138,19 @@ class MM {
     size_t pool_count() const { return pools_.size(); }
     bool pinned() const;
 
+    // Shm directory for the same-host fast path. Empty when use_shm is off
+    // or the backing fell back to anonymous memory.
+    std::vector<PoolDirEntry> pool_dir() const;
+    bool shm_enabled() const { return shm_prefix_ != nullptr; }
+    // Translate a pool pointer into (pool_id, offset) for the directory.
+    PoolLoc locate(const void* ptr) const;
+
   private:
+    std::string next_shm_name();
+
     size_t block_size_;
     bool pin_;
+    std::unique_ptr<std::string> shm_prefix_;  // null = shm off
     std::vector<std::unique_ptr<MemoryPool>> pools_;
 };
 

@@ -35,6 +35,17 @@ enum Op : uint8_t {
     kOpMatchLastIdx = 'M',   // longest-prefix match index (binary search)
     kOpDeleteKeys = 'D',     // delete a list of keys
     kOpStat = 'S',           // server stats snapshot (selftest support)
+    // Same-host shm fast path: payload moves by direct memcpy between client
+    // memory and the server's shm-backed pools; only metadata rides the
+    // socket. The allocate-then-write shape mirrors the reference's (unused)
+    // RdmaAllocateResponse schema (reference src/allocate_response.fbs) and
+    // its server-pull RDMA design: the server still owns placement, and keys
+    // commit only after the transfer completes.
+    kOpShmHello = 'H',       // capability probe -> shm pool directory
+    kOpPutAlloc = 'p',       // batched write phase 1: allocate, return locations
+    kOpPutCommit = 'c',      // batched write phase 2: publish keys
+    kOpGetLoc = 'g',         // batched read: pin blocks, return locations
+    kOpRelease = 'r',        // drop a ticket's pinned blocks; NO response
 };
 
 // HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
@@ -195,6 +206,85 @@ struct KeyMeta {
         WireReader r(data, size);
         KeyMeta m;
         m.key = r.str();
+        return m;
+    }
+};
+
+// Ticket body (PutCommit / Release).
+struct TicketMeta {
+    uint64_t ticket = 0;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.u64(ticket);
+    }
+    static TicketMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        TicketMeta m;
+        m.ticket = r.u64();
+        return m;
+    }
+};
+
+// Shm pool directory entry + block location, shared by the PutAlloc/GetLoc
+// response bodies and the ShmHello response.
+struct ShmPool {
+    uint16_t pool_id = 0;
+    std::string name;
+    uint64_t size = 0;
+};
+struct ShmLoc {
+    uint16_t pool_id = 0;
+    uint64_t offset = 0;
+    uint32_t size = 0;  // stored block size (GetLoc); block_size echo (PutAlloc)
+};
+
+// Response body for PutAlloc and GetLoc: {ticket, locations, pool directory}.
+// The directory carries every mappable pool so clients can map auto-extended
+// pools on demand without a re-handshake.
+struct ShmLocResp {
+    uint64_t ticket = 0;
+    std::vector<ShmLoc> locs;
+    std::vector<ShmPool> pools;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.u64(ticket);
+        w.u32(static_cast<uint32_t>(locs.size()));
+        for (const auto& l : locs) {
+            w.u16(l.pool_id);
+            w.u64(l.offset);
+            w.u32(l.size);
+        }
+        w.u16(static_cast<uint16_t>(pools.size()));
+        for (const auto& p : pools) {
+            w.u16(p.pool_id);
+            w.str(p.name);
+            w.u64(p.size);
+        }
+    }
+    static ShmLocResp decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        ShmLocResp m;
+        m.ticket = r.u64();
+        uint32_t n = r.u32();
+        m.locs.reserve(n);
+        for (uint32_t i = 0; i < n; i++) {
+            ShmLoc l;
+            l.pool_id = r.u16();
+            l.offset = r.u64();
+            l.size = r.u32();
+            m.locs.push_back(l);
+        }
+        uint16_t np = r.u16();
+        m.pools.reserve(np);
+        for (uint16_t i = 0; i < np; i++) {
+            ShmPool p;
+            p.pool_id = r.u16();
+            p.name = r.str();
+            p.size = r.u64();
+            m.pools.push_back(p);
+        }
         return m;
     }
 };

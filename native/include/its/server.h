@@ -48,6 +48,10 @@ struct ServerConfig {
     // /root/reference/src/infinistore.cpp:52-53).
     double evict_min_ratio = 0.8;
     double evict_max_ratio = 0.95;
+    // Back pools with named shm segments so same-host clients can move
+    // payloads with one memcpy instead of the socket (degrades to anonymous
+    // memory + socket path automatically when /dev/shm is unavailable).
+    bool enable_shm = true;
 };
 
 // Per-op service counters (SURVEY.md §5.1: the reference has no tracing at
@@ -98,7 +102,9 @@ class Server {
     void handle_put_batch(Conn* c);
     void handle_get_batch(Conn* c);
     void handle_tcp_put(Conn* c);
+    void handle_shm(Conn* c);
     void handle_simple(Conn* c);
+    bool alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases);
     void finish_payload(Conn* c);
     void send_status(Conn* c, uint32_t status);
     void send_resp(Conn* c, uint32_t status, std::vector<uint8_t> body,

@@ -74,7 +74,7 @@ void its_log(int level, const char* msg) {
 // ---- server ----
 void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes,
                         uint64_t block_bytes, int auto_increase, uint64_t extend_bytes,
-                        int pin, double evict_min, double evict_max) {
+                        int pin, double evict_min, double evict_max, int enable_shm) {
     ServerConfig cfg;
     cfg.bind_addr = bind_addr;
     cfg.service_port = port;
@@ -85,6 +85,7 @@ void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes
     cfg.pin_memory = pin != 0;
     cfg.evict_min_ratio = evict_min;
     cfg.evict_max_ratio = evict_max;
+    cfg.enable_shm = enable_shm != 0;
     try {
         return new Server(cfg);
     } catch (const std::exception& e) {
@@ -107,14 +108,16 @@ int its_server_stats_json(void* s, char* buf, int buf_len) {
 }
 
 // ---- client ----
-void* its_conn_create(const char* host, int port, int timeout_ms) {
+void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm) {
     ClientConfig cfg;
     cfg.host = host;
     cfg.port = port;
     cfg.connect_timeout_ms = timeout_ms;
+    cfg.enable_shm = enable_shm != 0;
     return new Connection(cfg);
 }
 int its_conn_connect(void* c) { return static_cast<Connection*>(c)->connect(); }
+int its_conn_shm_active(void* c) { return static_cast<Connection*>(c)->shm_active() ? 1 : 0; }
 void its_conn_close(void* c) { static_cast<Connection*>(c)->close(); }
 void its_conn_destroy(void* c) { delete static_cast<Connection*>(c); }
 int its_conn_connected(void* c) { return static_cast<Connection*>(c)->connected() ? 1 : 0; }

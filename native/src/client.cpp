@@ -28,6 +28,11 @@ struct Connection::Request {
     std::vector<iovec> tx_payload;  // gather sources (user memory / caller buffer)
     size_t sent = 0;
     size_t send_total = 0;
+    // Shm fast path: tx_payload/rx_addrs are memcpy endpoints, not wire
+    // payload (payload_on_wire=false), and release requests expect no
+    // response from the server.
+    bool payload_on_wire = true;
+    bool no_response = false;
 
     // get-batch scatter destinations (filled sizes arrive in the resp body)
     std::vector<char*> rx_addrs;
@@ -111,8 +116,49 @@ int Connection::connect() {
     stop_.store(false);
     connected_.store(true);
     thread_ = std::thread([this] { reactor(); });
-    ITS_LOG_DEBUG("connected to %s:%d", config_.host.c_str(), config_.port);
+    if (config_.enable_shm) shm_handshake();
+    ITS_LOG_DEBUG("connected to %s:%d (shm=%d)", config_.host.c_str(), config_.port,
+                  static_cast<int>(shm_ok_.load()));
     return 0;
+}
+
+// Probe the server's shm pool directory and map every pool. All-or-nothing:
+// a partially mapped directory (e.g. cross-host client that happens to share
+// an shm namespace) disables the fast path rather than risking per-op
+// failures.
+void Connection::shm_handshake() {
+    auto req = std::make_unique<Request>();
+    req->op = kOpShmHello;
+    std::vector<uint8_t> body;
+    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    if (status != kStatusOk || body.empty()) return;
+    try {
+        ShmLocResp resp = ShmLocResp::decode(body.data(), body.size());
+        if (resp.pools.empty()) return;
+        size_t mapped = 0;
+        for (const auto& p : resp.pools)
+            if (map_pool(p.pool_id, p.name, p.size) != nullptr) mapped++;
+        shm_ok_.store(mapped == resp.pools.size());
+    } catch (const std::exception& e) {
+        ITS_LOG_WARN("shm handshake parse failed: %s", e.what());
+    }
+}
+
+char* Connection::map_pool(uint16_t pool_id, const std::string& name, uint64_t size) {
+    {
+        std::lock_guard<std::mutex> lock(shm_mu_);
+        auto it = shm_pools_.find(pool_id);
+        if (it != shm_pools_.end()) return it->second.base;
+    }
+    int fd = shm_open(name.c_str(), O_RDWR, 0);
+    if (fd < 0) return nullptr;
+    void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (mem == MAP_FAILED) return nullptr;
+    std::lock_guard<std::mutex> lock(shm_mu_);
+    auto [it, inserted] = shm_pools_.emplace(pool_id, ShmMap{static_cast<char*>(mem), size});
+    if (!inserted) munmap(mem, size);  // lost a race; keep the existing mapping
+    return it->second.base;
 }
 
 void Connection::close() {
@@ -127,6 +173,10 @@ void Connection::close() {
     ::close(epoll_fd_);
     fd_ = wake_fd_ = epoll_fd_ = -1;
     connected_.store(false);
+    shm_ok_.store(false);
+    std::lock_guard<std::mutex> lock(shm_mu_);
+    for (auto& [id, m] : shm_pools_) munmap(m.base, m.size);
+    shm_pools_.clear();
 }
 
 int Connection::register_mr(void* ptr, size_t size) {
@@ -153,7 +203,8 @@ bool Connection::base_registered(const void* base, size_t span) const {
 int Connection::submit(std::unique_ptr<Request> req) {
     req->hdr = ReqHeader{kMagic, req->op, static_cast<uint32_t>(req->body.size())};
     req->send_total = sizeof(ReqHeader) + req->body.size();
-    for (const auto& io : req->tx_payload) req->send_total += io.iov_len;
+    if (req->payload_on_wire)
+        for (const auto& io : req->tx_payload) req->send_total += io.iov_len;
     {
         std::lock_guard<std::mutex> lock(submit_mu_);
         if (!connected_.load()) return -1;
@@ -176,7 +227,9 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
         return -1;
     }
     auto req = std::make_unique<Request>();
-    req->op = kOpPutBatch;
+    bool shm = shm_ok_.load();
+    req->op = shm ? kOpPutAlloc : kOpPutBatch;
+    req->payload_on_wire = !shm;  // shm: blocks are memcpy'd after PutAlloc
     BatchMeta meta{block_size, keys};
     meta.encode(req->body);
     req->tx_payload.reserve(keys.size());
@@ -198,7 +251,7 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
         return -1;
     }
     auto req = std::make_unique<Request>();
-    req->op = kOpGetBatch;
+    req->op = shm_ok_.load() ? kOpGetLoc : kOpGetBatch;
     BatchMeta meta{block_size, keys};
     meta.encode(req->body);
     req->block_size = block_size;
@@ -325,10 +378,13 @@ void Connection::fail_all(int code) {
 }
 
 bool Connection::flush_send() {
+    static const std::vector<iovec> kNoPayload;
     while (!sendq_.empty()) {
         Request* req = sendq_.front().get();
         iovec iov[64];
-        size_t niov = build_send_iov(&req->hdr, sizeof(ReqHeader), req->body, req->tx_payload,
+        const std::vector<iovec>& wire_payload =
+            req->payload_on_wire ? req->tx_payload : kNoPayload;
+        size_t niov = build_send_iov(&req->hdr, sizeof(ReqHeader), req->body, wire_payload,
                                      req->sent, iov, 64);
         ssize_t r = writev(fd_, iov, static_cast<int>(niov));
         if (r < 0) {
@@ -343,8 +399,12 @@ bool Connection::flush_send() {
         }
         req->sent += static_cast<size_t>(r);
         if (req->sent == req->send_total) {
-            awaiting_.push_back(std::move(sendq_.front()));
-            sendq_.pop_front();
+            if (req->no_response) {
+                sendq_.pop_front();  // fire-and-forget (release)
+            } else {
+                awaiting_.push_back(std::move(sendq_.front()));
+                sendq_.pop_front();
+            }
         }
     }
     epoll_event ev{};
@@ -432,8 +492,119 @@ bool Connection::read_ready() {
         awaiting_.pop_front();
         resp_in_progress_ = false;
         rhdr_got_ = 0;
-        complete(std::move(done), static_cast<int>(rhdr_.status));
+        if (done->op == kOpPutAlloc || done->op == kOpGetLoc) {
+            auto requeue = shm_phase(std::move(done), rhdr_.status);
+            if (requeue != nullptr) sendq_.push_back(std::move(requeue));
+            if (!sendq_.empty() && !flush_send()) return false;
+        } else {
+            complete(std::move(done), static_cast<int>(rhdr_.status));
+        }
     }
+}
+
+// Handle a shm fast-path response on the reactor thread: memcpy payload
+// between user memory and the mapped pools, then either requeue the request
+// as a commit (put) or release the server-side pins and complete (get).
+std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Request> req,
+                                                           uint32_t status) {
+    bool put = req->op == kOpPutAlloc;
+    // Convert back to the socket-path op: the request body (BatchMeta) and
+    // payload endpoints are identical, so the op byte is the only change.
+    auto fall_back = [this, put](std::unique_ptr<Request> r) {
+        shm_ok_.store(false);
+        ITS_LOG_WARN("shm fast path degraded; retrying over the socket");
+        r->op = put ? kOpPutBatch : kOpGetBatch;
+        r->payload_on_wire = true;
+        r->sent = 0;
+        r->hdr = ReqHeader{kMagic, r->op, static_cast<uint32_t>(r->body.size())};
+        r->send_total = sizeof(ReqHeader) + r->body.size();
+        if (r->payload_on_wire)
+            for (const auto& io : r->tx_payload) r->send_total += io.iov_len;
+        return r;
+    };
+    if (status == kStatusRetry) {
+        // Server placed (or stored) the blocks in a pool that is not shm-
+        // mappable (e.g. /dev/shm quota forced an anonymous extend pool).
+        return fall_back(std::move(req));
+    }
+    if (status != kStatusOk) {
+        complete(std::move(req), static_cast<int>(status));
+        return nullptr;
+    }
+    ShmLocResp resp;
+    try {
+        resp = ShmLocResp::decode(rbody_.data(), rbody_.size());
+    } catch (const std::exception& e) {
+        ITS_LOG_ERROR("shm response parse failed: %s", e.what());
+        complete(std::move(req), static_cast<int>(kStatusInternal));
+        return nullptr;
+    }
+    size_t n = resp.locs.size();
+    bool ok = put ? n == req->tx_payload.size() : n == req->rx_addrs.size();
+    std::vector<char*> at(n);
+    for (size_t i = 0; ok && i < n; i++) {
+        const ShmLoc& l = resp.locs[i];
+        char* base = nullptr;
+        size_t mapped_size = 0;
+        {
+            std::lock_guard<std::mutex> lock(shm_mu_);
+            auto it = shm_pools_.find(l.pool_id);
+            if (it != shm_pools_.end()) {
+                base = it->second.base;
+                mapped_size = it->second.size;
+            }
+        }
+        if (base == nullptr) {
+            // Auto-extended pool: map on demand from the embedded directory.
+            for (const auto& p : resp.pools) {
+                if (p.pool_id == l.pool_id) {
+                    base = map_pool(p.pool_id, p.name, p.size);
+                    mapped_size = p.size;
+                    break;
+                }
+            }
+        }
+        // Bounds-check against the mapping: a malformed location must not
+        // drive memcpy out of the pool (the socket path bounds everything
+        // through validated iovecs; this is the shm equivalent).
+        size_t span = put ? req->tx_payload[i].iov_len : static_cast<size_t>(l.size);
+        if (base == nullptr || l.offset > mapped_size || span > mapped_size - l.offset) {
+            ok = false;
+            break;
+        }
+        at[i] = base + l.offset;
+    }
+    if (!ok) {
+        queue_release(resp.ticket);  // abort: drop the server-side ticket
+        return fall_back(std::move(req));
+    }
+    if (put) {
+        for (size_t i = 0; i < n; i++)
+            memcpy(at[i], req->tx_payload[i].iov_base, req->tx_payload[i].iov_len);
+        // Phase 2: publish the keys (commit-on-copy-complete).
+        req->op = kOpPutCommit;
+        req->body.clear();
+        TicketMeta{resp.ticket}.encode(req->body);
+        req->tx_payload.clear();
+        req->sent = 0;
+        req->hdr = ReqHeader{kMagic, req->op, static_cast<uint32_t>(req->body.size())};
+        req->send_total = sizeof(ReqHeader) + req->body.size();
+        return req;
+    }
+    for (size_t i = 0; i < n; i++) memcpy(req->rx_addrs[i], at[i], resp.locs[i].size);
+    queue_release(resp.ticket);
+    complete(std::move(req), static_cast<int>(kStatusOk));
+    return nullptr;
+}
+
+void Connection::queue_release(uint64_t ticket) {
+    auto rel = std::make_unique<Request>();
+    rel->op = kOpRelease;
+    TicketMeta{ticket}.encode(rel->body);
+    rel->no_response = true;
+    rel->hdr = ReqHeader{kMagic, rel->op, static_cast<uint32_t>(rel->body.size())};
+    rel->send_total = sizeof(ReqHeader) + rel->body.size();
+    sendq_.push_back(std::move(rel));
 }
 
 void Connection::reactor() {

@@ -11,6 +11,8 @@
 #include <cstdlib>
 #include <initializer_list>
 
+#include "its/mempool.h"
+
 namespace its {
 
 namespace {
@@ -21,6 +23,9 @@ void crash_handler(int sig) {
     dprintf(STDERR_FILENO, "\n[infinistore-tpu] fatal signal %d (%s); backtrace:\n", sig,
             strsignal(sig));
     backtrace_symbols_fd(frames, n, STDERR_FILENO);
+    // Unlink live shm pool segments so tmpfs pages don't outlive the process
+    // (async-signal-safe: walks a static table, calls shm_unlink only).
+    shm_registry_unlink_all();
     // Restore default and re-raise so the exit status reflects the signal.
     signal(sig, SIG_DFL);
     raise(sig);

@@ -1,10 +1,18 @@
 #include "its/mempool.h"
 
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
 #include <strings.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
+#include <random>
 #include <stdexcept>
 
 #include "its/log.h"
@@ -15,19 +23,103 @@ namespace {
 constexpr size_t kAlignment = 4096;
 
 bool is_pow2(size_t v) { return v != 0 && (v & (v - 1)) == 0; }
+
+// Registry of live shm segment names for crash-time cleanup. Fixed-size slots
+// with plain char arrays: the fatal-signal handler walks it without taking
+// locks or touching the heap.
+constexpr size_t kMaxSegments = 512;
+constexpr size_t kMaxName = 96;
+char g_segments[kMaxSegments][kMaxName];
+std::mutex g_segments_mu;
 }  // namespace
 
-MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin)
+void shm_registry_add(const char* name) {
+    std::lock_guard<std::mutex> lock(g_segments_mu);
+    for (auto& slot : g_segments) {
+        if (slot[0] == '\0') {
+            snprintf(slot, kMaxName, "%s", name);
+            return;
+        }
+    }
+    ITS_LOG_WARN("shm registry full; %s will leak if the process crashes", name);
+}
+
+void shm_registry_remove(const char* name) {
+    std::lock_guard<std::mutex> lock(g_segments_mu);
+    for (auto& slot : g_segments) {
+        if (strncmp(slot, name, kMaxName) == 0) {
+            slot[0] = '\0';
+            return;
+        }
+    }
+}
+
+void shm_registry_unlink_all() {
+    // Called from the fatal-signal handler: no locks, no heap. A torn name
+    // (writer mid-snprintf) at worst makes shm_unlink fail with ENOENT.
+    for (auto& slot : g_segments) {
+        if (slot[0] != '\0') shm_unlink(slot);
+    }
+}
+
+void shm_sweep_stale() {
+    // Unlink segments left by SIGKILLed servers: /dev/shm entries named
+    // its.<pid>.<rand>.<idx> whose pid no longer exists.
+    DIR* d = opendir("/dev/shm");
+    if (d == nullptr) return;
+    while (dirent* e = readdir(d)) {
+        if (strncmp(e->d_name, "its.", 4) != 0) continue;
+        long pid = strtol(e->d_name + 4, nullptr, 10);
+        if (pid <= 0 || kill(static_cast<pid_t>(pid), 0) == 0 || errno != ESRCH) continue;
+        std::string name = std::string("/") + e->d_name;
+        if (shm_unlink(name.c_str()) == 0)
+            ITS_LOG_INFO("swept stale shm segment %s (pid %ld is gone)", name.c_str(), pid);
+    }
+    closedir(d);
+}
+
+MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
+                       const std::string& shm_name)
     : pool_size_(pool_size), block_size_(block_size) {
     if (!is_pow2(block_size)) throw std::invalid_argument("block_size must be a power of two");
     if (pool_size == 0 || pool_size % block_size != 0)
         throw std::invalid_argument("pool_size must be a positive multiple of block_size");
     total_blocks_ = pool_size / block_size;
 
-    void* mem = nullptr;
-    if (posix_memalign(&mem, kAlignment, pool_size) != 0)
-        throw std::bad_alloc();
-    base_ = static_cast<char*>(mem);
+    if (!shm_name.empty()) {
+        int fd = shm_open(shm_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        // posix_fallocate (not just ftruncate): reserve the tmpfs pages now so
+        // an over-committed /dev/shm fails cleanly here — triggering the
+        // anonymous fallback — instead of SIGBUSing the first touch mid-put.
+        if (fd >= 0 && (ftruncate(fd, static_cast<off_t>(pool_size)) != 0 ||
+                        posix_fallocate(fd, 0, static_cast<off_t>(pool_size)) != 0)) {
+            close(fd);
+            shm_unlink(shm_name.c_str());
+            fd = -1;
+        }
+        if (fd >= 0) {
+            void* mem =
+                mmap(nullptr, pool_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+            close(fd);  // the mapping keeps the segment alive
+            if (mem != MAP_FAILED) {
+                base_ = static_cast<char*>(mem);
+                shm_backed_ = true;
+                shm_name_ = shm_name;
+                shm_registry_add(shm_name.c_str());
+            } else {
+                shm_unlink(shm_name.c_str());
+            }
+        }
+        if (!shm_backed_)
+            ITS_LOG_WARN("shm pool %s unavailable (%s); falling back to anonymous memory",
+                         shm_name.c_str(), strerror(errno));
+    }
+    if (base_ == nullptr) {
+        void* mem = nullptr;
+        if (posix_memalign(&mem, kAlignment, pool_size) != 0)
+            throw std::bad_alloc();
+        base_ = static_cast<char*>(mem);
+    }
 
     if (pin) {
         // Pin so DCN send/recv never faults mid-transfer. Containers commonly
@@ -46,7 +138,13 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin)
 MemoryPool::~MemoryPool() {
     if (base_ != nullptr) {
         if (pinned_) munlock(base_, pool_size_);
-        free(base_);
+        if (shm_backed_) {
+            munmap(base_, pool_size_);
+            shm_unlink(shm_name_.c_str());
+            shm_registry_remove(shm_name_.c_str());
+        } else {
+            free(base_);
+        }
     }
 }
 
@@ -126,9 +224,47 @@ bool MemoryPool::deallocate(void* ptr, size_t size) {
     return true;
 }
 
-MM::MM(size_t initial_pool_size, size_t block_size, bool pin)
+MM::MM(size_t initial_pool_size, size_t block_size, bool pin, bool use_shm)
     : block_size_(block_size), pin_(pin) {
-    pools_.push_back(std::make_unique<MemoryPool>(initial_pool_size, block_size, pin));
+    if (use_shm) {
+        shm_sweep_stale();
+        // Unique prefix per MM instance; pools are "<prefix>.<index>".
+        std::random_device rd;
+        char buf[64];
+        snprintf(buf, sizeof(buf), "/its.%d.%08x", static_cast<int>(getpid()), rd());
+        shm_prefix_ = std::make_unique<std::string>(buf);
+    }
+    pools_.push_back(
+        std::make_unique<MemoryPool>(initial_pool_size, block_size, pin, next_shm_name()));
+    if (use_shm && pools_[0]->shm_name().empty()) shm_prefix_.reset();  // fell back
+}
+
+std::string MM::next_shm_name() {
+    if (shm_prefix_ == nullptr) return "";
+    return *shm_prefix_ + "." + std::to_string(pools_.size());
+}
+
+std::vector<PoolDirEntry> MM::pool_dir() const {
+    std::vector<PoolDirEntry> dir;
+    if (shm_prefix_ == nullptr) return dir;
+    for (size_t i = 0; i < pools_.size(); i++) {
+        if (pools_[i]->shm_name().empty()) continue;
+        dir.push_back(PoolDirEntry{static_cast<uint16_t>(i), pools_[i]->shm_name(),
+                                   static_cast<uint64_t>(pools_[i]->size())});
+    }
+    return dir;
+}
+
+PoolLoc MM::locate(const void* ptr) const {
+    for (size_t i = 0; i < pools_.size(); i++) {
+        if (pools_[i]->contains(ptr)) {
+            return PoolLoc{static_cast<uint16_t>(i),
+                           static_cast<uint64_t>(static_cast<const char*>(ptr) -
+                                                 static_cast<const char*>(pools_[i]->base())),
+                           true};
+        }
+    }
+    return PoolLoc{};
 }
 
 bool MM::allocate(size_t size, size_t n, const std::function<void(void*, size_t)>& cb,
@@ -173,7 +309,8 @@ void MM::deallocate(void* ptr, size_t size) {
 
 bool MM::extend(size_t pool_size) {
     try {
-        pools_.push_back(std::make_unique<MemoryPool>(pool_size, block_size_, pin_));
+        pools_.push_back(
+            std::make_unique<MemoryPool>(pool_size, block_size_, pin_, next_shm_name()));
         ITS_LOG_INFO("mempool extended: now %zu pools, %zu MB total", pools_.size(),
                      total_bytes() >> 20);
         return true;

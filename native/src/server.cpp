@@ -93,6 +93,18 @@ struct Server::Conn {
     std::deque<OutMsg> outq;
     bool epollout_armed = false;
 
+    // Shm fast-path tickets. A put ticket holds allocated-but-unpublished
+    // blocks between PutAlloc and PutCommit; a get ticket pins committed
+    // blocks while the client copies them out of the mapped pools. Both die
+    // with the connection (blocks freed / refs dropped via BlockRef).
+    struct PendingPut {
+        std::vector<std::string> keys;
+        std::vector<BlockRef> blocks;
+    };
+    uint64_t next_ticket = 1;
+    std::unordered_map<uint64_t, PendingPut> pending_puts;
+    std::unordered_map<uint64_t, std::vector<BlockRef>> pending_gets;
+
     void reset_read() {
         rstate = RState::kHeader;
         hdr_got = 0;
@@ -107,7 +119,8 @@ struct Server::Conn {
 };
 
 Server::Server(const ServerConfig& config) : config_(config) {
-    mm_ = std::make_unique<MM>(config.prealloc_bytes, config.block_size, config.pin_memory);
+    mm_ = std::make_unique<MM>(config.prealloc_bytes, config.block_size, config.pin_memory,
+                               config.enable_shm);
     kv_ = std::make_unique<KVStore>(mm_.get());
 }
 
@@ -461,6 +474,13 @@ void Server::dispatch(Conn* c) {
             case kOpTcpPut:
                 handle_tcp_put(c);
                 break;
+            case kOpShmHello:
+            case kOpPutAlloc:
+            case kOpPutCommit:
+            case kOpGetLoc:
+            case kOpRelease:
+                handle_shm(c);
+                break;
             case kOpTcpGet:
             case kOpCheckExist:
             case kOpMatchLastIdx:
@@ -489,6 +509,16 @@ bool Server::ensure_capacity(size_t need_bytes) {
     return true;
 }
 
+bool Server::alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases) {
+    kv_->evict(config_.evict_min_ratio, config_.evict_max_ratio);
+    ensure_capacity(size * n);
+    bool ok = mm_->allocate(size, n, nullptr, leases);
+    if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
+        ok = mm_->allocate(size, n, nullptr, leases);
+    }
+    return ok;
+}
+
 void Server::handle_put_batch(Conn* c) {
     BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
     size_t n = m.keys.size();
@@ -498,15 +528,8 @@ void Server::handle_put_batch(Conn* c) {
         return;
     }
     uint64_t need = static_cast<uint64_t>(n) * m.block_size;
-    kv_->evict(config_.evict_min_ratio, config_.evict_max_ratio);
-    ensure_capacity(need);
-
     std::vector<Lease> leases;
-    bool ok = mm_->allocate(m.block_size, n, nullptr, &leases);
-    if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
-        ok = mm_->allocate(m.block_size, n, nullptr, &leases);
-    }
-    if (!ok) {
+    if (!alloc_blocks(m.block_size, n, &leases)) {
         // Client streams payload back-to-back with the metadata (no extra
         // RTT), so on OOM we must drain it before answering 507.
         c->body.clear();
@@ -533,15 +556,8 @@ void Server::handle_tcp_put(Conn* c) {
         send_status(c, kStatusInvalidReq);
         return;
     }
-    kv_->evict(config_.evict_min_ratio, config_.evict_max_ratio);
-    ensure_capacity(m.value_length);
-
     std::vector<Lease> leases;
-    bool ok = mm_->allocate(m.value_length, 1, nullptr, &leases);
-    if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
-        ok = mm_->allocate(m.value_length, 1, nullptr, &leases);
-    }
-    if (!ok) {
+    if (!alloc_blocks(m.value_length, 1, &leases)) {
         c->body.clear();
         c->rstate = Conn::RState::kDrain;
         c->drain_remaining = m.value_length;
@@ -553,6 +569,159 @@ void Server::handle_tcp_put(Conn* c) {
     c->rx_iov = {iovec{leases[0].ptr, m.value_length}};
     c->rstate = Conn::RState::kPayload;
     c->rx_cur.reset();
+}
+
+// Shm fast-path control ops: allocate/commit for writes, locate/release for
+// reads. Payload never touches the socket — the same-host client memcpys
+// straight into/out of the shm-mapped pools (zero-copy in the same sense as
+// the reference's one-sided RDMA: one data movement, placed by the server).
+void Server::handle_shm(Conn* c) {
+    std::vector<PoolDirEntry> dir = mm_->pool_dir();
+    // Shared tail: embed the mappable-pool directory and send.
+    auto send_loc_resp = [this, c, &dir](ShmLocResp& resp) {
+        for (const auto& e : dir)
+            resp.pools.push_back(ShmPool{e.pool_id, e.shm_name, e.size});
+        std::vector<uint8_t> body;
+        resp.encode(body);
+        c->reset_read();
+        send_resp(c, kStatusOk, std::move(body), {}, {});
+    };
+    // A location is only usable if its pool is in the shm directory; a pool
+    // that fell back to anonymous memory (e.g. /dev/shm quota hit during
+    // auto-extend) is reachable only via the socket path.
+    auto shm_mappable = [this, &dir](const void* ptr, PoolLoc* out) {
+        *out = mm_->locate(ptr);
+        if (!out->found) return false;
+        for (const auto& e : dir)
+            if (e.pool_id == out->pool_id) return true;
+        return false;
+    };
+    switch (c->hdr.op) {
+        case kOpShmHello: {
+            ShmLocResp resp;
+            send_loc_resp(resp);
+            return;
+        }
+        case kOpPutAlloc: {
+            BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+            size_t n = m.keys.size();
+            if (n == 0 || m.block_size == 0 || !mm_->shm_enabled()) {
+                c->reset_read();
+                send_status(c, kStatusInvalidReq);
+                return;
+            }
+            std::vector<Lease> leases;
+            if (!alloc_blocks(m.block_size, n, &leases)) {
+                // No payload is in flight on this path, so OOM is a clean
+                // immediate 507 (the socket path must drain first).
+                c->reset_read();
+                send_status(c, kStatusOutOfMemory);
+                return;
+            }
+            dir = mm_->pool_dir();  // alloc may have auto-extended a pool
+            ShmLocResp resp;
+            resp.ticket = c->next_ticket++;
+            Conn::PendingPut pending;
+            pending.keys = std::move(m.keys);
+            pending.blocks.reserve(n);
+            resp.locs.reserve(n);
+            bool mappable = true;
+            for (const auto& lease : leases) {
+                PoolLoc loc;
+                mappable = mappable && shm_mappable(lease.ptr, &loc);
+                resp.locs.push_back(ShmLoc{loc.pool_id, loc.offset, m.block_size});
+                pending.blocks.push_back(
+                    std::make_shared<Block>(mm_.get(), lease.ptr, lease.size));
+            }
+            if (!mappable) {
+                // Blocks landed in an anonymous-fallback pool: tell the
+                // client to retry over the socket path (BlockRefs free the
+                // leases here).
+                c->reset_read();
+                send_status(c, kStatusRetry);
+                return;
+            }
+            c->pending_puts.emplace(resp.ticket, std::move(pending));
+            send_loc_resp(resp);
+            return;
+        }
+        case kOpPutCommit: {
+            TicketMeta m = TicketMeta::decode(c->body.data(), c->body.size());
+            auto it = c->pending_puts.find(m.ticket);
+            if (it == c->pending_puts.end()) {
+                c->reset_read();
+                send_status(c, kStatusInvalidReq);
+                return;
+            }
+            uint64_t in_bytes = 0;
+            auto& pending = it->second;
+            for (size_t i = 0; i < pending.keys.size(); i++) {
+                in_bytes += pending.blocks[i]->size();
+                kv_->commit(pending.keys[i], std::move(pending.blocks[i]));
+            }
+            c->pending_puts.erase(it);
+            // Logical write op: account under 'W' so shm and socket transports
+            // share one metric stream.
+            stats_[kOpPutBatch].record(now_us() - c->op_start_us, in_bytes, 0, true);
+            c->reset_read();
+            send_resp(c, kStatusOk, {}, {}, {});
+            return;
+        }
+        case kOpGetLoc: {
+            BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+            if (m.keys.empty() || m.block_size == 0 || !mm_->shm_enabled()) {
+                c->reset_read();
+                send_status(c, kStatusInvalidReq);
+                return;
+            }
+            for (const auto& key : m.keys) {
+                if (!kv_->exists(key)) {
+                    c->reset_read();
+                    send_status(c, kStatusKeyNotFound);
+                    return;
+                }
+            }
+            ShmLocResp resp;
+            resp.ticket = c->next_ticket++;
+            std::vector<BlockRef> refs;
+            refs.reserve(m.keys.size());
+            uint64_t total = 0;
+            for (const auto& key : m.keys) {
+                BlockRef b = kv_->get(key);  // LRU touch
+                if (b->size() > m.block_size) {
+                    c->reset_read();
+                    send_status(c, kStatusInvalidReq);
+                    return;
+                }
+                PoolLoc loc;
+                if (!shm_mappable(b->data(), &loc)) {
+                    // Block lives in an anonymous-fallback pool; the client
+                    // must fetch it over the socket path.
+                    c->reset_read();
+                    send_status(c, kStatusRetry);
+                    return;
+                }
+                resp.locs.push_back(
+                    ShmLoc{loc.pool_id, loc.offset, static_cast<uint32_t>(b->size())});
+                total += b->size();
+                refs.push_back(std::move(b));
+            }
+            c->pending_gets.emplace(resp.ticket, std::move(refs));
+            stats_[kOpGetBatch].record(now_us() - c->op_start_us, 0, total, true);
+            send_loc_resp(resp);
+            return;
+        }
+        case kOpRelease: {
+            TicketMeta m = TicketMeta::decode(c->body.data(), c->body.size());
+            c->pending_gets.erase(m.ticket);
+            c->pending_puts.erase(m.ticket);  // abort path for unmappable pools
+            c->reset_read();  // fire-and-forget: no response
+            return;
+        }
+        default:
+            c->reset_read();
+            send_status(c, kStatusInvalidReq);
+    }
 }
 
 void Server::finish_payload(Conn* c) {

@@ -38,15 +38,19 @@ def server():
     srv.stop()
 
 
-@pytest.fixture()
-def conn(server):
+@pytest.fixture(params=["shm", "socket"])
+def conn(server, request):
+    """Every integration test runs against both data planes: the same-host
+    shm fast path and the socket (DCN) path."""
     cfg = its.ClientConfig(
         host_addr="127.0.0.1",
         service_port=server["port"],
         connection_type=its.TYPE_RDMA,
         log_level="error",
+        enable_shm=request.param == "shm",
     )
     c = its.InfinityConnection(cfg)
     c.connect()
+    assert c.shm_active == (request.param == "shm")
     yield c
     c.close()

@@ -1,0 +1,146 @@
+"""Same-host shm fast-path behavior: activation/fallback, server-side ticket
+lifetime (pending blocks freed on disconnect), clean OOM (no payload drain
+needed), and on-demand mapping of auto-extended pools.
+
+The reference gets its zero-copy local path from GPUDirect RDMA (ibv_reg_mr on
+CUDA pointers, reference infinistore/test_infinistore.py:120-122); on TPU
+hosts the analogue is named-shm pools mapped into the client, and these are
+the behaviors that differ from the socket path.
+"""
+
+import asyncio
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu import wire
+
+
+def _connect_raw(port: int) -> socket.socket:
+    s = socket.create_connection(("127.0.0.1", port))
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _roundtrip(sock: socket.socket, op: int, body: bytes):
+    sock.sendall(wire.pack_req_header(op, len(body)) + body)
+    hdr = b""
+    while len(hdr) < 16:
+        hdr += sock.recv(16 - len(hdr))
+    status, body_size, payload_size = wire.unpack_resp_header(hdr)
+    resp = b""
+    while len(resp) < body_size:
+        resp += sock.recv(body_size - len(resp))
+    return status, resp, payload_size
+
+
+def test_shm_active_matches_server_capability():
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    assert c.shm_active is True
+    c.close()
+    srv.stop()
+
+    # Server with shm disabled -> client degrades to the socket path.
+    srv2 = its.start_local_server(
+        prealloc_bytes=16 << 20, block_bytes=16 << 10, enable_shm=False
+    )
+    c2 = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv2.port, log_level="error")
+    )
+    c2.connect()
+    assert c2.shm_active is False
+    data = (np.arange(16 << 10) % 256).astype(np.uint8)
+    dst = np.zeros_like(data)
+    c2.register_mr(data)
+    c2.register_mr(dst)
+    asyncio.run(c2.write_cache_async([("sk", 0)], data.nbytes, data.ctypes.data))
+    asyncio.run(c2.read_cache_async([("sk", 0)], data.nbytes, dst.ctypes.data))
+    assert np.array_equal(data, dst)
+    c2.close()
+    srv2.stop()
+
+
+def test_pending_put_blocks_freed_on_disconnect():
+    """PutAlloc without commit pins pool blocks in the connection's ticket
+    table; dropping the connection must free them (the reference analogue:
+    inflight RDMA state dies with the Client struct, infinistore.cpp:967-988)."""
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    from infinistore_tpu._native import lib
+
+    assert lib.its_server_usage(srv.handle) == 0.0
+    s = _connect_raw(srv.port)
+    body = wire.BatchMeta(block_size=16 << 10, keys=[f"pend-{i}" for i in range(64)]).encode()
+    status, resp, _ = _roundtrip(s, wire.OP_PUT_ALLOC, body)
+    assert status == wire.STATUS_OK
+    parsed = wire.ShmLocResp.decode(resp)
+    assert len(parsed.locs) == 64
+    assert len(parsed.pools) >= 1
+    assert parsed.ticket != 0
+    # 64 x 16KB pinned by the ticket, never committed.
+    assert lib.its_server_usage(srv.handle) > 0.0
+    assert lib.its_server_kvmap_len(srv.handle) == 0
+    s.close()
+    deadline = time.time() + 5
+    while time.time() < deadline and lib.its_server_usage(srv.handle) > 0.0:
+        time.sleep(0.05)
+    assert lib.its_server_usage(srv.handle) == 0.0
+    srv.stop()
+
+
+def test_shm_oom_is_immediate_507():
+    """On the shm path OOM needs no payload drain: the 507 comes back before
+    any data moves, and the connection stays usable."""
+    srv = its.start_local_server(prealloc_bytes=8 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    assert c.shm_active
+    big = np.zeros(16 << 20, dtype=np.uint8)
+    c.register_mr(big)
+    with pytest.raises(its.InfiniStoreException):
+        asyncio.run(c.write_cache_async([("big", 0)], big.nbytes, big.ctypes.data))
+    small = np.ones(4096, dtype=np.uint8)
+    dst = np.zeros_like(small)
+    c.register_mr(small)
+    c.register_mr(dst)
+    asyncio.run(c.write_cache_async([("ok", 0)], 4096, small.ctypes.data))
+    asyncio.run(c.read_cache_async([("ok", 0)], 4096, dst.ctypes.data))
+    assert np.array_equal(small, dst)
+    c.close()
+    srv.stop()
+
+
+def test_auto_extend_pool_mapped_on_demand():
+    """Writes spilling into an auto-extended pool must reach the client via
+    the directory embedded in responses — no re-handshake."""
+    srv = its.start_local_server(
+        prealloc_bytes=8 << 20,
+        block_bytes=16 << 10,
+        auto_increase=True,
+        extend_bytes=16 << 20,
+    )
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    assert c.shm_active
+    n, block = 512, 16 << 10  # 8MB of data on an 8MB pool -> must extend
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    c.register_mr(src)
+    c.register_mr(dst)
+    pairs = [(f"x-{i}", i * block) for i in range(n)]
+    asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
+    asyncio.run(c.read_cache_async(pairs, block, dst.ctypes.data))
+    assert np.array_equal(src, dst)
+    c.close()
+    srv.stop()
