@@ -1,0 +1,62 @@
+"""Cross-request prefix reuse through the KVConnector (reference scenario 2,
+README.md:15-16: the extra-large KV pool with cross-node reuse; LMCache plays
+this role for vLLM in the reference stack).
+
+Request A prefills a long system prompt and saves its KV blocks. Request B
+shares the system prompt but has a different user turn: the connector's
+lookup finds the shared block-aligned prefix, load() fetches only those
+blocks, and the engine prefills just the new suffix.
+"""
+
+import asyncio
+
+import jax.numpy as jnp
+import numpy as np
+
+from common import get_connection, parse_args
+
+from infinistore_tpu import KVConnector
+from infinistore_tpu.tpu import PagedKVCacheSpec
+
+
+def main():
+    args = parse_args()
+    conn, cleanup = get_connection(args)
+    try:
+        spec = PagedKVCacheSpec(
+            num_layers=4, num_blocks=32, block_tokens=8, num_kv_heads=2,
+            head_dim=64, dtype=jnp.bfloat16,
+        )
+        connector = KVConnector(conn, spec, model_id="demo", max_blocks=8)
+
+        system_prompt = list(range(1000, 1032))  # 4 blocks of 8 tokens
+        req_a = system_prompt + [1, 2, 3, 4, 5, 6, 7, 8]  # 5 blocks
+
+        # Request A: nothing cached -> engine prefills everything, then saves.
+        assert connector.lookup(req_a) == 0
+        caches = spec.make_caches()
+        # (A real engine fills `caches` by running prefill; the flow is the
+        # same either way.)
+        block_ids_a = np.arange(5, dtype=np.int32)
+        written = asyncio.run(connector.save(req_a, caches, block_ids_a))
+        print(f"request A: saved {written} KV blocks to the store")
+
+        # Request B: shares the 4 system-prompt blocks, new user turn.
+        req_b = system_prompt + [9, 10, 11, 12, 13, 14, 15, 16]
+        hit = connector.lookup(req_b)
+        print(f"request B: {hit} of {len(req_b) // spec.block_tokens} blocks cached")
+        assert hit == 4
+
+        fresh = spec.make_caches()
+        block_ids_b = np.arange(10, 15, dtype=np.int32)
+        _, loaded = asyncio.run(connector.load(req_b, fresh, block_ids_b))
+        print(f"request B: loaded {loaded} blocks; engine only prefills the last "
+              f"{len(req_b) - loaded * spec.block_tokens} tokens")
+
+        print(f"cleanup: dropped {connector.drop(req_a)} store keys")
+    finally:
+        cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,20 @@ from .lib import (
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # Lazy: the connector pulls in jax (via the TPU data plane); the core
+    # client/server API must stay importable without it.
+    if name in ("KVConnector", "token_chain_hashes"):
+        from . import connector
+
+        return getattr(connector, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
+    "KVConnector",
+    "token_chain_hashes",
     "InfinityConnection",
     "register_server",
     "start_local_server",

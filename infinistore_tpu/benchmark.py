@@ -33,7 +33,41 @@ def parse_args(argv=None):
     p.add_argument("--iteration", type=int, default=1)
     p.add_argument("--verify", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--json", action="store_true", help="emit one JSON line")
+    p.add_argument(
+        "--latency", action="store_true",
+        help="also measure single-block fetch latency p50/p99 at 4KB and 64KB "
+             "(the BASELINE.md 'p50 block-fetch latency' configs)",
+    )
     return p.parse_args(argv)
+
+
+def _measure_latency(conn, samples: int = 200) -> dict:
+    """p50/p99 single-block fetch latency at 4KB and 64KB."""
+    out = {}
+    for size in (4 << 10, 64 << 10):
+        buf = np.random.randint(0, 256, size=size, dtype=np.uint8)
+        dst = np.zeros_like(buf)
+        conn.register_mr(buf)
+        conn.register_mr(dst)
+        key = f"lat-{uuid.uuid4().hex[:8]}"
+
+        async def sample():
+            await conn.write_cache_async([(key, 0)], size, buf.ctypes.data)
+            await conn.read_cache_async([(key, 0)], size, dst.ctypes.data)  # warm
+            lats = []
+            for _ in range(samples):
+                t0 = time.perf_counter()
+                await conn.read_cache_async([(key, 0)], size, dst.ctypes.data)
+                lats.append((time.perf_counter() - t0) * 1e6)
+            return lats
+
+        lats = sorted(asyncio.run(sample()))
+        out[f"fetch_{size >> 10}kb"] = {
+            "p50_us": round(lats[len(lats) // 2], 1),
+            "p99_us": round(lats[int(len(lats) * 0.99)], 1),
+        }
+        conn.delete_keys([key])
+    return out
 
 
 async def _run_batched(conn, keys, offsets, block_size, src, dst, steps):
@@ -115,6 +149,8 @@ def run(args) -> dict:
             "read_mb_s": round(moved / read_s / (1 << 20), 2),
             "verified": ok,
         }
+        if args.latency and args.type == "rdma":
+            result["latency"] = _measure_latency(conn)
         conn.delete_keys(keys)
         return result
     finally:

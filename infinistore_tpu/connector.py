@@ -1,0 +1,155 @@
+"""Engine-facing KV-cache connector: the LMCache-style glue layer.
+
+The reference integrates with vLLM "through LMCache" (reference README.md:22):
+the engine never speaks the store protocol directly — a connector hashes token
+prefixes into chain keys, asks the store how much of a prompt is already
+cached (`get_match_last_index`, reference src/infinistore.cpp:786-798), and
+streams paged-KV blocks layer by layer. This module is that connector for
+JAX/TPU engines: it binds a paged cache spec + host staging pool + store
+connection to a model id and exposes lookup / save / load in engine terms
+(token ids and block ids), with the chain-hash key scheme that makes
+cross-request prefix reuse work (reference docs/source/design.rst:50).
+
+Key scheme: ``{model}/L{layer}/{k|v}/{chain_hash_i}`` where ``chain_hash_i``
+is a rolling SHA-256 over token blocks [0..i]. A block's key therefore commits
+to the *entire prefix*, so two prompts share keys exactly for their common
+block-aligned prefix — and the store's binary-search prefix match applies.
+"""
+
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .lib import InfiniStoreKeyNotFound
+from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter
+from .tpu.paged import PagedKVCacheSpec
+from .tpu.staging import HostStagingPool
+
+
+def token_chain_hashes(token_ids: Sequence[int], block_tokens: int) -> List[str]:
+    """Rolling prefix hash per *complete* token block.
+
+    hash_i covers tokens [0, (i+1) * block_tokens); an incomplete tail block
+    is excluded (it cannot be reused — its key would never match another
+    request's complete block).
+    """
+    n_full = len(token_ids) // block_tokens
+    hashes = []
+    h = hashlib.sha256()
+    for i in range(n_full):
+        chunk = np.asarray(
+            token_ids[i * block_tokens : (i + 1) * block_tokens], dtype=np.int64
+        )
+        h.update(chunk.tobytes())
+        hashes.append(h.copy().hexdigest()[:32])
+    return hashes
+
+
+class KVConnector:
+    """Bind one model's paged KV cache to a store connection.
+
+    The engine calls, per request:
+      - ``lookup(tokens)`` -> how many leading blocks are already cached
+      - ``load(tokens, caches, block_ids)`` -> scatter those blocks into the
+        engine's paged cache (skipping recompute of the shared prefix)
+      - ``save(tokens, caches, block_ids)`` -> stream the request's blocks
+        out, layer by layer, overlapping D2H with the network
+    """
+
+    def __init__(
+        self,
+        conn,
+        spec: PagedKVCacheSpec,
+        model_id: str,
+        max_blocks: int,
+        pool: Optional[HostStagingPool] = None,
+    ):
+        self.conn = conn
+        self.spec = spec
+        self.model_id = model_id
+        self.max_blocks = max_blocks
+        if pool is None:
+            pool = HostStagingPool(
+                4 * max_blocks * spec.block_nbytes, spec.block_nbytes, conn=conn
+            )
+        self.pool = pool
+        self._writer = LayerwiseKVWriter(conn, pool, spec, max_blocks)
+        self._reader = LayerwiseKVReader(conn, pool, spec, max_blocks)
+
+    # -- key scheme ----------------------------------------------------------
+
+    def block_key(self, layer: int, kind: str, chain_hash: str) -> str:
+        return f"{self.model_id}/L{layer}/{kind}/{chain_hash}"
+
+    def _key_fn(self, chains: List[str]):
+        def key_fn(layer: int, kind: str, block: int) -> str:
+            return self.block_key(layer, kind, chains[block])
+
+        return key_fn
+
+    # -- engine surface ------------------------------------------------------
+
+    def lookup(self, token_ids: Sequence[int]) -> int:
+        """Number of leading blocks of this prompt already in the store.
+
+        One control round-trip: the layer-0 K keys stand in for the whole
+        block (the writer commits layer 0 last, so a present sentinel means
+        every layer is present), and the store's binary-search longest-prefix
+        match does the rest.
+        """
+        return self._lookup_chains(token_chain_hashes(token_ids, self.spec.block_tokens))
+
+    def _lookup_chains(self, chains: List[str]) -> int:
+        if not chains:
+            return 0
+        keys = [self.block_key(0, "k", c) for c in chains]
+        try:
+            return self.conn.get_match_last_index(keys) + 1
+        except Exception:
+            return 0
+
+    async def save(self, token_ids, caches, block_ids: np.ndarray) -> int:
+        """Stream the request's KV blocks to the store. ``block_ids[i]`` is
+        the engine's physical block holding logical block i of this prompt.
+        Returns blocks written (K+V across layers)."""
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        n = min(len(chains), len(block_ids))
+        if n == 0:
+            return 0
+        return await self._writer.write(
+            caches, np.asarray(block_ids[:n]), self._key_fn(chains)
+        )
+
+    async def load(self, token_ids, caches, block_ids: np.ndarray):
+        """Fetch this prompt's cached prefix into the engine's paged cache.
+
+        Fetches ``lookup(tokens)`` blocks (capped by len(block_ids)) and
+        scatters them; returns (updated caches, blocks_loaded).
+        """
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        hit = self._lookup_chains(chains)
+        n = min(hit, len(block_ids))
+        if n == 0:
+            return list(caches), 0
+        try:
+            out = await self._reader.read(
+                caches, np.asarray(block_ids[:n]), self._key_fn(chains[:n])
+            )
+        except InfiniStoreKeyNotFound:
+            # Blocks raced away (eviction/delete between lookup and read):
+            # cache semantics — the engine just recomputes.
+            return list(caches), 0
+        return out, n
+
+    def drop(self, token_ids) -> int:
+        """Remove this prompt's blocks from the store (all layers). Returns
+        the number of store keys deleted."""
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        keys = [
+            self.block_key(layer, kind, c)
+            for layer in range(self.spec.num_layers)
+            for kind in ("k", "v")
+            for c in chains
+        ]
+        return self.conn.delete_keys(keys) if keys else 0

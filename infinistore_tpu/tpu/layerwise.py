@@ -89,8 +89,15 @@ class LayerwiseKVWriter:
         bn = self.spec.block_nbytes
         pending = None  # (blocks list of (key, offset)) awaiting network put
         total = 0
-        for layer, (k_cache, v_cache) in enumerate(caches):
-            region = layer % 2
+        # Layer 0 is written LAST: connectors use a block's layer-0 K key as
+        # the presence sentinel for the whole block (one prefix-match probe
+        # instead of layers x 2), so it must commit only after every deeper
+        # layer did — a half-saved block then reads as absent, never as a
+        # false hit.
+        order = list(range(1, len(caches))) + [0] if len(caches) > 1 else [0]
+        for pos, layer in enumerate(order):
+            k_cache, v_cache = caches[layer]
+            region = pos % 2
             # Device-side gather + async D2H into this region.
             k_blocks = gather_blocks(k_cache, ids_dev)
             v_blocks = gather_blocks(v_cache, ids_dev)

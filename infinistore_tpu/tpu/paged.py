@@ -51,9 +51,20 @@ class PagedKVCacheSpec:
         return int(np.prod(self.block_shape)) * jnp.dtype(self.dtype).itemsize
 
     def make_caches(self) -> List[Tuple[jax.Array, jax.Array]]:
-        """Fresh zeroed (K, V) cache pair per layer."""
-        z = jnp.zeros(self.cache_shape, dtype=self.dtype)
-        return [(z, z) for _ in range(self.num_layers)]
+        """Fresh zeroed (K, V) cache pair per layer.
+
+        Every entry is a *distinct* buffer: scatter_blocks donates its cache
+        argument (in-place update on TPU), so aliasing one zeros array across
+        K/V/layers would leave dead buffers behind the first scatter. (The CPU
+        backend ignores donation, which masks the bug in CPU-only tests.)
+        """
+        return [
+            (
+                jnp.zeros(self.cache_shape, dtype=self.dtype),
+                jnp.zeros(self.cache_shape, dtype=self.dtype),
+            )
+            for _ in range(self.num_layers)
+        ]
 
 
 # ---------------------------------------------------------------------------

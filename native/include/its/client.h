@@ -91,6 +91,7 @@ class Connection {
 
   private:
     struct Request;
+    struct SyncState;
     struct ShmMap {
         char* base = nullptr;
         size_t size = 0;
@@ -102,8 +103,11 @@ class Connection {
     bool flush_send();
     bool read_ready();
     void complete(std::unique_ptr<Request> req, int code);
+    // timeout_ms < 0 = wait forever; on timeout returns kStatusUnavailable
+    // and abandons the wait (a late response completes into shared state).
     uint32_t sync_roundtrip(std::unique_ptr<Request> req, std::vector<uint8_t>* body_out,
-                            uint8_t** payload_out, size_t* payload_size_out);
+                            uint8_t** payload_out, size_t* payload_size_out,
+                            int timeout_ms = -1);
     bool base_registered(const void* base, size_t span) const;
     void shm_handshake();
     char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);

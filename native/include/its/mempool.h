@@ -73,6 +73,7 @@ class MemoryPool {
     size_t used_blocks_ = 0;
     bool pinned_ = false;
     bool shm_backed_ = false;
+    int shm_fd_ = -1;  // kept open: holds the liveness flock for sweep
     std::string shm_name_;
     std::vector<uint64_t> bitmap_;  // 1 = used
 };

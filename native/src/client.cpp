@@ -13,6 +13,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdlib>
 #include <future>
 
@@ -20,6 +21,21 @@
 #include "its/log.h"
 
 namespace its {
+
+// Shared landing zone for sync ops. The waiter and the Request each hold a
+// reference, so a caller that times out can abandon the wait and a late
+// completion still has a live place to write (no use-after-free).
+struct Connection::SyncState {
+    std::promise<void> prom;
+    uint32_t status = kStatusUnavailable;
+    std::vector<uint8_t> body;
+    uint8_t* payload = nullptr;  // malloc'd; freed here unless the waiter takes it
+    size_t payload_size = 0;
+
+    ~SyncState() {
+        if (payload != nullptr) free(payload);
+    }
+};
 
 struct Connection::Request {
     uint8_t op = 0;
@@ -43,16 +59,21 @@ struct Connection::Request {
     CompletionCb cb = nullptr;
     void* ctx = nullptr;
 
-    // sync completion: results are written through these before set_value()
-    std::promise<void>* prom = nullptr;
-    uint32_t* out_status = nullptr;
-    std::vector<uint8_t>* out_body = nullptr;
-    uint8_t** out_payload = nullptr;
-    size_t* out_payload_size = nullptr;
+    // sync completion
+    std::shared_ptr<SyncState> sync;
 
     // reactor-side response capture
     uint8_t* rx_buf = nullptr;
     size_t rx_buf_size = 0;
+
+    // (Re)compute the wire framing before (re)queueing for send.
+    void prime() {
+        hdr = ReqHeader{kMagic, op, static_cast<uint32_t>(body.size())};
+        sent = 0;
+        send_total = sizeof(ReqHeader) + body.size();
+        if (payload_on_wire)
+            for (const auto& io : tx_payload) send_total += io.iov_len;
+    }
 };
 
 Connection::Connection(const ClientConfig& config) : config_(config) {}
@@ -130,7 +151,10 @@ void Connection::shm_handshake() {
     auto req = std::make_unique<Request>();
     req->op = kOpShmHello;
     std::vector<uint8_t> body;
-    uint32_t status = sync_roundtrip(std::move(req), &body, nullptr, nullptr);
+    // Bounded wait: connect() promises connect_timeout_ms overall; a server
+    // that accepted but never answers must not hang the caller forever.
+    uint32_t status =
+        sync_roundtrip(std::move(req), &body, nullptr, nullptr, config_.connect_timeout_ms);
     if (status != kStatusOk || body.empty()) return;
     try {
         ShmLocResp resp = ShmLocResp::decode(body.data(), body.size());
@@ -201,10 +225,7 @@ bool Connection::base_registered(const void* base, size_t span) const {
 }
 
 int Connection::submit(std::unique_ptr<Request> req) {
-    req->hdr = ReqHeader{kMagic, req->op, static_cast<uint32_t>(req->body.size())};
-    req->send_total = sizeof(ReqHeader) + req->body.size();
-    if (req->payload_on_wire)
-        for (const auto& io : req->tx_payload) req->send_total += io.iov_len;
+    req->prime();
     {
         std::lock_guard<std::mutex> lock(submit_mu_);
         if (!connected_.load()) return -1;
@@ -264,18 +285,28 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
 
 uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
                                     std::vector<uint8_t>* body_out, uint8_t** payload_out,
-                                    size_t* payload_size_out) {
-    std::promise<void> done;
-    uint32_t status = kStatusUnavailable;
-    req->prom = &done;
-    req->out_status = &status;
-    req->out_body = body_out;
-    req->out_payload = payload_out;
-    req->out_payload_size = payload_size_out;
-    auto fut = done.get_future();
+                                    size_t* payload_size_out, int timeout_ms) {
+    auto state = std::make_shared<SyncState>();
+    req->sync = state;
+    auto fut = state->prom.get_future();
     if (submit(std::move(req)) != 0) return kStatusUnavailable;
-    fut.wait();
-    return status;
+    if (timeout_ms >= 0) {
+        if (fut.wait_for(std::chrono::milliseconds(timeout_ms)) !=
+            std::future_status::ready) {
+            // Abandon: the Request keeps the shared state alive, so a late
+            // response completes harmlessly and FIFO matching stays intact.
+            return kStatusUnavailable;
+        }
+    } else {
+        fut.wait();
+    }
+    if (body_out != nullptr) *body_out = std::move(state->body);
+    if (payload_out != nullptr) {
+        *payload_out = state->payload;
+        *payload_size_out = state->payload_size;
+        state->payload = nullptr;  // ownership to the caller
+    }
+    return state->status;
 }
 
 int Connection::tcp_put(const std::string& key, const void* data, size_t size) {
@@ -343,15 +374,13 @@ std::string Connection::stat_json() {
 }
 
 void Connection::complete(std::unique_ptr<Request> req, int code) {
-    if (req->prom != nullptr) {
-        *req->out_status = static_cast<uint32_t>(code);
-        if (req->out_body != nullptr) *req->out_body = std::move(rbody_);
-        if (req->out_payload != nullptr) {
-            *req->out_payload = req->rx_buf;
-            *req->out_payload_size = req->rx_buf_size;
-            req->rx_buf = nullptr;
-        }
-        req->prom->set_value();
+    if (req->sync != nullptr) {
+        req->sync->status = static_cast<uint32_t>(code);
+        req->sync->body = std::move(rbody_);
+        req->sync->payload = req->rx_buf;
+        req->sync->payload_size = req->rx_buf_size;
+        req->rx_buf = nullptr;
+        req->sync->prom.set_value();
     } else if (req->cb != nullptr) {
         req->cb(req->ctx, code);
     }
@@ -515,11 +544,7 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
         ITS_LOG_WARN("shm fast path degraded; retrying over the socket");
         r->op = put ? kOpPutBatch : kOpGetBatch;
         r->payload_on_wire = true;
-        r->sent = 0;
-        r->hdr = ReqHeader{kMagic, r->op, static_cast<uint32_t>(r->body.size())};
-        r->send_total = sizeof(ReqHeader) + r->body.size();
-        if (r->payload_on_wire)
-            for (const auto& io : r->tx_payload) r->send_total += io.iov_len;
+        r->prime();
         return r;
     };
     if (status == kStatusRetry) {
@@ -586,9 +611,7 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
         req->body.clear();
         TicketMeta{resp.ticket}.encode(req->body);
         req->tx_payload.clear();
-        req->sent = 0;
-        req->hdr = ReqHeader{kMagic, req->op, static_cast<uint32_t>(req->body.size())};
-        req->send_total = sizeof(ReqHeader) + req->body.size();
+        req->prime();
         return req;
     }
     for (size_t i = 0; i < n; i++) memcpy(req->rx_addrs[i], at[i], resp.locs[i].size);
@@ -602,8 +625,7 @@ void Connection::queue_release(uint64_t ticket) {
     rel->op = kOpRelease;
     TicketMeta{ticket}.encode(rel->body);
     rel->no_response = true;
-    rel->hdr = ReqHeader{kMagic, rel->op, static_cast<uint32_t>(rel->body.size())};
-    rel->send_total = sizeof(ReqHeader) + rel->body.size();
+    rel->prime();
     sendq_.push_back(std::move(rel));
 }
 

@@ -3,7 +3,7 @@
 #include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
-#include <signal.h>
+#include <sys/file.h>
 #include <strings.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -63,17 +63,20 @@ void shm_registry_unlink_all() {
 }
 
 void shm_sweep_stale() {
-    // Unlink segments left by SIGKILLed servers: /dev/shm entries named
-    // its.<pid>.<rand>.<idx> whose pid no longer exists.
+    // Unlink segments left by SIGKILLed servers. Liveness is decided by
+    // flock, not pid probing: every live pool holds LOCK_EX on its segment
+    // fd, and locks die with the owner — correct even when servers live in
+    // different pid namespaces sharing one /dev/shm mount.
     DIR* d = opendir("/dev/shm");
     if (d == nullptr) return;
     while (dirent* e = readdir(d)) {
         if (strncmp(e->d_name, "its.", 4) != 0) continue;
-        long pid = strtol(e->d_name + 4, nullptr, 10);
-        if (pid <= 0 || kill(static_cast<pid_t>(pid), 0) == 0 || errno != ESRCH) continue;
         std::string name = std::string("/") + e->d_name;
-        if (shm_unlink(name.c_str()) == 0)
-            ITS_LOG_INFO("swept stale shm segment %s (pid %ld is gone)", name.c_str(), pid);
+        int fd = shm_open(name.c_str(), O_RDWR, 0);
+        if (fd < 0) continue;
+        if (flock(fd, LOCK_EX | LOCK_NB) == 0 && shm_unlink(name.c_str()) == 0)
+            ITS_LOG_INFO("swept stale shm segment %s (owner is gone)", name.c_str());
+        close(fd);  // releases our probe lock
     }
     closedir(d);
 }
@@ -87,32 +90,43 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
     total_blocks_ = pool_size / block_size;
 
     if (!shm_name.empty()) {
+        int err = 0;
         int fd = shm_open(shm_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+        if (fd < 0) err = errno;
         // posix_fallocate (not just ftruncate): reserve the tmpfs pages now so
         // an over-committed /dev/shm fails cleanly here — triggering the
         // anonymous fallback — instead of SIGBUSing the first touch mid-put.
-        if (fd >= 0 && (ftruncate(fd, static_cast<off_t>(pool_size)) != 0 ||
-                        posix_fallocate(fd, 0, static_cast<off_t>(pool_size)) != 0)) {
-            close(fd);
-            shm_unlink(shm_name.c_str());
-            fd = -1;
+        // (It returns its error code without setting errno.)
+        if (fd >= 0) {
+            if (ftruncate(fd, static_cast<off_t>(pool_size)) != 0) err = errno;
+            if (err == 0) err = posix_fallocate(fd, 0, static_cast<off_t>(pool_size));
+            if (err != 0) {
+                close(fd);
+                shm_unlink(shm_name.c_str());
+                fd = -1;
+            }
         }
         if (fd >= 0) {
             void* mem =
                 mmap(nullptr, pool_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-            close(fd);  // the mapping keeps the segment alive
             if (mem != MAP_FAILED) {
                 base_ = static_cast<char*>(mem);
                 shm_backed_ = true;
                 shm_name_ = shm_name;
+                shm_fd_ = fd;
+                // Liveness marker for shm_sweep_stale: held until destruction
+                // (or process death, which is the point).
+                flock(shm_fd_, LOCK_EX | LOCK_NB);
                 shm_registry_add(shm_name.c_str());
             } else {
+                err = errno;
+                close(fd);
                 shm_unlink(shm_name.c_str());
             }
         }
         if (!shm_backed_)
             ITS_LOG_WARN("shm pool %s unavailable (%s); falling back to anonymous memory",
-                         shm_name.c_str(), strerror(errno));
+                         shm_name.c_str(), strerror(err));
     }
     if (base_ == nullptr) {
         void* mem = nullptr;
@@ -142,6 +156,7 @@ MemoryPool::~MemoryPool() {
             munmap(base_, pool_size_);
             shm_unlink(shm_name_.c_str());
             shm_registry_remove(shm_name_.c_str());
+            close(shm_fd_);  // releases the liveness flock
         } else {
             free(base_);
         }

@@ -576,7 +576,9 @@ void Server::handle_tcp_put(Conn* c) {
 // straight into/out of the shm-mapped pools (zero-copy in the same sense as
 // the reference's one-sided RDMA: one data movement, placed by the server).
 void Server::handle_shm(Conn* c) {
-    std::vector<PoolDirEntry> dir = mm_->pool_dir();
+    // Filled only by the ops that need it (Hello / PutAlloc / GetLoc) —
+    // PutCommit and Release are the per-batch hot ops and skip the copies.
+    std::vector<PoolDirEntry> dir;
     // Shared tail: embed the mappable-pool directory and send.
     auto send_loc_resp = [this, c, &dir](ShmLocResp& resp) {
         for (const auto& e : dir)
@@ -598,6 +600,7 @@ void Server::handle_shm(Conn* c) {
     };
     switch (c->hdr.op) {
         case kOpShmHello: {
+            dir = mm_->pool_dir();
             ShmLocResp resp;
             send_loc_resp(resp);
             return;
@@ -668,6 +671,7 @@ void Server::handle_shm(Conn* c) {
             return;
         }
         case kOpGetLoc: {
+            dir = mm_->pool_dir();
             BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
             if (m.keys.empty() || m.block_size == 0 || !mm_->shm_enabled()) {
                 c->reset_read();

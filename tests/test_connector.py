@@ -1,0 +1,136 @@
+"""KVConnector: the LMCache-style engine glue (BASELINE.md config 4).
+
+Covers the chain-hash key scheme (prefix property), cross-request prefix
+reuse (lookup -> load skips recompute), save/load roundtrip through the real
+loopback store, and drop().
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu import KVConnector, token_chain_hashes
+from infinistore_tpu.tpu import PagedKVCacheSpec, gather_blocks
+
+SPEC = PagedKVCacheSpec(
+    num_layers=3, num_blocks=16, block_tokens=8, num_kv_heads=2, head_dim=32,
+    dtype=jnp.bfloat16,
+)
+
+
+def _rand_caches(seed):
+    out = []
+    for layer in range(SPEC.num_layers):
+        k = jax.random.normal(
+            jax.random.PRNGKey(seed * 100 + layer), SPEC.cache_shape, jnp.float32
+        ).astype(SPEC.dtype)
+        v = jax.random.normal(
+            jax.random.PRNGKey(seed * 100 + 50 + layer), SPEC.cache_shape, jnp.float32
+        ).astype(SPEC.dtype)
+        out.append((k, v))
+    return out
+
+
+def test_chain_hashes_prefix_property():
+    a = list(range(40))
+    b = list(range(24)) + [99, 98, 97, 96, 95, 94, 93, 92] + list(range(8))
+    ha, hb = token_chain_hashes(a, 8), token_chain_hashes(b, 8)
+    assert len(ha) == 5
+    assert ha[:3] == hb[:3]  # shared 24-token prefix -> same first 3 chains
+    assert ha[3] != hb[3]  # divergence poisons every later chain
+    assert ha[4] != hb[4]
+    # Incomplete tail block is excluded.
+    assert len(token_chain_hashes(list(range(15)), 8)) == 1
+    assert token_chain_hashes([], 8) == []
+
+
+@pytest.fixture()
+def connector(conn):
+    return KVConnector(conn, SPEC, model_id="demo-llama", max_blocks=8)
+
+
+def test_lookup_miss_then_save_then_hit(connector):
+    tokens = list(range(32))  # 4 complete blocks
+    assert connector.lookup(tokens) == 0
+    caches = _rand_caches(1)
+    block_ids = np.array([3, 7, 1, 9], dtype=np.int32)
+    written = asyncio.run(connector.save(tokens, caches, block_ids))
+    assert written == 4 * 2 * SPEC.num_layers  # K+V per layer per block
+    assert connector.lookup(tokens) == 4
+    # A prompt sharing 2 blocks then diverging hits exactly 2.
+    other = list(range(16)) + [500 + i for i in range(16)]
+    assert connector.lookup(other) == 2
+
+
+def test_save_load_roundtrip_scatters_correct_blocks(connector):
+    tokens = list(range(24))  # 3 blocks
+    caches = _rand_caches(2)
+    src_ids = np.array([2, 11, 5], dtype=np.int32)
+    asyncio.run(connector.save(tokens, caches, src_ids))
+
+    fresh = SPEC.make_caches()
+    dst_ids = np.array([8, 0, 14], dtype=np.int32)
+    loaded, n = asyncio.run(connector.load(tokens, fresh, dst_ids))
+    assert n == 3
+    ids_src = jnp.asarray(src_ids)
+    ids_dst = jnp.asarray(dst_ids)
+    for layer in range(SPEC.num_layers):
+        for side in (0, 1):
+            want = np.asarray(gather_blocks(caches[layer][side], ids_src))
+            got = np.asarray(gather_blocks(loaded[layer][side], ids_dst))
+            np.testing.assert_array_equal(want, got)
+
+
+def test_load_partial_prefix(connector):
+    """Only the cached prefix is fetched; the divergent tail is untouched."""
+    base = list(range(16))  # 2 blocks saved
+    caches = _rand_caches(3)
+    asyncio.run(connector.save(base, caches, np.array([1, 2], dtype=np.int32)))
+
+    longer = base + [900 + i for i in range(16)]  # 4 blocks, 2 cached
+    fresh = SPEC.make_caches()
+    loaded, n = asyncio.run(
+        connector.load(longer, fresh, np.array([4, 5, 6, 7], dtype=np.int32))
+    )
+    assert n == 2
+    # Block 6/7 (would-be blocks 3/4) stay zero.
+    for layer in range(SPEC.num_layers):
+        assert float(jnp.abs(loaded[layer][0][6]).sum()) == 0.0
+        assert float(jnp.abs(loaded[layer][0][7]).sum()) == 0.0
+
+
+def test_writer_commits_layer0_last(connector, conn):
+    """The lookup sentinel (layer-0 K key) must be written after all deeper
+    layers, so a half-saved block reads as absent rather than a false hit."""
+    order = []
+    orig = conn.write_cache_async
+
+    async def spy(blocks, block_size, ptr):
+        order.extend(k for k, _ in blocks)
+        return await orig(blocks, block_size, ptr)
+
+    conn.write_cache_async = spy
+    try:
+        tokens = list(range(16))
+        asyncio.run(
+            connector.save(tokens, _rand_caches(9), np.array([0, 1], dtype=np.int32))
+        )
+    finally:
+        conn.write_cache_async = orig
+    layer0_positions = [i for i, k in enumerate(order) if "/L0/" in k]
+    others = [i for i, k in enumerate(order) if "/L0/" not in k]
+    assert layer0_positions and others
+    assert min(layer0_positions) > max(others)
+
+
+def test_drop_removes_all_layers(connector, conn):
+    tokens = list(range(16))
+    caches = _rand_caches(4)
+    asyncio.run(connector.save(tokens, caches, np.array([0, 1], dtype=np.int32)))
+    assert connector.lookup(tokens) == 2
+    deleted = connector.drop(tokens)
+    assert deleted == 2 * 2 * SPEC.num_layers
+    assert connector.lookup(tokens) == 0

@@ -119,6 +119,42 @@ def test_shm_oom_is_immediate_507():
     srv.stop()
 
 
+def test_stale_segment_sweep_spares_live_pools():
+    """Startup sweep unlinks orphaned its.* segments (flock released = owner
+    dead) but must not touch a running server's pools."""
+    import os
+
+    # Plant a fake orphan: nobody holds a lock on it.
+    orphan = f"/its.999999.deadbeef.0"
+    path = "/dev/shm" + orphan
+    with open(path, "wb") as f:
+        f.write(b"\0" * 4096)
+    live = its.start_local_server(prealloc_bytes=8 << 20, block_bytes=16 << 10)
+    try:
+        # A second server's MM constructor runs the sweep.
+        other = its.start_local_server(prealloc_bytes=8 << 20, block_bytes=16 << 10)
+        other.stop()
+        assert not os.path.exists(path), "orphan segment not swept"
+        # The live server's pools survived: a client can still use them.
+        c = its.InfinityConnection(
+            its.ClientConfig(host_addr="127.0.0.1", service_port=live.port, log_level="error")
+        )
+        c.connect()
+        assert c.shm_active
+        data = np.ones(4096, dtype=np.uint8)
+        dst = np.zeros_like(data)
+        c.register_mr(data)
+        c.register_mr(dst)
+        asyncio.run(c.write_cache_async([("live", 0)], 4096, data.ctypes.data))
+        asyncio.run(c.read_cache_async([("live", 0)], 4096, dst.ctypes.data))
+        assert np.array_equal(data, dst)
+        c.close()
+    finally:
+        live.stop()
+        if os.path.exists(path):
+            os.unlink(path)
+
+
 def test_auto_extend_pool_mapped_on_demand():
     """Writes spilling into an auto-extended pool must reach the client via
     the directory embedded in responses — no re-handshake."""
