@@ -124,6 +124,8 @@ int Connection::connect() {
     }
     int one = 1;
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // SO_SNDBUF/SO_RCVBUF intentionally left to kernel autotuning (see
+    // server accept path).
 
     epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
     wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
