@@ -1,0 +1,48 @@
+"""Fully-async single connection: connect_async, then write/read one batched
+op per iteration in a loop (reference example/client_async_single.py's
+connect-loop shape, minus its blocking-connect FIXME — ours awaits).
+"""
+
+import asyncio
+import uuid
+
+import numpy as np
+
+from common import parse_args
+
+import infinistore_tpu as its
+
+
+async def run(args):
+    srv = None
+    port = args.service_port
+    if port == 0:
+        srv = its.start_local_server()
+        port = srv.port
+        print(f"(started in-process server on :{port})")
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr=args.host, service_port=port)
+    )
+    await conn.connect_async()  # non-blocking connect inside the loop
+    try:
+        n_blocks, block = 16, 64 << 10
+        src = np.random.randint(0, 256, size=n_blocks * block, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        for it in range(5):
+            run_id = uuid.uuid4().hex[:8]
+            blocks = [(f"as-{run_id}-{i}", i * block) for i in range(n_blocks)]
+            await conn.write_cache_async(blocks, block, src.ctypes.data)
+            await conn.read_cache_async(blocks, block, dst.ctypes.data)
+            assert np.array_equal(src, dst)
+            conn.delete_keys([k for k, _ in blocks])
+            print(f"iteration {it}: {n_blocks} blocks round-tripped")
+    finally:
+        conn.close()
+        if srv is not None:
+            srv.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(run(parse_args()))
