@@ -8,21 +8,13 @@ import uuid
 
 import numpy as np
 
-from common import parse_args
+from common import make_connection, parse_args
 
 import infinistore_tpu as its
 
 
 async def run(args):
-    srv = None
-    port = args.service_port
-    if port == 0:
-        srv = its.start_local_server()
-        port = srv.port
-        print(f"(started in-process server on :{port})")
-    conn = its.InfinityConnection(
-        its.ClientConfig(host_addr=args.host, service_port=port)
-    )
+    conn, cleanup = make_connection(args)
     await conn.connect_async()  # non-blocking connect inside the loop
     try:
         n_blocks, block = 16, 64 << 10
@@ -39,9 +31,7 @@ async def run(args):
             conn.delete_keys([k for k, _ in blocks])
             print(f"iteration {it}: {n_blocks} blocks round-tripped")
     finally:
-        conn.close()
-        if srv is not None:
-            srv.stop()
+        cleanup()
 
 
 if __name__ == "__main__":

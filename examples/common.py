@@ -23,7 +23,10 @@ def parse_args():
     return p.parse_args()
 
 
-def get_connection(args):
+def make_connection(args):
+    """Build (but do not connect) a client, starting an in-process server if
+    no --service-port was given. For async examples that `await
+    conn.connect_async()` themselves."""
     srv = None
     port = args.service_port
     if port == 0:
@@ -33,11 +36,16 @@ def get_connection(args):
     conn = its.InfinityConnection(
         its.ClientConfig(host_addr=args.host, service_port=port)
     )
-    conn.connect()
 
     def cleanup():
         conn.close()
         if srv is not None:
             srv.stop()
 
+    return conn, cleanup
+
+
+def get_connection(args):
+    conn, cleanup = make_connection(args)
+    conn.connect()
     return conn, cleanup

@@ -24,51 +24,74 @@ from infinistore_tpu.tpu import (
 )
 
 
+def build(conn):
+    cfg = LlamaConfig(
+        vocab=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=256, block_tokens=8, dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))  # deterministic: both
+    spec = cfg.kv_spec(num_blocks=32)                 # roles derive the same
+    n_prompt_blocks = 2
+    pool = HostStagingPool(
+        nbytes=4 * n_prompt_blocks * 2 * spec.block_nbytes,
+        block_size=spec.block_nbytes,
+        conn=conn,
+    )
+    key_fn = lambda l, k, i: kv_block_key("demo-llama", "req-hash-001", l, k, i)
+    return cfg, params, spec, n_prompt_blocks, pool, key_fn
+
+
+def run_prefill(conn):
+    cfg, params, spec, n_blocks, pool, key_fn = build(conn)
+    prompt = jnp.arange(16, dtype=jnp.int32) % cfg.vocab
+    table = jnp.array([4, 11], dtype=jnp.int32)
+    _, caches = prefill(params, prompt, spec.make_caches(), table, cfg)
+    writer = LayerwiseKVWriter(conn, pool, spec, max_blocks=n_blocks)
+    written = asyncio.run(writer.write(caches, np.asarray(table), key_fn))
+    print(f"prefill host: streamed {written} KV blocks to the store")
+
+
+def run_decode(conn):
+    cfg, params, spec, n_blocks, pool, key_fn = build(conn)
+    decode_table = jnp.array([0, 1, 2, 3], dtype=jnp.int32)
+    reader = LayerwiseKVReader(conn, pool, spec, max_blocks=n_blocks)
+    decode_caches = asyncio.run(
+        reader.read(spec.make_caches(), np.asarray(decode_table[:2]), key_fn)
+    )
+    print("decode host: fetched prompt KV from the store")
+
+    token, position = jnp.int32(1), 16
+    generated = []
+    for _ in range(8):
+        logits, decode_caches = decode_step(
+            params, token, jnp.int32(position), decode_caches, decode_table, cfg, 4,
+        )
+        token = jnp.argmax(logits).astype(jnp.int32)
+        generated.append(int(token))
+        position += 1
+    print("decode host: generated tokens", generated)
+
+
 def main():
+    import argparse
+    import sys
+
+    # Extra --role flag on top of the shared example args. In a real
+    # deployment prefill and decode are separate hosts: run this script twice
+    # against one server, `--role prefill` then `--role decode`.
+    extra = argparse.ArgumentParser(add_help=False)
+    extra.add_argument("--role", choices=["both", "prefill", "decode"], default="both")
+    ns, rest = extra.parse_known_args()
+    sys.argv = [sys.argv[0]] + rest
     args = parse_args()
+    if ns.role != "both" and args.service_port == 0:
+        raise SystemExit("--role prefill/decode needs --service-port of a shared server")
     conn, cleanup = get_connection(args)
     try:
-        cfg = LlamaConfig(
-            vocab=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
-            ffn_dim=256, block_tokens=8, dtype=jnp.float32,
-        )
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        spec = cfg.kv_spec(num_blocks=32)
-        n_prompt_blocks = 2
-        pool = HostStagingPool(
-            nbytes=4 * n_prompt_blocks * 2 * spec.block_nbytes,
-            block_size=spec.block_nbytes,
-            conn=conn,
-        )
-        key_fn = lambda l, k, i: kv_block_key("demo-llama", "req-hash-001", l, k, i)
-
-        # --- prefill host ---
-        prompt = jnp.arange(16, dtype=jnp.int32) % cfg.vocab
-        table = jnp.array([4, 11], dtype=jnp.int32)
-        _, caches = prefill(params, prompt, spec.make_caches(), table, cfg)
-        writer = LayerwiseKVWriter(conn, pool, spec, max_blocks=n_prompt_blocks)
-        written = asyncio.run(writer.write(caches, np.asarray(table), key_fn))
-        print(f"prefill host: streamed {written} KV blocks to the store")
-
-        # --- decode host (fresh process in real deployments) ---
-        decode_table = jnp.array([0, 1, 2, 3], dtype=jnp.int32)
-        reader = LayerwiseKVReader(conn, pool, spec, max_blocks=n_prompt_blocks)
-        decode_caches = asyncio.run(
-            reader.read(spec.make_caches(), np.asarray(decode_table[:2]), key_fn)
-        )
-        print("decode host: fetched prompt KV from the store")
-
-        token, position = jnp.int32(1), 16
-        generated = []
-        for step in range(8):
-            logits, decode_caches = decode_step(
-                params, token, jnp.int32(position), decode_caches, decode_table,
-                cfg, 4,
-            )
-            token = jnp.argmax(logits).astype(jnp.int32)
-            generated.append(int(token))
-            position += 1
-        print("decode host: generated tokens", generated)
+        if ns.role in ("both", "prefill"):
+            run_prefill(conn)
+        if ns.role in ("both", "decode"):
+            run_decode(conn)
     finally:
         cleanup()
 

@@ -90,10 +90,48 @@ def _http_response(status: int, payload: dict) -> bytes:
     ).encode() + body
 
 
+def _prometheus_text(stats: dict) -> bytes:
+    """Render the stats snapshot in Prometheus exposition format (the
+    reference exposes no metrics at all — SURVEY.md §5.1/§5.5)."""
+    lines = [
+        "# TYPE infinistore_kvmap_entries gauge",
+        f"infinistore_kvmap_entries {stats['kvmap_len']}",
+        "# TYPE infinistore_pool_usage_ratio gauge",
+        f"infinistore_pool_usage_ratio {stats['usage']:.6f}",
+        "# TYPE infinistore_pool_bytes gauge",
+        f'infinistore_pool_bytes{{kind="total"}} {stats["total_bytes"]}',
+        f'infinistore_pool_bytes{{kind="used"}} {stats["used_bytes"]}',
+        "# TYPE infinistore_connections gauge",
+        f"infinistore_connections {stats['connections']}",
+    ]
+    # Exposition format requires all samples of a family in one uninterrupted
+    # group after its TYPE line — one pass per family, not per op.
+    ops = sorted(stats.get("ops", {}).items())
+    lines.append("# TYPE infinistore_op_count counter")
+    for op, s in ops:
+        lines.append(f'infinistore_op_count{{op="{op}",result="ok"}} '
+                     f'{s["count"] - s["errors"]}')
+        lines.append(f'infinistore_op_count{{op="{op}",result="error"}} {s["errors"]}')
+    lines.append("# TYPE infinistore_op_bytes counter")
+    for op, s in ops:
+        lines.append(f'infinistore_op_bytes{{op="{op}",dir="in"}} {s["bytes_in"]}')
+        lines.append(f'infinistore_op_bytes{{op="{op}",dir="out"}} {s["bytes_out"]}')
+    lines.append("# TYPE infinistore_op_p50_latency_us gauge")
+    for op, s in ops:
+        lines.append(f'infinistore_op_p50_latency_us{{op="{op}"}} {s["p50_us"]}')
+    body = ("\n".join(lines) + "\n").encode()
+    return (
+        f"HTTP/1.1 200 OK\r\n"
+        f"Content-Type: text/plain; version=0.0.4\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n"
+    ).encode() + body
+
+
 class ManageServer:
     """The management plane: /purge, /kvmap_len (reference server.py:25-39),
     /selftest (advertised in reference README.md:56-57 but missing), /stats,
-    /usage, /health."""
+    /usage, /metrics (Prometheus), /health."""
 
     def __init__(self, config: ServerConfig):
         self.config = config
@@ -139,11 +177,15 @@ class ManageServer:
             if path == "/usage" and method == "GET":
                 stats = await asyncio.to_thread(_lib.get_server_stats)
                 return _http_response(200, {"usage": stats["usage"]})
+            if path == "/metrics" and method == "GET":
+                stats = await asyncio.to_thread(_lib.get_server_stats)
+                return _prometheus_text(stats)
             if path == "/health" and method == "GET":
                 return _http_response(200, {"status": "ok"})
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
-            if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/selftest", "/health"):
+            if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
+                        "/selftest", "/health"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request

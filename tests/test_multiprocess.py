@@ -1,0 +1,81 @@
+"""Multi-process clients against one server — the reference's concurrency
+test shape (two `multiprocessing.Process` clients, reference
+infinistore/test_infinistore.py:217-268) plus the cross-process handoff the
+disaggregation story depends on: a producer process writes over the shm fast
+path, a separate consumer process reads the same keys over the DCN socket
+path (the cross-host transport), so the test proves the two data planes see
+one consistent store."""
+
+import subprocess
+import sys
+
+import infinistore_tpu as its
+
+_CLIENT = r"""
+import asyncio, sys
+import numpy as np
+import infinistore_tpu as its
+
+port, tag, mode, use_shm = int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4] == "1"
+conn = its.InfinityConnection(its.ClientConfig(
+    host_addr="127.0.0.1", service_port=port, log_level="error", enable_shm=use_shm))
+conn.connect()
+assert conn.shm_active == use_shm, f"shm_active={conn.shm_active} want={use_shm}"
+n, block = 32, 16 << 10
+buf = np.full(n * block, (ord(tag[0]) + 7) % 256, dtype=np.uint8)
+pairs = [(f"{tag}-{i}", i * block) for i in range(n)]
+conn.register_mr(buf)
+if mode in ("write", "both"):
+    asyncio.run(conn.write_cache_async(pairs, block, buf.ctypes.data))
+if mode in ("read", "both"):
+    dst = np.zeros(n * block, dtype=np.uint8)
+    conn.register_mr(dst)
+    asyncio.run(conn.read_cache_async(pairs, block, dst.ctypes.data))
+    expect = np.full(n * block, (ord(tag[0]) + 7) % 256, dtype=np.uint8)
+    assert np.array_equal(dst, expect), "cross-process data mismatch"
+conn.close()
+print("ok")
+"""
+
+
+def _run_client(port, tag, mode, use_shm):
+    return subprocess.run(
+        [sys.executable, "-c", _CLIENT, str(port), tag, mode, "1" if use_shm else "0"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_two_concurrent_client_processes(server):
+    """Two separate OS processes writing+reading disjoint keysets."""
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CLIENT, str(server["port"]), tag, "both", "1"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for tag in ("alpha", "beta")
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"client failed: {err}"
+        assert "ok" in out
+
+
+def test_cross_process_shm_write_dcn_read(server):
+    """Producer writes via shm fast path; a different process reads the same
+    keys via the socket path (what a remote decode host would use)."""
+    r = _run_client(server["port"], "handoff", "write", use_shm=True)
+    assert r.returncode == 0, r.stderr
+    r = _run_client(server["port"], "handoff", "read", use_shm=False)
+    assert r.returncode == 0, r.stderr
+
+
+def test_cross_process_dcn_write_shm_read(server):
+    """And the reverse direction."""
+    r = _run_client(server["port"], "ffodnah", "write", use_shm=False)
+    assert r.returncode == 0, r.stderr
+    r = _run_client(server["port"], "ffodnah", "read", use_shm=True)
+    assert r.returncode == 0, r.stderr

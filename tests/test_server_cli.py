@@ -118,6 +118,21 @@ def test_manage_stats(cli_server, cli_conn):
     assert "ops" in body and body["total_bytes"] > 0
 
 
+def test_manage_prometheus_metrics(cli_server, cli_conn):
+    data = np.zeros(1024, dtype=np.uint8)
+    cli_conn.tcp_write_cache("metrics-probe", data.ctypes.data, data.nbytes)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{cli_server['manage_port']}/metrics"
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "infinistore_kvmap_entries" in text
+    assert "infinistore_pool_usage_ratio" in text
+    assert 'infinistore_op_count{op="P",result="ok"}' in text
+
+
 def test_manage_unknown_and_wrong_method(cli_server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _manage(cli_server, "/nope")
