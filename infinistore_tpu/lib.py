@@ -203,6 +203,22 @@ class InfinityConnection:
             raise InfiniStoreException("register memory region failed")
         return ret
 
+    def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
+        """Allocate a staging buffer the server maps too (one-RTT data plane:
+        the server pulls puts out of / pushes gets into it directly — the shm
+        analogue of the reference's one-sided RDMA against registered client
+        memory). Returns a uint8 array view, or None when the server is
+        remote or shm-less (fall back to your own array + register_mr). The
+        segment lives until close()."""
+        self._require()
+        ptr = lib.its_conn_alloc_shm_mr(self._handle, nbytes)
+        if not ptr:
+            return None
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        arr._its_conn = self  # keep the connection (and mapping) alive
+        return arr
+
     # -- batched async data plane -------------------------------------------
 
     def _semaphore(self, loop) -> asyncio.BoundedSemaphore:

@@ -61,6 +61,14 @@ class Connection {
     // error, :602-605).
     int register_mr(void* ptr, size_t size);
 
+    // Allocate a shm-backed staging region the SERVER maps too: batched ops
+    // whose base pointer lies inside it use the one-RTT server-pull/push
+    // path (PutFrom/GetInto) — the closest analogue of the reference's
+    // one-sided RDMA against client-registered memory. Returns nullptr when
+    // the server is remote or shm-less (caller falls back to a normal
+    // buffer + register_mr). Freed at close().
+    void* alloc_shm_mr(size_t size);
+
     // Async batched block write: for each i, send block_size bytes from
     // base_ptr+offsets[i] under keys[i]. cb fires from the reactor thread with
     // an HTTP-like status. Returns 0 on submit, -1 if not connected /
@@ -144,6 +152,17 @@ class Connection {
 
     mutable std::mutex mr_mu_;
     std::vector<std::pair<const char*, size_t>> regions_;
+
+    // Client-owned shm staging segments (one-RTT path).
+    struct ClientSeg {
+        char* base = nullptr;
+        size_t size = 0;
+        uint16_t id = 0;
+        std::string name;  // empty once unlinked (server declined)
+        bool server_mapped = false;
+    };
+    std::vector<ClientSeg> client_segs_;  // guarded by mr_mu_
+    const ClientSeg* find_seg(const void* base, size_t span) const;
 
     // Shm fast-path state. Written at connect (handshake) and by the reactor
     // (on-demand mapping of auto-extended pools); guarded for the overlap.

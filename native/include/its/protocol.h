@@ -46,6 +46,15 @@ enum Op : uint8_t {
     kOpPutCommit = 'c',      // batched write phase 2: publish keys
     kOpGetLoc = 'g',         // batched read: pin blocks, return locations
     kOpRelease = 'r',        // drop a ticket's pinned blocks; NO response
+    // One-RTT server-pull variant: the client's registered staging region is
+    // itself a named shm segment the server maps. Put = server pulls blocks
+    // out of the client segment (the exact shape of the reference's
+    // server-initiated RDMA READ, reference docs/source/design.rst:51-52);
+    // get = server pushes into it (the RDMA WRITE analogue). One message per
+    // batch, no tickets, placement and copy both server-owned.
+    kOpRegSegment = 'B',     // register a client shm segment {id, name, size}
+    kOpPutFrom = 'F',        // pull blocks from client segment offsets; commit
+    kOpGetInto = 'I',        // push stored blocks into client segment offsets
 };
 
 // HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
@@ -285,6 +294,57 @@ struct ShmLocResp {
             p.size = r.u64();
             m.pools.push_back(p);
         }
+        return m;
+    }
+};
+
+// Client shm segment registration (RegSegment).
+struct SegMeta {
+    uint16_t seg_id = 0;
+    std::string name;
+    uint64_t size = 0;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.u16(seg_id);
+        w.str(name);
+        w.u64(size);
+    }
+    static SegMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        SegMeta m;
+        m.seg_id = r.u16();
+        m.name = r.str();
+        m.size = r.u64();
+        return m;
+    }
+};
+
+// One-RTT batched op against a registered client segment (PutFrom / GetInto):
+// block i lives at segment offset offsets[i].
+struct SegBatchMeta {
+    uint32_t block_size = 0;
+    uint16_t seg_id = 0;
+    std::vector<std::string> keys;
+    std::vector<uint64_t> offsets;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.u32(block_size);
+        w.u16(seg_id);
+        w.str_list(keys);
+        w.u32(static_cast<uint32_t>(offsets.size()));
+        for (uint64_t off : offsets) w.u64(off);
+    }
+    static SegBatchMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        SegBatchMeta m;
+        m.block_size = r.u32();
+        m.seg_id = r.u16();
+        m.keys = r.str_list();
+        uint32_t n = r.u32();
+        m.offsets.reserve(n);
+        for (uint32_t i = 0; i < n; i++) m.offsets.push_back(r.u64());
         return m;
     }
 };

@@ -124,6 +124,11 @@ int its_conn_connected(void* c) { return static_cast<Connection*>(c)->connected(
 int its_conn_register_mr(void* c, void* ptr, uint64_t size) {
     return static_cast<Connection*>(c)->register_mr(ptr, size);
 }
+// Returns the mapped base of a server-shared staging segment (one-RTT data
+// plane), or NULL when the server is remote/shm-less.
+void* its_conn_alloc_shm_mr(void* c, uint64_t size) {
+    return static_cast<Connection*>(c)->alloc_shm_mr(size);
+}
 
 int its_conn_put_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,

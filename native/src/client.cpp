@@ -9,16 +9,20 @@
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/file.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdlib>
 #include <future>
+#include <random>
 
 #include "its/iovec_util.h"
 #include "its/log.h"
+#include "its/mempool.h"  // shm_registry_* (crash-time segment cleanup)
 
 namespace its {
 
@@ -200,9 +204,21 @@ void Connection::close() {
     fd_ = wake_fd_ = epoll_fd_ = -1;
     connected_.store(false);
     shm_ok_.store(false);
-    std::lock_guard<std::mutex> lock(shm_mu_);
-    for (auto& [id, m] : shm_pools_) munmap(m.base, m.size);
-    shm_pools_.clear();
+    {
+        std::lock_guard<std::mutex> lock(shm_mu_);
+        for (auto& [id, m] : shm_pools_) munmap(m.base, m.size);
+        shm_pools_.clear();
+    }
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    for (auto& seg : client_segs_) {
+        munmap(seg.base, seg.size);
+        if (!seg.name.empty()) {
+            shm_unlink(seg.name.c_str());
+            shm_registry_remove(seg.name.c_str());
+        }
+    }
+    client_segs_.clear();
+    regions_.clear();
 }
 
 int Connection::register_mr(void* ptr, size_t size) {
@@ -224,6 +240,71 @@ bool Connection::base_registered(const void* base, size_t span) const {
         if (p >= start && p + span <= start + size) return true;
     }
     return false;
+}
+
+const Connection::ClientSeg* Connection::find_seg(const void* base, size_t span) const {
+    const char* p = static_cast<const char*>(base);
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    for (const auto& seg : client_segs_) {
+        if (seg.server_mapped && p >= seg.base && p + span <= seg.base + seg.size)
+            return &seg;
+    }
+    return nullptr;
+}
+
+void* Connection::alloc_shm_mr(size_t size) {
+    if (!config_.enable_shm || !connected_.load() || size == 0) return nullptr;
+    static std::atomic<uint32_t> counter{0};
+    uint32_t seq = counter.fetch_add(1);
+    char name[96];
+    std::random_device rd;
+    snprintf(name, sizeof(name), "/its.%d.%08x.c%u", static_cast<int>(getpid()), rd(), seq);
+    int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, static_cast<off_t>(size)) != 0 ||
+        posix_fallocate(fd, 0, static_cast<off_t>(size)) != 0) {
+        ::close(fd);
+        shm_unlink(name);
+        return nullptr;
+    }
+    void* mem = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (mem == MAP_FAILED) {
+        ::close(fd);
+        shm_unlink(name);
+        return nullptr;
+    }
+    flock(fd, LOCK_EX | LOCK_NB);  // liveness marker for shm_sweep_stale
+    // Leak fd intentionally: it holds the flock for the connection lifetime
+    // (closed implicitly at process exit; the segment itself is unlinked in
+    // close()).
+    shm_registry_add(name);
+
+    ClientSeg seg;
+    seg.base = static_cast<char*>(mem);
+    seg.size = size;
+    seg.name = name;
+    seg.id = static_cast<uint16_t>(seq);  // process-unique (mod 64k), per-conn on the server
+
+    // Ask the server to map it; a remote/shm-less server answers non-200 and
+    // we fall back to a plain (still registered) buffer.
+    auto req = std::make_unique<Request>();
+    req->op = kOpRegSegment;
+    SegMeta{seg.id, seg.name, static_cast<uint64_t>(size)}.encode(req->body);
+    uint32_t status =
+        sync_roundtrip(std::move(req), nullptr, nullptr, nullptr, config_.connect_timeout_ms);
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    regions_.emplace_back(seg.base, size);  // valid base for every path
+    if (status == kStatusOk) {
+        seg.server_mapped = true;
+        ITS_LOG_DEBUG("shm segment %s (%zu bytes) registered with server", name, size);
+    } else {
+        shm_registry_remove(name);
+        shm_unlink(name);  // mapping stays valid locally until munmap
+        seg.name.clear();
+        ITS_LOG_DEBUG("server declined shm segment (%u); using plain buffer", status);
+    }
+    client_segs_.push_back(seg);
+    return mem;
 }
 
 int Connection::submit(std::unique_ptr<Request> req) {
@@ -250,14 +331,29 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
         return -1;
     }
     auto req = std::make_unique<Request>();
-    bool shm = shm_ok_.load();
-    req->op = shm ? kOpPutAlloc : kOpPutBatch;
-    req->payload_on_wire = !shm;  // shm: blocks are memcpy'd after PutAlloc
-    BatchMeta meta{block_size, keys};
-    meta.encode(req->body);
-    req->tx_payload.reserve(keys.size());
-    for (uint64_t off : offsets)
-        req->tx_payload.push_back(iovec{static_cast<char*>(base_ptr) + off, block_size});
+    if (const ClientSeg* seg = find_seg(base_ptr, span)) {
+        // One-RTT server-pull: the server memcpys straight out of the
+        // mapped segment and commits; nothing else to do client-side.
+        req->op = kOpPutFrom;
+        SegBatchMeta m;
+        m.block_size = block_size;
+        m.seg_id = seg->id;
+        m.keys = keys;
+        m.offsets.reserve(offsets.size());
+        uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
+        for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
+        m.encode(req->body);
+        req->payload_on_wire = false;
+    } else {
+        bool shm = shm_ok_.load();
+        req->op = shm ? kOpPutAlloc : kOpPutBatch;
+        req->payload_on_wire = !shm;  // shm: blocks are memcpy'd after PutAlloc
+        BatchMeta meta{block_size, keys};
+        meta.encode(req->body);
+        req->tx_payload.reserve(keys.size());
+        for (uint64_t off : offsets)
+            req->tx_payload.push_back(iovec{static_cast<char*>(base_ptr) + off, block_size});
+    }
     req->cb = cb;
     req->ctx = ctx;
     return submit(std::move(req));
@@ -274,12 +370,26 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
         return -1;
     }
     auto req = std::make_unique<Request>();
-    req->op = shm_ok_.load() ? kOpGetLoc : kOpGetBatch;
-    BatchMeta meta{block_size, keys};
-    meta.encode(req->body);
-    req->block_size = block_size;
-    req->rx_addrs.reserve(keys.size());
-    for (uint64_t off : offsets) req->rx_addrs.push_back(static_cast<char*>(base_ptr) + off);
+    if (const ClientSeg* seg = find_seg(base_ptr, span)) {
+        // One-RTT server-push into the mapped segment; sizes land in-place.
+        req->op = kOpGetInto;
+        SegBatchMeta m;
+        m.block_size = block_size;
+        m.seg_id = seg->id;
+        m.keys = keys;
+        m.offsets.reserve(offsets.size());
+        uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
+        for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
+        m.encode(req->body);
+    } else {
+        req->op = shm_ok_.load() ? kOpGetLoc : kOpGetBatch;
+        BatchMeta meta{block_size, keys};
+        meta.encode(req->body);
+        req->block_size = block_size;
+        req->rx_addrs.reserve(keys.size());
+        for (uint64_t off : offsets)
+            req->rx_addrs.push_back(static_cast<char*>(base_ptr) + off);
+    }
     req->cb = cb;
     req->ctx = ctx;
     return submit(std::move(req));

@@ -7,6 +7,7 @@
 #include <string.h>
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
 #include <time.h>
 #include <unistd.h>
@@ -104,6 +105,18 @@ struct Server::Conn {
     uint64_t next_ticket = 1;
     std::unordered_map<uint64_t, PendingPut> pending_puts;
     std::unordered_map<uint64_t, std::vector<BlockRef>> pending_gets;
+
+    // Client shm segments mapped for the one-RTT pull/push path.
+    struct SegMap {
+        char* base = nullptr;
+        size_t size = 0;
+    };
+    std::unordered_map<uint16_t, SegMap> segments;
+
+    ~Conn() {
+        for (auto& [id, seg] : segments)
+            if (seg.base != nullptr) munmap(seg.base, seg.size);
+    }
 
     void reset_read() {
         rstate = RState::kHeader;
@@ -482,6 +495,9 @@ void Server::dispatch(Conn* c) {
             case kOpPutCommit:
             case kOpGetLoc:
             case kOpRelease:
+            case kOpRegSegment:
+            case kOpPutFrom:
+            case kOpGetInto:
                 handle_shm(c);
                 break;
             case kOpTcpGet:
@@ -723,6 +739,107 @@ void Server::handle_shm(Conn* c) {
             c->pending_gets.erase(m.ticket);
             c->pending_puts.erase(m.ticket);  // abort path for unmappable pools
             c->reset_read();  // fire-and-forget: no response
+            return;
+        }
+        case kOpRegSegment: {
+            SegMeta m = SegMeta::decode(c->body.data(), c->body.size());
+            uint32_t status = kStatusInvalidReq;
+            if (mm_->shm_enabled() && !m.name.empty() && m.size > 0 &&
+                c->segments.find(m.seg_id) == c->segments.end()) {
+                int fd = shm_open(m.name.c_str(), O_RDWR, 0);
+                if (fd >= 0) {
+                    void* mem =
+                        mmap(nullptr, m.size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+                    ::close(fd);
+                    if (mem != MAP_FAILED) {
+                        c->segments[m.seg_id] =
+                            Conn::SegMap{static_cast<char*>(mem), m.size};
+                        status = kStatusOk;
+                    }
+                }
+            }
+            c->reset_read();
+            send_status(c, status);
+            return;
+        }
+        case kOpPutFrom: {
+            // Pull blocks out of the client segment, commit, single ack —
+            // the reference's write path shape (server-initiated RDMA READ,
+            // reference src/infinistore.cpp:558-595) on shm.
+            SegBatchMeta m = SegBatchMeta::decode(c->body.data(), c->body.size());
+            size_t n = m.keys.size();
+            auto seg_it = c->segments.find(m.seg_id);
+            if (n == 0 || m.block_size == 0 || n != m.offsets.size() ||
+                seg_it == c->segments.end()) {
+                c->reset_read();
+                send_status(c, kStatusInvalidReq);
+                return;
+            }
+            const Conn::SegMap& seg = seg_it->second;
+            for (uint64_t off : m.offsets) {
+                if (off > seg.size || m.block_size > seg.size - off) {
+                    c->reset_read();
+                    send_status(c, kStatusInvalidReq);
+                    return;
+                }
+            }
+            std::vector<Lease> leases;
+            if (!alloc_blocks(m.block_size, n, &leases)) {
+                c->reset_read();
+                send_status(c, kStatusOutOfMemory);
+                return;
+            }
+            uint64_t in_bytes = 0;
+            for (size_t i = 0; i < n; i++) {
+                memcpy(leases[i].ptr, seg.base + m.offsets[i], m.block_size);
+                in_bytes += m.block_size;
+                kv_->commit(m.keys[i], std::make_shared<Block>(mm_.get(), leases[i].ptr,
+                                                               leases[i].size));
+            }
+            stats_[kOpPutBatch].record(now_us() - c->op_start_us, in_bytes, 0, true);
+            c->reset_read();
+            send_resp(c, kStatusOk, {}, {}, {});
+            return;
+        }
+        case kOpGetInto: {
+            // Push stored blocks into the client segment (RDMA WRITE
+            // analogue, reference :600-637); resp body carries stored sizes.
+            SegBatchMeta m = SegBatchMeta::decode(c->body.data(), c->body.size());
+            auto seg_it = c->segments.find(m.seg_id);
+            if (m.keys.empty() || m.block_size == 0 || m.keys.size() != m.offsets.size() ||
+                seg_it == c->segments.end()) {
+                c->reset_read();
+                send_status(c, kStatusInvalidReq);
+                return;
+            }
+            for (const auto& key : m.keys) {
+                if (!kv_->exists(key)) {
+                    c->reset_read();
+                    send_status(c, kStatusKeyNotFound);
+                    return;
+                }
+            }
+            const Conn::SegMap& seg = seg_it->second;
+            std::vector<uint8_t> body;
+            WireWriter w(body);
+            w.u32(static_cast<uint32_t>(m.keys.size()));
+            uint64_t total = 0;
+            for (size_t i = 0; i < m.keys.size(); i++) {
+                BlockRef b = kv_->get(m.keys[i]);  // LRU touch
+                uint64_t off = m.offsets[i];
+                if (b->size() > m.block_size || off > seg.size ||
+                    b->size() > seg.size - off) {
+                    c->reset_read();
+                    send_status(c, kStatusInvalidReq);
+                    return;
+                }
+                memcpy(seg.base + off, b->data(), b->size());
+                w.u32(static_cast<uint32_t>(b->size()));
+                total += b->size();
+            }
+            stats_[kOpGetBatch].record(now_us() - c->op_start_us, 0, total, true);
+            c->reset_read();
+            send_resp(c, kStatusOk, std::move(body), {}, {});
             return;
         }
         default:
