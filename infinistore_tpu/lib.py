@@ -141,6 +141,7 @@ class InfinityConnection:
         self.config = config
         self._handle = None
         self._semaphores: dict = {}  # per-loop inflight caps
+        self._shm_bufs: list = []  # keeps alloc_shm_mr views (and mappings) alive
         self._lock = threading.Lock()
         self.rdma_connected = False  # name kept for drop-in compatibility
         self.tcp_connected = False
@@ -181,6 +182,7 @@ class InfinityConnection:
             lib.its_conn_close(self._handle)
             lib.its_conn_destroy(self._handle)
             self._handle = None
+            self._shm_bufs.clear()  # views are dead once the segment unmaps
             self.rdma_connected = False
             self.tcp_connected = False
 
@@ -207,16 +209,19 @@ class InfinityConnection:
         """Allocate a staging buffer the server maps too (one-RTT data plane:
         the server pulls puts out of / pushes gets into it directly — the shm
         analogue of the reference's one-sided RDMA against registered client
-        memory). Returns a uint8 array view, or None when the server is
-        remote or shm-less (fall back to your own array + register_mr). The
-        segment lives until close()."""
+        memory). Returns a uint8 array view; when the server is remote or
+        shm-less the buffer is still a valid registered region, batched ops
+        just ride the socket path instead. Returns None only when allocation
+        itself fails. The segment lives until close()."""
         self._require()
         ptr = lib.its_conn_alloc_shm_mr(self._handle, nbytes)
         if not ptr:
             return None
         buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
         arr = np.frombuffer(buf, dtype=np.uint8)
-        arr._its_conn = self  # keep the connection (and mapping) alive
+        # ndarrays forbid new attributes, so anchor the view on the connection
+        # instead; the mapping lives until close() anyway.
+        self._shm_bufs.append(arr)
         return arr
 
     # -- batched async data plane -------------------------------------------
@@ -280,7 +285,13 @@ class InfinityConnection:
         """Async batched block write: for each (key, offset) send block_size
         bytes from ptr+offset (reference lib.py:425). On TPU the transport is
         the zero-copy DCN socket plane, not ibverbs; the name is kept for
-        drop-in compatibility, write_cache_async is the native alias."""
+        drop-in compatibility, write_cache_async is the native alias.
+
+        Ordering: batched ops order only via their completion awaitables. On
+        the shm fast path a put publishes its keys in a later commit leg, so
+        a get submitted before the put's future resolves may see KeyNotFound
+        even on the same connection — await the put first (the socket path
+        happens to serialize, but that is not part of the contract)."""
         return await self._batch_op(
             lib.its_conn_put_batch, blocks, block_size, ptr, "write_cache"
         )

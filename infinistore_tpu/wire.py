@@ -29,6 +29,11 @@ OP_PUT_ALLOC = ord("p")
 OP_PUT_COMMIT = ord("c")
 OP_GET_LOC = ord("g")
 OP_RELEASE = ord("r")
+# One-RTT segment path (native protocol.h: server pulls puts out of / pushes
+# gets into a client-registered shm segment).
+OP_REG_SEGMENT = ord("B")
+OP_PUT_FROM = ord("F")
+OP_GET_INTO = ord("I")
 
 # Status codes (reference src/protocol.h:55-62).
 STATUS_OK = 200
@@ -190,6 +195,50 @@ class ShmLocResp:
             m.locs.append((r.u16(), r.u64(), r.u32()))
         for _ in range(r.u16()):
             m.pools.append((r.u16(), r.str(), r.u64()))
+        return m
+
+
+@dataclass
+class SegMeta:
+    """Client shm segment registration (native SegMeta: RegSegment)."""
+
+    seg_id: int = 0
+    name: str = ""
+    size: int = 0
+
+    def encode(self) -> bytes:
+        return struct.pack("<H", self.seg_id) + encode_str(self.name) + struct.pack(
+            "<Q", self.size
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SegMeta":
+        r = Reader(data)
+        return cls(seg_id=r.u16(), name=r.str(), size=r.u64())
+
+
+@dataclass
+class SegBatchMeta:
+    """One-RTT batched op against a registered segment (native SegBatchMeta:
+    PutFrom / GetInto); block i lives at segment offset offsets[i]."""
+
+    block_size: int = 0
+    seg_id: int = 0
+    keys: List[str] = field(default_factory=list)
+    offsets: List[int] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        out = [struct.pack("<IH", self.block_size, self.seg_id)]
+        out.append(encode_str_list(self.keys))
+        out.append(struct.pack("<I", len(self.offsets)))
+        out.extend(struct.pack("<Q", off) for off in self.offsets)
+        return b"".join(out)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SegBatchMeta":
+        r = Reader(data)
+        m = cls(block_size=r.u32(), seg_id=r.u16(), keys=r.str_list())
+        m.offsets = [r.u64() for _ in range(r.u32())]
         return m
 
 

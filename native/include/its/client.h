@@ -147,6 +147,7 @@ class Connection {
     std::vector<iovec> rx_iov_;
     ScatterCursor rx_cur_;
     uint64_t rx_discard_ = 0;
+    bool rx_failed_ = false;  // payload rejected client-side (drained, op errors)
     bool resp_in_progress_ = false;
     bool rx_setup_done_ = false;
 

@@ -261,6 +261,10 @@ void* Connection::alloc_shm_mr(size_t size) {
     snprintf(name, sizeof(name), "/its.%d.%08x.c%u", static_cast<int>(getpid()), rd(), seq);
     int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
     if (fd < 0) return nullptr;
+    // Liveness marker for shm_sweep_stale, taken before the (possibly long)
+    // fallocate so a server starting concurrently cannot sweep the segment
+    // mid-setup.
+    flock(fd, LOCK_EX | LOCK_NB);
     if (ftruncate(fd, static_cast<off_t>(size)) != 0 ||
         posix_fallocate(fd, 0, static_cast<off_t>(size)) != 0) {
         ::close(fd);
@@ -273,7 +277,6 @@ void* Connection::alloc_shm_mr(size_t size) {
         shm_unlink(name);
         return nullptr;
     }
-    flock(fd, LOCK_EX | LOCK_NB);  // liveness marker for shm_sweep_stale
     // Leak fd intentionally: it holds the flock for the connection lifetime
     // (closed implicitly at process exit; the segment itself is unlinked in
     // close()).
@@ -588,6 +591,7 @@ bool Connection::read_ready() {
             rx_iov_.clear();
             rx_cur_.reset();
             rx_discard_ = 0;
+            rx_failed_ = false;
             if (rhdr_.payload_size > 0) {
                 if (req->op == kOpGetBatch && rhdr_.status == kStatusOk) {
                     WireReader rd(rbody_.data(), rbody_.size());
@@ -598,6 +602,18 @@ bool Connection::read_ready() {
                     }
                     for (uint32_t i = 0; i < n; i++) {
                         uint32_t sz = rd.u32();
+                        // A stored block larger than the caller's slot must
+                        // not scatter past rx_addrs[i]: fail the op and
+                        // drain the payload instead of overflowing.
+                        if (sz > req->block_size) {
+                            ITS_LOG_ERROR(
+                                "get_batch: stored block (%u) exceeds requested "
+                                "block_size (%u)", sz, req->block_size);
+                            rx_iov_.clear();
+                            rx_discard_ = rhdr_.payload_size;
+                            rx_failed_ = true;
+                            break;
+                        }
                         rx_iov_.push_back(iovec{req->rx_addrs[i], sz});
                     }
                 } else if (req->alloc_rx && rhdr_.status == kStatusOk) {
@@ -633,7 +649,10 @@ bool Connection::read_ready() {
         awaiting_.pop_front();
         resp_in_progress_ = false;
         rhdr_got_ = 0;
-        if (done->op == kOpPutAlloc || done->op == kOpGetLoc) {
+        if (rx_failed_) {
+            rx_failed_ = false;
+            complete(std::move(done), static_cast<int>(kStatusInternal));
+        } else if (done->op == kOpPutAlloc || done->op == kOpGetLoc) {
             auto requeue = shm_phase(std::move(done), rhdr_.status);
             if (requeue != nullptr) sendq_.push_back(std::move(requeue));
             if (!sendq_.empty() && !flush_send()) return false;
@@ -700,6 +719,17 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
                     break;
                 }
             }
+        }
+        // On gets, a stored block larger than the caller's slot must not
+        // overflow rx_addrs[i]: that is a size-contract violation, not a
+        // mapping problem — fail the op (no socket retry: that path would
+        // face the same oversized payload).
+        if (!put && l.size > req->block_size) {
+            ITS_LOG_ERROR("shm get: stored block (%u) exceeds requested block_size (%u)",
+                          l.size, req->block_size);
+            queue_release(resp.ticket);
+            complete(std::move(req), static_cast<int>(kStatusInternal));
+            return nullptr;
         }
         // Bounds-check against the mapping: a malformed location must not
         // drive memcpy out of the pool (the socket path bounds everything

@@ -93,6 +93,10 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
         int err = 0;
         int fd = shm_open(shm_name.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
         if (fd < 0) err = errno;
+        // Take the liveness lock before fallocate: shm_sweep_stale treats an
+        // unlocked segment as abandoned, and a multi-GB fallocate is a long
+        // window for a concurrently starting server to sweep us mid-setup.
+        if (fd >= 0) flock(fd, LOCK_EX | LOCK_NB);
         // posix_fallocate (not just ftruncate): reserve the tmpfs pages now so
         // an over-committed /dev/shm fails cleanly here — triggering the
         // anonymous fallback — instead of SIGBUSing the first touch mid-put.
@@ -113,10 +117,7 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
                 base_ = static_cast<char*>(mem);
                 shm_backed_ = true;
                 shm_name_ = shm_name;
-                shm_fd_ = fd;
-                // Liveness marker for shm_sweep_stale: held until destruction
-                // (or process death, which is the point).
-                flock(shm_fd_, LOCK_EX | LOCK_NB);
+                shm_fd_ = fd;  // keeps the flock liveness marker until death
                 shm_registry_add(shm_name.c_str());
             } else {
                 err = errno;

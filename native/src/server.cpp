@@ -9,6 +9,7 @@
 #include <sys/eventfd.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -744,18 +745,26 @@ void Server::handle_shm(Conn* c) {
         case kOpRegSegment: {
             SegMeta m = SegMeta::decode(c->body.data(), c->body.size());
             uint32_t status = kStatusInvalidReq;
-            if (mm_->shm_enabled() && !m.name.empty() && m.size > 0 &&
+            // Only map segments this library created (its. prefix), and only
+            // when tmpfs really backs the declared size — a shorter segment
+            // would SIGBUS the server on the first memcpy past EOF.
+            if (mm_->shm_enabled() && m.size > 0 &&
+                m.name.rfind("/its.", 0) == 0 &&
                 c->segments.find(m.seg_id) == c->segments.end()) {
                 int fd = shm_open(m.name.c_str(), O_RDWR, 0);
                 if (fd >= 0) {
-                    void* mem =
-                        mmap(nullptr, m.size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
-                    ::close(fd);
-                    if (mem != MAP_FAILED) {
-                        c->segments[m.seg_id] =
-                            Conn::SegMap{static_cast<char*>(mem), m.size};
-                        status = kStatusOk;
+                    struct stat st;
+                    if (fstat(fd, &st) == 0 &&
+                        st.st_size >= static_cast<off_t>(m.size)) {
+                        void* mem = mmap(nullptr, m.size, PROT_READ | PROT_WRITE,
+                                         MAP_SHARED, fd, 0);
+                        if (mem != MAP_FAILED) {
+                            c->segments[m.seg_id] =
+                                Conn::SegMap{static_cast<char*>(mem), m.size};
+                            status = kStatusOk;
+                        }
                     }
+                    ::close(fd);
                 }
             }
             c->reset_read();
@@ -796,7 +805,7 @@ void Server::handle_shm(Conn* c) {
                 kv_->commit(m.keys[i], std::make_shared<Block>(mm_.get(), leases[i].ptr,
                                                                leases[i].size));
             }
-            stats_[kOpPutBatch].record(now_us() - c->op_start_us, in_bytes, 0, true);
+            stats_[kOpPutFrom].record(now_us() - c->op_start_us, in_bytes, 0, true);
             c->reset_read();
             send_resp(c, kStatusOk, {}, {}, {});
             return;
@@ -820,10 +829,11 @@ void Server::handle_shm(Conn* c) {
                 }
             }
             const Conn::SegMap& seg = seg_it->second;
-            std::vector<uint8_t> body;
-            WireWriter w(body);
-            w.u32(static_cast<uint32_t>(m.keys.size()));
-            uint64_t total = 0;
+            // Validate the whole batch before the first memcpy so a rejected
+            // request never leaves the client segment partially overwritten
+            // (all-or-nothing, matching the PutFrom pre-pass above).
+            std::vector<BlockRef> blocks;
+            blocks.reserve(m.keys.size());
             for (size_t i = 0; i < m.keys.size(); i++) {
                 BlockRef b = kv_->get(m.keys[i]);  // LRU touch
                 uint64_t off = m.offsets[i];
@@ -833,11 +843,18 @@ void Server::handle_shm(Conn* c) {
                     send_status(c, kStatusInvalidReq);
                     return;
                 }
-                memcpy(seg.base + off, b->data(), b->size());
-                w.u32(static_cast<uint32_t>(b->size()));
-                total += b->size();
+                blocks.push_back(std::move(b));
             }
-            stats_[kOpGetBatch].record(now_us() - c->op_start_us, 0, total, true);
+            std::vector<uint8_t> body;
+            WireWriter w(body);
+            w.u32(static_cast<uint32_t>(m.keys.size()));
+            uint64_t total = 0;
+            for (size_t i = 0; i < blocks.size(); i++) {
+                memcpy(seg.base + m.offsets[i], blocks[i]->data(), blocks[i]->size());
+                w.u32(static_cast<uint32_t>(blocks[i]->size()));
+                total += blocks[i]->size();
+            }
+            stats_[kOpGetInto].record(now_us() - c->op_start_us, 0, total, true);
             c->reset_read();
             send_resp(c, kStatusOk, std::move(body), {}, {});
             return;

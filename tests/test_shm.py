@@ -180,3 +180,120 @@ def test_auto_extend_pool_mapped_on_demand():
     assert np.array_equal(src, dst)
     c.close()
     srv.stop()
+
+
+def test_alloc_shm_mr_one_rtt_roundtrip():
+    """alloc_shm_mr returns a server-mapped staging buffer, and batched ops on
+    it ride the one-RTT PutFrom/GetInto path (the shm analogue of the
+    reference's one-sided RDMA against registered client memory,
+    reference src/infinistore.cpp:558-595) — verified via op counters."""
+    srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    assert c.shm_active
+    n, block = 16, 16 << 10
+    buf = c.alloc_shm_mr(n * block)
+    assert buf is not None and buf.nbytes == n * block
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    buf[:] = src
+    pairs = [(f"seg-{i}", i * block) for i in range(n)]
+    asyncio.run(c.write_cache_async(pairs, block, buf.ctypes.data))
+    buf[:] = 0
+    asyncio.run(c.read_cache_async(pairs, block, buf.ctypes.data))
+    assert np.array_equal(buf, src)
+    ops = c.get_stats()["ops"]
+    assert ops.get("F", {}).get("count", 0) >= 1  # PutFrom
+    assert ops.get("I", {}).get("count", 0) >= 1  # GetInto
+    c.close()
+    srv.stop()
+
+
+def test_alloc_shm_mr_declined_falls_back():
+    """A shm-less server declines RegSegment; the buffer stays usable as a
+    plain registered region and batched ops ride the socket path ('W'/'R'
+    op counters, not 'F'/'I')."""
+    srv = its.start_local_server(
+        prealloc_bytes=16 << 20, block_bytes=16 << 10, enable_shm=False
+    )
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    assert not c.shm_active
+    block = 16 << 10
+    buf = c.alloc_shm_mr(2 * block)
+    assert buf is not None
+    src = np.random.randint(0, 256, size=2 * block, dtype=np.uint8)
+    buf[:] = src
+    pairs = [("d-0", 0), ("d-1", block)]
+    asyncio.run(c.write_cache_async(pairs, block, buf.ctypes.data))
+    buf[:] = 0
+    asyncio.run(c.read_cache_async(pairs, block, buf.ctypes.data))
+    assert np.array_equal(buf, src)
+    ops = c.get_stats()["ops"]
+    assert ops.get("W", {}).get("count", 0) >= 1
+    assert "F" not in ops and "I" not in ops
+    c.close()
+    srv.stop()
+
+
+def test_reg_segment_rejects_undersized_shm(tmp_path):
+    """The server must fstat a client-declared segment and refuse to map past
+    tmpfs EOF — an undersized segment would SIGBUS the reactor on first use."""
+    import os
+
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    name = f"/its.{os.getpid()}.feedf00d.t"
+    path = "/dev/shm" + name
+    with open(path, "wb") as f:
+        f.truncate(4096)  # claims 1MB below but backs only 4KB
+    try:
+        s = _connect_raw(srv.port)
+        body = wire.SegMeta(seg_id=7, name=name, size=1 << 20).encode()
+        status, _, _ = _roundtrip(s, wire.OP_REG_SEGMENT, body)
+        assert status != wire.STATUS_OK
+        # A non-its-prefixed name must be refused outright.
+        with open("/dev/shm/evil.seg", "wb") as f:
+            f.truncate(1 << 20)
+        body = wire.SegMeta(seg_id=8, name="/evil.seg", size=1 << 20).encode()
+        status, _, _ = _roundtrip(s, wire.OP_REG_SEGMENT, body)
+        assert status != wire.STATUS_OK
+        s.close()
+    finally:
+        for p in (path, "/dev/shm/evil.seg"):
+            if os.path.exists(p):
+                os.unlink(p)
+        srv.stop()
+
+
+@pytest.mark.parametrize("shm", [True, False], ids=["shm", "socket"])
+def test_get_with_smaller_block_size_errors_cleanly(shm):
+    """Reading a key back with a block_size smaller than the stored block
+    must fail with a typed error — never scatter past the caller's slot —
+    and leave the connection usable (both data planes)."""
+    srv = its.start_local_server(
+        prealloc_bytes=16 << 20, block_bytes=32 << 10, enable_shm=shm
+    )
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    big = np.random.randint(0, 256, size=32 << 10, dtype=np.uint8)
+    c.register_mr(big)
+    asyncio.run(c.write_cache_async([("over", 0)], big.nbytes, big.ctypes.data))
+    # Guard pages: canary after the undersized slot must survive the get.
+    dst = np.zeros(32 << 10, dtype=np.uint8)
+    dst[16 << 10 :] = 0xAB
+    c.register_mr(dst)
+    with pytest.raises(its.InfiniStoreException):
+        asyncio.run(c.read_cache_async([("over", 0)], 16 << 10, dst.ctypes.data))
+    assert np.all(dst[16 << 10 :] == 0xAB)
+    # Connection stays usable.
+    full = np.zeros(32 << 10, dtype=np.uint8)
+    c.register_mr(full)
+    asyncio.run(c.read_cache_async([("over", 0)], 32 << 10, full.ctypes.data))
+    assert np.array_equal(full, big)
+    c.close()
+    srv.stop()
