@@ -1,60 +1,53 @@
 #!/usr/bin/env python
 """Driver benchmark: one JSON line with the headline metric.
 
-Measures BASELINE.md config 2 — async batched write+read of 1K keys x 64KB
+Headline: BASELINE.md config 2 — async batched write+read of 1K keys x 64KB
 blocks against a loopback server (the reference's client_async.py analogue,
-which its benchmark.py measures as MB/s; reference
-benchmark.py:258-269). Metric is aggregate data-plane throughput (bytes moved
-in both directions / wall time) in GB/s per host.
+which its benchmark.py measures as MB/s; reference benchmark.py:258-269).
+The buffers are allocated via alloc_shm_mr, so the data plane is the one-RTT
+server-pull/push segment path — one memcpy per byte per direction, the same
+copy count as the reference's one-sided RDMA.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the divisor
-is a fixed 1.0 GB/s nominal — the practical ceiling of the reference's own
-TCP fallback path on a 10GbE-class NIC, which is the comparable transport when
-no RDMA hardware is present. Values > 1 mean we beat the reference's
-non-RDMA data plane.
+is the *measured* single-core memcpy ceiling of this host (the hard physical
+bound for any same-host transport that moves each byte once): vs_baseline =
+achieved aggregate GB/s / memcpy GB/s. 1.0 would mean the full transport
+stack costs nothing beyond the copy itself.
+
+extra: TPU-in-the-loop numbers (BASELINE.md config 4 — paged-KV save/load
+through the LMCache-style connector on the default jax backend, real chip
+under the driver) and p50/p99 single-block fetch latency at 4KB / 64KB
+(BASELINE.json's headline latency metric).
 """
 
 import json
-import socket
-import subprocess
 import sys
 import time
 
-BASELINE_GBPS = 1.0
+
+def _memcpy_ceiling_gbps(np) -> float:
+    """Measured warm single-core memcpy bandwidth (the honest divisor)."""
+    n = 64 << 20
+    src = np.random.randint(0, 256, size=n, dtype=np.uint8)
+    dst = np.empty_like(src)
+    np.copyto(dst, src)  # warm pages
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        best = min(best, time.perf_counter() - t0)
+    return n / best / (1 << 30)
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def main() -> int:
-    import asyncio
-
-    import numpy as np
-
-    import infinistore_tpu as its
-
-    # In-process server: 1GB pool, 64KB blocks (reference bench defaults are
-    # 64KB minimal_allocate_size), pinned if RLIMIT_MEMLOCK allows.
-    srv = its.start_local_server(
-        prealloc_bytes=1 << 30, block_bytes=64 << 10, pin_memory=True
-    )
-    port = srv.port
-
-    conn = its.InfinityConnection(
-        its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error")
-    )
-    conn.connect()
-
+def _loopback_throughput(its, np, conn) -> float:
     n_keys = 1000
     block = 64 << 10
     batch = 250  # keys per batched op -> 4 pipelined ops in flight
-    src = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
-    dst = np.zeros_like(src)
-    conn.register_mr(src)
-    conn.register_mr(dst)
+    import asyncio
+
+    src = conn.alloc_shm_mr(n_keys * block)
+    dst = conn.alloc_shm_mr(n_keys * block)
+    src[:] = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
     keys = [f"bench-{i}" for i in range(n_keys)]
     offsets = [i * block for i in range(n_keys)]
     batches = [
@@ -83,7 +76,141 @@ def main() -> int:
 
     assert np.array_equal(src, dst), "data verification failed"
     moved = 2 * n_keys * block * iters  # write + read
-    gbps = moved / best_dt / (1 << 30)
+    return moved / best_dt / (1 << 30)
+
+
+def _fetch_latency_us(np, conn, block: int, iters: int = 300):
+    """p50/p99 single-block fetch latency through the public API."""
+    import asyncio
+
+    buf = conn.alloc_shm_mr(block)
+    buf[:] = np.random.randint(0, 256, size=block, dtype=np.uint8)
+    key = f"lat-{block}"
+
+    async def run():
+        await conn.write_cache_async([(key, 0)], block, buf.ctypes.data)
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            await conn.read_cache_async([(key, 0)], block, buf.ctypes.data)
+            samples.append((time.perf_counter() - t0) * 1e6)
+        return samples
+
+    samples = sorted(asyncio.run(run()))
+    return (
+        samples[len(samples) // 2],
+        samples[min(len(samples) - 1, int(len(samples) * 0.99))],
+    )
+
+
+def _tpu_connector_gbps(its, np, conn):
+    """BASELINE config 4: paged-KV block save/load via the connector on the
+    default jax backend (the real chip when the driver runs this)."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.connector import KVConnector
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+    # 64KB blocks: 64 tokens x 8 kv-heads x 64 dim x bf16.
+    spec = PagedKVCacheSpec(
+        num_layers=8,
+        num_kv_heads=8,
+        head_dim=64,
+        block_tokens=64,
+        dtype=jnp.bfloat16,
+        num_blocks=64,
+    )
+    n_blocks = 32
+    kvc = KVConnector(conn, spec, "bench-llama", max_blocks=n_blocks)
+    key = jax.random.PRNGKey(0)
+    caches = [
+        (
+            jax.random.normal(jax.random.fold_in(key, 2 * l), (spec.num_blocks, *spec.block_shape)).astype(spec.dtype),
+            jax.random.normal(jax.random.fold_in(key, 2 * l + 1), (spec.num_blocks, *spec.block_shape)).astype(spec.dtype),
+        )
+        for l in range(spec.num_layers)
+    ]
+    jax.block_until_ready(caches)
+    tokens = list(range(n_blocks * spec.block_tokens))
+    ids = np.arange(n_blocks, dtype=np.int32)
+    nbytes = 2 * spec.num_layers * n_blocks * spec.block_nbytes
+
+    # Raw device-transfer ceilings with the same layer-window overlap the
+    # pipeline uses: the connector can't beat these; closeness to them is
+    # the real figure of merit (on tunneled dev TPUs they are low; on local
+    # chips they are PCIe/DMA-class).
+    chunks = [caches[l][0][:n_blocks] + 0 for l in range(4)]
+    jax.block_until_ready(chunks)
+    t0 = time.perf_counter()
+    for c in chunks:
+        c.copy_to_host_async()
+    hosts = [np.asarray(c) for c in chunks]
+    d2h_gbps = sum(h.nbytes for h in hosts) / (time.perf_counter() - t0) / (1 << 30)
+    t0 = time.perf_counter()
+    devs = [jax.device_put(h) for h in hosts]
+    jax.block_until_ready(devs)
+    h2d_gbps = sum(h.nbytes for h in hosts) / (time.perf_counter() - t0) / (1 << 30)
+
+    asyncio.run(kvc.save(tokens, caches, ids))  # warmup (jit compile)
+    best_save = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        asyncio.run(kvc.save(tokens, caches, ids))
+        best_save = min(best_save, time.perf_counter() - t0)
+
+    fresh = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
+    out, loaded = asyncio.run(kvc.load(tokens, fresh, ids))  # warmup
+    assert loaded == n_blocks, f"load hit {loaded}/{n_blocks}"
+    best_load = float("inf")
+    for _ in range(3):
+        fresh = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
+        t0 = time.perf_counter()
+        out, loaded = asyncio.run(kvc.load(tokens, fresh, ids))
+        jax.block_until_ready(out)
+        best_load = min(best_load, time.perf_counter() - t0)
+    # Spot-verify one layer's blocks made the round trip.
+    k_ref = np.asarray(caches[3][0][ids[5]], np.float32)
+    k_got = np.asarray(out[3][0][ids[5]], np.float32)
+    assert np.array_equal(k_ref, k_got), "TPU roundtrip verification failed"
+
+    return (
+        nbytes / best_save / (1 << 30),
+        nbytes / best_load / (1 << 30),
+        d2h_gbps,
+        h2d_gbps,
+    )
+
+
+def main() -> int:
+    import numpy as np
+
+    import infinistore_tpu as its
+
+    srv = its.start_local_server(
+        prealloc_bytes=1 << 30, block_bytes=64 << 10, pin_memory=True
+    )
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    conn.connect()
+
+    ceiling = _memcpy_ceiling_gbps(np)
+    gbps = _loopback_throughput(its, np, conn)
+    p50_4k, p99_4k = _fetch_latency_us(np, conn, 4 << 10)
+    p50_64k, p99_64k = _fetch_latency_us(np, conn, 64 << 10)
+    try:
+        tpu_save, tpu_load, d2h, h2d = _tpu_connector_gbps(its, np, conn)
+        import jax
+
+        backend = jax.devices()[0].platform
+    except (ImportError, RuntimeError) as e:
+        # Absent/broken backend only — data-verification AssertionErrors
+        # must fail the bench, not masquerade as a missing chip.
+        tpu_save = tpu_load = d2h = h2d = None
+        backend = f"unavailable ({type(e).__name__})"
 
     conn.close()
     srv.stop()
@@ -94,7 +221,19 @@ def main() -> int:
                 "metric": "kv_batched_write_read_throughput",
                 "value": round(gbps, 3),
                 "unit": "GB/s",
-                "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+                "vs_baseline": round(gbps / ceiling, 3),
+                "extra": {
+                    "memcpy_ceiling_gbps": round(ceiling, 3),
+                    "p50_fetch_4k_us": round(p50_4k, 1),
+                    "p99_fetch_4k_us": round(p99_4k, 1),
+                    "p50_fetch_64k_us": round(p50_64k, 1),
+                    "p99_fetch_64k_us": round(p99_64k, 1),
+                    "tpu_paged_kv_save_gbps": None if tpu_save is None else round(tpu_save, 3),
+                    "tpu_paged_kv_load_gbps": None if tpu_load is None else round(tpu_load, 3),
+                    "tpu_d2h_ceiling_gbps": None if d2h is None else round(d2h, 3),
+                    "tpu_h2d_ceiling_gbps": None if h2d is None else round(h2d, 3),
+                    "tpu_backend": backend,
+                },
             }
         )
     )

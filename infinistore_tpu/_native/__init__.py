@@ -102,6 +102,8 @@ lib.its_conn_connected.argtypes = [c_void_p]
 lib.its_conn_connected.restype = c_int
 lib.its_conn_register_mr.argtypes = [c_void_p, c_void_p, c_uint64]
 lib.its_conn_register_mr.restype = c_int
+lib.its_conn_unregister_mr.argtypes = [c_void_p, c_void_p]
+lib.its_conn_unregister_mr.restype = c_int
 lib.its_conn_alloc_shm_mr.argtypes = [c_void_p, c_uint64]
 lib.its_conn_alloc_shm_mr.restype = c_void_p
 _batch_args = [

@@ -70,8 +70,11 @@ class KVConnector:
         self.model_id = model_id
         self.max_blocks = max_blocks
         if pool is None:
+            # 6 read-staging regions (K+V each): deep enough that network
+            # fetches and H2D uploads overlap several layers (layerwise.py
+            # _LayerRegions adapts the pipeline depth to this size).
             pool = HostStagingPool(
-                4 * max_blocks * spec.block_nbytes, spec.block_nbytes, conn=conn
+                12 * max_blocks * spec.block_nbytes, spec.block_nbytes, conn=conn
             )
         self.pool = pool
         self._writer = LayerwiseKVWriter(conn, pool, spec, max_blocks)
@@ -126,6 +129,11 @@ class KVConnector:
 
         Fetches ``lookup(tokens)`` blocks (capped by len(block_ids)) and
         scatters them; returns (updated caches, blocks_loaded).
+
+        DONATION: the input ``caches`` are consumed (scatter_blocks donates
+        the cache buffer on TPU so the update is in-place in HBM). Use the
+        returned caches; do not touch the inputs again — on a real chip they
+        are deleted buffers after this call.
         """
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
         hit = self._lookup_chains(chains)

@@ -205,6 +205,17 @@ class InfinityConnection:
             raise InfiniStoreException("register memory region failed")
         return ret
 
+    def unregister_mr(self, arg: Union[int, np.ndarray]):
+        """Drop a transfer-scoped registration (pair with register_mr for
+        short-lived staging buffers; in-flight ops are unaffected)."""
+        self._require()
+        ptr, _ = _extract_ptr_size(arg, 0 if isinstance(arg, int) else None)
+        if lib.its_conn_unregister_mr(self._handle, ctypes.c_void_p(ptr)) != 0:
+            # A silent miss would leak the region (and its mlock) forever.
+            raise InfiniStoreException(
+                f"unregister_mr: no region registered at base 0x{ptr:x}"
+            )
+
     def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
         """Allocate a staging buffer the server maps too (one-RTT data plane:
         the server pulls puts out of / pushes gets into it directly — the shm

@@ -5,9 +5,9 @@ KV cache layer by layer so network transfer overlaps per-layer compute, which
 is how it keeps prefill network overhead "no more than 1%"
 (reference docs/source/design.rst:54-63; the benchmark models it as
 --steps "layers", benchmark.py:188-193). Here the overlap is two-level:
-device->host copies (async, overlap with TPU compute) and DCN puts (async,
-overlap with the next layer's D2H) are pipelined through a double-buffered
-staging region.
+device->host copies (async, overlap with TPU compute) and network puts
+(async, up to ``depth`` layers in flight) are pipelined, and the writer ships
+directly from jax's D2H buffers — zero staging copies (see staging.py).
 
 Key naming follows the reference's convention of hash-chain keys per block
 (design.rst:50): one key per (request-chain hash, layer, k/v, block index), so
@@ -15,6 +15,7 @@ Key naming follows the reference's convention of hash-chain keys per block
 """
 
 import asyncio
+from collections import deque
 from typing import Callable, List, Sequence, Tuple
 
 import jax
@@ -32,8 +33,10 @@ def kv_block_key(model: str, chain_hash: str, layer: int, kind: str, block: int)
 
 
 class _LayerRegions:
-    """Double-buffered staging layout: region r holds this layer's K blocks
-    then V blocks, each block in its own slot."""
+    """Read-staging layout: region r holds a layer's K blocks then V blocks,
+    each block in its own slot. The region count adapts to the pool size
+    (>= 2 — double buffering — up to 8), deepening the fetch/H2D pipeline
+    when the pool affords it."""
 
     def __init__(self, pool: HostStagingPool, spec: PagedKVCacheSpec, max_blocks: int):
         if spec.block_nbytes > pool.block_size:
@@ -44,8 +47,9 @@ class _LayerRegions:
         self.pool = pool
         self.spec = spec
         self.max_blocks = max_blocks
-        # 2 regions x (K + V) x max_blocks slots.
-        if pool.num_slots < 4 * max_blocks:
+        # count regions x (K + V) x max_blocks slots.
+        self.count = min(8, pool.num_slots // (2 * max_blocks))
+        if self.count < 2:
             raise ValueError(
                 f"staging pool too small: need {4 * max_blocks} slots of "
                 f"{pool.block_size}B, have {pool.num_slots}"
@@ -63,14 +67,26 @@ class LayerwiseKVWriter:
     """Stream a request's KV blocks to the store, one layer at a time.
 
     Pipeline per layer: Pallas-gather blocks from the paged cache (device),
-    start the async D2H into staging region r, and while it lands, the
-    previous layer's staged region (1-r) is in flight on the DCN socket."""
+    start the async D2H, and ship previous layers' host buffers on the
+    network concurrently — up to ``depth`` layer-groups of puts in flight.
+    Puts go straight from jax's D2H buffers (registered for the op's
+    lifetime), so the only host copy is the one into the server's pool."""
 
     def __init__(self, conn, pool: HostStagingPool, spec: PagedKVCacheSpec,
-                 max_blocks: int):
+                 max_blocks: int, depth: int = 2, d2h_window: int = 4):
+        if depth < 1 or d2h_window < 1:
+            raise ValueError("depth and d2h_window must be >= 1")
         self.conn = conn
         self.spec = spec
-        self.regions = _LayerRegions(pool, spec, max_blocks)
+        # The writer ships straight from jax D2H buffers — the pool provides
+        # only the connection to register them with; no slots are consumed.
+        self.pool = pool
+        self.max_blocks = max_blocks
+        self.depth = depth
+        # Layers of D2H kept in flight: device->host transfers pipeline (on
+        # tunneled/remote TPU hosts batching them is worth several x), at a
+        # device-memory cost of 2 x n x block_nbytes per window entry.
+        self.d2h_window = d2h_window
 
     async def write(
         self,
@@ -82,52 +98,94 @@ class LayerwiseKVWriter:
         n = len(block_ids)
         if n == 0:
             return 0
-        if n > self.regions.max_blocks:
-            raise ValueError(f"{n} blocks > writer capacity {self.regions.max_blocks}")
+        if n > self.max_blocks:
+            raise ValueError(f"{n} blocks > writer capacity {self.max_blocks}")
         ids_dev = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
-        pool = self.regions.pool
+        pool = self.pool
         bn = self.spec.block_nbytes
-        pending = None  # (blocks list of (key, offset)) awaiting network put
+        # (futures, registered transfer, blocks count) groups in flight.
+        inflight: deque = deque()
         total = 0
+
+        async def drain_one() -> int:
+            futs, tr, count = inflight.popleft()
+            # Let BOTH puts settle before releasing the host buffers — a
+            # failed K-batch must not free memory the V-batch's writev is
+            # still streaming from — then surface the first failure.
+            results = await asyncio.gather(*futs, return_exceptions=True)
+            tr.release()
+            for r in results:
+                if isinstance(r, BaseException):
+                    raise r
+            return count
+
         # Layer 0 is written LAST: connectors use a block's layer-0 K key as
         # the presence sentinel for the whole block (one prefix-match probe
         # instead of layers x 2), so it must commit only after every deeper
         # layer did — a half-saved block then reads as absent, never as a
         # false hit.
         order = list(range(1, len(caches))) + [0] if len(caches) > 1 else [0]
-        for pos, layer in enumerate(order):
-            k_cache, v_cache = caches[layer]
-            region = pos % 2
-            # Device-side gather + async D2H into this region.
-            k_blocks = gather_blocks(k_cache, ids_dev)
-            v_blocks = gather_blocks(v_cache, ids_dev)
-            k_off = self.regions.offsets(region, "k", 1)[0]
-            v_off = self.regions.offsets(region, "v", 1)[0]
-            transfer = pool.stage_out(
-                [k_blocks, v_blocks],
-                [self.regions.slots(region, "k", 1)[0], self.regions.slots(region, "v", 1)[0]],
-            )
-            # Previous layer's staged bytes ride the network while this
-            # layer's D2H completes.
-            if pending is not None:
-                await self.conn.write_cache_async(pending, bn, pool.base_ptr)
-                total += len(pending)
-            transfer.wait()
-            pending = [
-                (key_fn(layer, "k", i), k_off + i * bn) for i in range(n)
-            ] + [
-                (key_fn(layer, "v", i), v_off + i * bn) for i in range(n)
-            ]
-        if pending is not None:
-            await self.conn.write_cache_async(pending, bn, pool.base_ptr)
-            total += len(pending)
+        # Stage ahead: gather + start async D2H for up to d2h_window layers
+        # before consuming the oldest — device->host transfers pipeline.
+        staged: deque = deque()
+        todo = iter(enumerate(order))
+
+        def top_up():
+            while len(staged) < self.d2h_window:
+                nxt = next(todo, None)
+                if nxt is None:
+                    return
+                pos, layer = nxt
+                k_cache, v_cache = caches[layer]
+                staged.append((pos, layer, pool.stage_out([
+                    gather_blocks(k_cache, ids_dev),
+                    gather_blocks(v_cache, ids_dev),
+                ])))
+
+        try:
+            top_up()
+            while staged:
+                pos, layer, tr = staged.popleft()
+                # Keep at most depth-1 older put groups while this D2H lands.
+                while len(inflight) >= self.depth:
+                    total += await drain_one()
+                if pos == len(order) - 1:
+                    # Layer-0-last barrier: every deeper layer's put must have
+                    # completed (= committed) before the sentinel ships.
+                    while inflight:
+                        total += await drain_one()
+                k_host, v_host = tr.wait()  # registers both buffers
+                futs = (
+                    asyncio.ensure_future(self.conn.write_cache_async(
+                        [(key_fn(layer, "k", i), i * bn) for i in range(n)],
+                        bn, k_host.ctypes.data)),
+                    asyncio.ensure_future(self.conn.write_cache_async(
+                        [(key_fn(layer, "v", i), i * bn) for i in range(n)],
+                        bn, v_host.ctypes.data)),
+                )
+                inflight.append((futs, tr, 2 * n))
+                top_up()  # refill the D2H pipeline before blocking again
+            while inflight:
+                total += await drain_one()
+        finally:
+            # On error, still wait for anything in flight before dropping the
+            # host buffers — the native reactor may be mid-writev on them
+            # (a dead connection fails these futures promptly via fail_all).
+            while inflight:
+                futs, tr, _ = inflight.popleft()
+                try:
+                    await asyncio.gather(*futs, return_exceptions=True)
+                finally:
+                    tr.release()
         return total
 
 
 class LayerwiseKVReader:
     """Fetch a request's KV blocks from the store layer by layer, scattering
     into the paged cache; network get of layer l+1 overlaps the device upload
-    + scatter of layer l."""
+    + scatter of layer l. Reads land in the pool — same-host that is the
+    server-mapped segment (one-RTT GetInto) — and jax uploads straight from
+    it."""
 
     def __init__(self, conn, pool: HostStagingPool, spec: PagedKVCacheSpec,
                  max_blocks: int):
@@ -153,7 +211,7 @@ class LayerwiseKVReader:
         bn = self.spec.block_nbytes
 
         def fetch(layer: int):
-            region = layer % 2
+            region = layer % self.regions.count
             k_off = self.regions.offsets(region, "k", 1)[0]
             v_off = self.regions.offsets(region, "v", 1)[0]
             blocks = [
@@ -165,27 +223,50 @@ class LayerwiseKVReader:
                 self.conn.read_cache_async(blocks, bn, pool.base_ptr)
             )
 
+        # Pipeline: with R regions, keep W = R//2 network fetches in flight
+        # ahead of device consumption; a region is reused only after its
+        # previous occupant's H2D + scatter completed (checked R-W layers
+        # later, so several H2D uploads overlap instead of serializing —
+        # a large win when device transfers ride a tunnel or PCIe queue).
+        R = self.regions.count
+        W = max(1, R // 2)
         out: List[Tuple[jax.Array, jax.Array]] = list(caches)
-        inflight = fetch(0)
-        for layer in range(num_layers):
-            await inflight
-            if layer + 1 < num_layers:
-                inflight = fetch(layer + 1)  # next layer rides the network now
-            region = layer % 2
-            shape = (n, *self.spec.block_shape)
-            k_host = pool.slot_view(self.regions.slots(region, "k", 1)[0], n * bn)
-            v_host = pool.slot_view(self.regions.slots(region, "v", 1)[0], n * bn)
-            k_blocks = jax.device_put(
-                k_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
-            )
-            v_blocks = jax.device_put(
-                v_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
-            )
-            k_cache, v_cache = out[layer]
-            new_k = scatter_blocks(k_cache, ids_dev, k_blocks)
-            new_v = scatter_blocks(v_cache, ids_dev, v_blocks)
-            # The staging region is reused two layers later; make sure the H2D
-            # copies consumed it before then.
-            jax.block_until_ready((new_k, new_v))
-            out[layer] = (new_k, new_v)
+        fetches = {}
+
+        def start(f: int):
+            if f < num_layers and f not in fetches:
+                occupant = f - R
+                if occupant >= 0:
+                    jax.block_until_ready(out[occupant])  # region now free
+                fetches[f] = fetch(f)
+
+        try:
+            for f in range(min(W, num_layers)):
+                start(f)
+            for layer in range(num_layers):
+                await fetches.pop(layer)
+                region = layer % R
+                shape = (n, *self.spec.block_shape)
+                k_host = pool.slot_view(self.regions.slots(region, "k", 1)[0], n * bn)
+                v_host = pool.slot_view(self.regions.slots(region, "v", 1)[0], n * bn)
+                k_blocks = jax.device_put(
+                    k_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
+                )
+                v_blocks = jax.device_put(
+                    v_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
+                )
+                k_cache, v_cache = out[layer]
+                out[layer] = (
+                    scatter_blocks(k_cache, ids_dev, k_blocks),
+                    scatter_blocks(v_cache, ids_dev, v_blocks),
+                )
+                start(layer + W)
+        finally:
+            # Failure drain: pending fetches would otherwise keep writing
+            # into regions a subsequent read() on this pool is using. The
+            # pool may also be reused (or freed) by the caller as soon as we
+            # return, so every staged byte must be consumed by the device.
+            if fetches:
+                await asyncio.gather(*fetches.values(), return_exceptions=True)
+            jax.block_until_ready(out)
         return out

@@ -1,14 +1,20 @@
-"""HBM <-> pinned-host staging.
+"""HBM <-> host staging for the TPU data plane.
 
 The TPU replacement for the reference's GPUDirect path: where the reference
 registers CUDA tensor memory with the NIC and lets the server RDMA straight
-into HBM (reference src/libinfinistore.cpp:728 register_mr on
-data_ptr), TPU VMs require an explicit device<->host hop. This module owns
-that hop: one pinned, MR-registered host pool per connection, asynchronous
-device->host copies (jax.Array.copy_to_host_async, so transfer overlaps
-compute exactly like the reference's per-layer streaming), and slot-based
-block placement so the network layer does zero-copy scatter/gather out of the
-same buffer the device copies land in.
+into HBM (reference src/libinfinistore.cpp:728 register_mr on data_ptr), TPU
+VMs require an explicit device<->host hop. This module owns that hop and keeps
+it to ONE host copy per direction:
+
+- Writes ship directly from the buffer jax's async D2H lands in
+  (``StagedTransfer.wait`` returns zero-copy views of the device transfer —
+  no staging memcpy). The buffer is registered for the transfer's lifetime
+  and the shm data plane memcpys it straight into the server pool.
+- Reads land in the pool below. When the server is same-host, the pool is
+  allocated via ``alloc_shm_mr`` so the server pushes blocks into it in one
+  round trip (GetInto — the shm analogue of the reference's one-sided RDMA
+  WRITE, reference src/infinistore.cpp:600-637) and ``jax.device_put``
+  uploads straight from the segment.
 """
 
 import math
@@ -19,51 +25,89 @@ import numpy as np
 
 
 class StagedTransfer:
-    """Handle for an in-flight device->host copy into staging slots."""
+    """Handle for in-flight async device->host copies.
 
-    def __init__(self, arrays: Sequence[jax.Array], views: Sequence[np.ndarray]):
+    ``wait()`` returns host views of the transferred data without any
+    further copy: ``np.asarray`` on a jax array reuses the buffer
+    ``copy_to_host_async`` produced. Keep the transfer object alive until the
+    network is done with the views — it anchors the jax arrays that own the
+    host memory.
+    """
+
+    def __init__(self, arrays: Sequence[jax.Array]):
         self._arrays = list(arrays)
-        self._views = list(views)
         # Kick off all D2H copies without blocking; jax overlaps them with
         # ongoing device computation.
         for arr in self._arrays:
             arr.copy_to_host_async()
-        self._done = False
+        self._hosts: Optional[List[np.ndarray]] = None
 
     def wait(self) -> List[np.ndarray]:
-        """Block until device data is host-visible and placed in the pinned
-        slots; returns the staged views."""
-        if not self._done:
-            for arr, view in zip(self._arrays, self._views):
-                # np.asarray reuses the buffer copy_to_host_async produced
-                # (no second D2H); the copyto lands it in pinned memory that
-                # the NIC-facing reactor reads with zero further copies.
-                host = np.asarray(arr)
-                np.copyto(view.view(host.dtype).reshape(host.shape), host)
-            self._done = True
-        return self._views
+        """Block until device data is host-visible; returns zero-copy host
+        views (one np.ndarray per input array)."""
+        if self._hosts is None:
+            self._hosts = [np.asarray(arr) for arr in self._arrays]
+        return self._hosts
+
+
+class RegisteredTransfer:
+    """A StagedTransfer whose host buffers are registered with a connection
+    for the duration of one network op: ``wait()`` registers, ``release()``
+    unregisters (call after the op's future resolves)."""
+
+    def __init__(self, transfer: StagedTransfer, conn):
+        self.transfer = transfer
+        self.conn = conn
+        self._registered: List[np.ndarray] = []
+
+    def wait(self) -> List[np.ndarray]:
+        hosts = self.transfer.wait()
+        if not self._registered:
+            for h in hosts:
+                self.conn.register_mr(h.ctypes.data, h.nbytes)
+            self._registered = hosts
+        return hosts
+
+    def release(self):
+        for h in self._registered:
+            self.conn.unregister_mr(h.ctypes.data)
+        self._registered = []
 
 
 class HostStagingPool:
-    """A pinned, connection-registered host buffer carved into uniform block
-    slots (the client-side mirror of the server's mempool; reference clients
+    """A connection-registered host buffer carved into uniform block slots
+    (the client-side mirror of the server's mempool; reference clients
     allocate their own torch tensors instead and register each one,
-    reference infinistore/benchmark.py:144-173)."""
+    reference infinistore/benchmark.py:144-173).
+
+    When ``conn`` is same-host with shm enabled, the pool is allocated via
+    ``alloc_shm_mr`` so the server maps it too and batched ops ride the
+    one-RTT PutFrom/GetInto path; otherwise it is a plain page-aligned
+    registered buffer and ops use the socket (or two-phase shm) plane.
+    """
 
     def __init__(self, nbytes: int, block_size: int, conn=None, align: int = 4096):
         if block_size <= 0 or nbytes < block_size:
             raise ValueError("need nbytes >= block_size > 0")
         self.block_size = block_size
         self.num_slots = nbytes // block_size
-        # Over-allocate to align the base: DCN readv/writev and mlock both
-        # like page-aligned bases.
-        raw = np.zeros(nbytes + align, dtype=np.uint8)
-        base_off = (-raw.ctypes.data) % align
-        self._raw = raw  # keep alive
-        self.buf = raw[base_off : base_off + nbytes]
         self.conn = conn
+        self.server_mapped = False
+        buf = None
         if conn is not None:
-            conn.register_mr(self.buf.ctypes.data, nbytes)
+            buf = conn.alloc_shm_mr(nbytes)  # mmap: page-aligned by nature
+            if buf is not None:
+                self.server_mapped = conn.shm_active
+        if buf is None:
+            # Over-allocate to align the base: DCN readv/writev and mlock both
+            # like page-aligned bases.
+            raw = np.zeros(nbytes + align, dtype=np.uint8)
+            base_off = (-raw.ctypes.data) % align
+            self._raw = raw  # keep alive
+            buf = raw[base_off : base_off + nbytes]
+            if conn is not None:
+                conn.register_mr(buf.ctypes.data, nbytes)
+        self.buf = buf
 
     @property
     def base_ptr(self) -> int:
@@ -82,23 +126,17 @@ class HostStagingPool:
         """How many slots one array of arr_nbytes occupies."""
         return math.ceil(arr_nbytes / self.block_size)
 
-    # -- device -> staging ---------------------------------------------------
+    # -- device -> host ------------------------------------------------------
 
-    def stage_out(
-        self, arrays: Sequence[jax.Array], slots: Sequence[int]
-    ) -> StagedTransfer:
-        """Start async D2H copies of `arrays` into consecutive slots starting
-        at slots[i]. Returns a handle; call .wait() before shipping."""
-        views = []
-        for arr, slot in zip(arrays, slots):
-            nbytes = arr.size * arr.dtype.itemsize
-            needed = self.slots_for(nbytes)
-            if slot + needed > self.num_slots:
-                raise IndexError("array does not fit in staging pool")
-            views.append(self.slot_view(slot, nbytes))
-        return StagedTransfer(arrays, views)
+    def stage_out(self, arrays: Sequence[jax.Array]) -> "RegisteredTransfer":
+        """Start async D2H copies; the returned transfer's ``wait()`` gives
+        zero-copy registered host views to ship from (call ``release()``
+        after the network op completes)."""
+        if self.conn is None:
+            raise ValueError("stage_out needs a connection to register with")
+        return RegisteredTransfer(StagedTransfer(arrays), self.conn)
 
-    # -- staging -> device ---------------------------------------------------
+    # -- host -> device ------------------------------------------------------
 
     def stage_in(
         self,

@@ -60,6 +60,10 @@ class Connection {
     // /root/reference/src/libinfinistore.cpp:728; unregistered base is an
     // error, :602-605).
     int register_mr(void* ptr, size_t size);
+    // Drop a transfer-scoped registration (most recent region with this
+    // base). In-flight ops referencing the region are unaffected: iovecs are
+    // captured at submit time.
+    int unregister_mr(void* ptr);
 
     // Allocate a shm-backed staging region the SERVER maps too: batched ops
     // whose base pointer lies inside it use the one-RTT server-pull/push

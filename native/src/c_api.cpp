@@ -121,6 +121,9 @@ int its_conn_shm_active(void* c) { return static_cast<Connection*>(c)->shm_activ
 void its_conn_close(void* c) { static_cast<Connection*>(c)->close(); }
 void its_conn_destroy(void* c) { delete static_cast<Connection*>(c); }
 int its_conn_connected(void* c) { return static_cast<Connection*>(c)->connected() ? 1 : 0; }
+int its_conn_unregister_mr(void* c, void* ptr) {
+    return static_cast<Connection*>(c)->unregister_mr(ptr);
+}
 int its_conn_register_mr(void* c, void* ptr, uint64_t size) {
     return static_cast<Connection*>(c)->register_mr(ptr, size);
 }

@@ -223,14 +223,32 @@ void Connection::close() {
 
 int Connection::register_mr(void* ptr, size_t size) {
     // Best-effort pin: mlock failure (RLIMIT_MEMLOCK in containers) degrades
-    // to unpinned but the region is still registered for validation.
+    // to unpinned but the region is still registered for validation. Warn
+    // once — per-transfer registrations would otherwise spam the log.
     if (mlock(ptr, size) != 0) {
-        ITS_LOG_WARN("mlock(%zu) failed (%s); region registered unpinned", size,
-                     strerror(errno));
+        static std::atomic<bool> warned{false};
+        if (!warned.exchange(true))
+            ITS_LOG_WARN("mlock(%zu) failed (%s); regions registered unpinned", size,
+                         strerror(errno));
     }
     std::lock_guard<std::mutex> lock(mr_mu_);
     regions_.emplace_back(static_cast<const char*>(ptr), size);
     return 0;
+}
+
+int Connection::unregister_mr(void* ptr) {
+    // Drops the most recent region with this base (transfer-scoped
+    // registrations of short-lived host buffers; the reference instead keeps
+    // an ever-growing MR cache, reference src/libinfinistore.cpp:702-733).
+    std::lock_guard<std::mutex> lock(mr_mu_);
+    for (auto it = regions_.rbegin(); it != regions_.rend(); ++it) {
+        if (it->first == static_cast<const char*>(ptr)) {
+            munlock(ptr, it->second);
+            regions_.erase(std::next(it).base());
+            return 0;
+        }
+    }
+    return -1;
 }
 
 bool Connection::base_registered(const void* base, size_t span) const {

@@ -683,9 +683,9 @@ void Server::handle_shm(Conn* c) {
                 kv_->commit(pending.keys[i], std::move(pending.blocks[i]));
             }
             c->pending_puts.erase(it);
-            // Logical write op: account under 'W' so shm and socket transports
-            // share one metric stream.
-            stats_[kOpPutBatch].record(now_us() - c->op_start_us, in_bytes, 0, true);
+            // Account under 'p' so /stats distinguishes which plane writes
+            // rode ('W' socket, 'p' shm two-phase, 'F' one-RTT segment).
+            stats_[kOpPutAlloc].record(now_us() - c->op_start_us, in_bytes, 0, true);
             c->reset_read();
             send_resp(c, kStatusOk, {}, {}, {});
             return;
@@ -731,7 +731,7 @@ void Server::handle_shm(Conn* c) {
                 refs.push_back(std::move(b));
             }
             c->pending_gets.emplace(resp.ticket, std::move(refs));
-            stats_[kOpGetBatch].record(now_us() - c->op_start_us, 0, total, true);
+            stats_[kOpGetLoc].record(now_us() - c->op_start_us, 0, total, true);
             send_loc_resp(resp);
             return;
         }

@@ -66,17 +66,23 @@ def test_gather_scatter_dispatch_matches_xla():
 
 
 def test_staging_pool_roundtrip():
+    from infinistore_tpu.tpu.staging import StagedTransfer
+
     pool = HostStagingPool(nbytes=1 << 20, block_size=SPEC.block_nbytes)
     arr = jax.random.normal(jax.random.PRNGKey(2), (4, *SPEC.block_shape)).astype(
         SPEC.dtype
     )
-    tr = pool.stage_out([arr], [0])
-    views = tr.wait()
+    # Zero-copy D2H: the host view is jax's own transfer buffer.
+    views = StagedTransfer([arr]).wait()
+    assert views[0].nbytes == arr.size * arr.dtype.itemsize
+    assert np.array_equal(views[0].astype(np.float32), np.asarray(arr, np.float32))
+    # Pool slots round-trip through stage_in.
+    host = views[0].reshape(-1).view(np.uint8)
+    pool.slot_view(0, host.nbytes)[:] = host
     back = pool.stage_in([0], arr.shape, SPEC.dtype)[0]
     assert np.array_equal(
         np.asarray(back, dtype=np.float32), np.asarray(arr, dtype=np.float32)
     )
-    assert views[0].nbytes == arr.size * arr.dtype.itemsize
 
 
 def test_staging_pool_alignment_and_bounds():
@@ -142,5 +148,14 @@ def test_layerwise_prefix_reuse(conn):
 def test_writer_capacity_check(conn):
     spec1 = PagedKVCacheSpec(1, 8, 8, 2, 64, jnp.bfloat16)
     pool = HostStagingPool(nbytes=8 * spec1.block_nbytes, block_size=spec1.block_nbytes)
+    # The writer ships from jax D2H buffers (no pool slots), so a small pool
+    # is fine — but a batch beyond max_blocks must be rejected.
+    writer = LayerwiseKVWriter(conn, pool, spec1, max_blocks=2)
+    cache = _rand_cache(5)
     with pytest.raises(ValueError):
-        LayerwiseKVWriter(conn, pool, spec1, max_blocks=8)  # needs 4x capacity
+        asyncio.run(
+            writer.write([(cache, cache)], np.arange(3, dtype=np.int32), lambda *a: "x")
+        )
+    # The reader does stage through the pool: 8 slots < 4*max_blocks.
+    with pytest.raises(ValueError):
+        LayerwiseKVReader(conn, pool, spec1, max_blocks=8)
