@@ -90,7 +90,7 @@ lib.its_server_stats_json.argtypes = [c_void_p, c_char_p, c_int]
 lib.its_server_stats_json.restype = c_int
 
 # ---- client ----
-lib.its_conn_create.argtypes = [c_char_p, c_int, c_int, c_int]
+lib.its_conn_create.argtypes = [c_char_p, c_int, c_int, c_int, c_int]
 lib.its_conn_create.restype = c_void_p
 lib.its_conn_connect.argtypes = [c_void_p]
 lib.its_conn_connect.restype = c_int

@@ -37,6 +37,10 @@ class ClientConfig:
     connection_type: str = TYPE_RDMA
     log_level: str = "warning"
     connect_timeout_ms: int = 10000
+    # Deadline for synchronous control ops (tcp put/get, check_exist,
+    # match_last_index, delete, stat): a stalled-but-connected server fails
+    # the call with a typed error instead of hanging. <= 0 waits forever.
+    op_timeout_ms: int = 30000
     # Same-host shm fast path: map the server's shm-backed pools and move
     # batched payloads with one memcpy instead of the socket. Auto-degrades
     # to the socket path for remote servers.

@@ -156,6 +156,7 @@ class InfinityConnection:
             self.config.service_port,
             self.config.connect_timeout_ms,
             1 if self.config.enable_shm else 0,
+            self.config.op_timeout_ms,
         )
         rc = lib.its_conn_connect(handle)
         if rc != 0:

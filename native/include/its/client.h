@@ -36,6 +36,11 @@ struct ClientConfig {
     std::string host = "127.0.0.1";
     int port = 22345;
     int connect_timeout_ms = 10000;
+    // Deadline for every synchronous control op (tcp_put/get, check_exist,
+    // match_last_index, delete, stat): a stalled-but-connected server makes
+    // the call fail with kStatusUnavailable instead of hanging the caller
+    // forever. <= 0 waits indefinitely (not recommended).
+    int op_timeout_ms = 30000;
     // Try the same-host shm fast path at connect: map the server's shm-backed
     // pools and move batched payloads with one memcpy instead of the socket.
     // Degrades automatically to the socket path when the server is remote or
@@ -115,8 +120,9 @@ class Connection {
     bool flush_send();
     bool read_ready();
     void complete(std::unique_ptr<Request> req, int code);
-    // timeout_ms < 0 = wait forever; on timeout returns kStatusUnavailable
-    // and abandons the wait (a late response completes into shared state).
+    // timeout_ms < 0 = use config_.op_timeout_ms (which <= 0 waits forever);
+    // on timeout returns kStatusUnavailable and abandons the wait (a late
+    // response completes into shared state, FIFO matching stays intact).
     uint32_t sync_roundtrip(std::unique_ptr<Request> req, std::vector<uint8_t>* body_out,
                             uint8_t** payload_out, size_t* payload_size_out,
                             int timeout_ms = -1);

@@ -108,11 +108,13 @@ int its_server_stats_json(void* s, char* buf, int buf_len) {
 }
 
 // ---- client ----
-void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm) {
+void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm,
+                      int op_timeout_ms) {
     ClientConfig cfg;
     cfg.host = host;
     cfg.port = port;
     cfg.connect_timeout_ms = timeout_ms;
+    cfg.op_timeout_ms = op_timeout_ms;
     cfg.enable_shm = enable_shm != 0;
     return new Connection(cfg);
 }

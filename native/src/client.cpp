@@ -54,6 +54,10 @@ struct Connection::Request {
     bool payload_on_wire = true;
     bool no_response = false;
 
+    // Payload owned by the request itself (sync ops that may be abandoned on
+    // timeout must not reference caller memory from tx_payload).
+    std::vector<uint8_t> owned_payload;
+
     // get-batch scatter destinations (filled sizes arrive in the resp body)
     std::vector<char*> rx_addrs;
     uint32_t block_size = 0;
@@ -423,7 +427,13 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
     req->sync = state;
     auto fut = state->prom.get_future();
     if (submit(std::move(req)) != 0) return kStatusUnavailable;
-    if (timeout_ms >= 0) {
+    bool forever = false;
+    if (timeout_ms < 0) {
+        // Default deadline from config; config <= 0 opts into wait-forever.
+        timeout_ms = config_.op_timeout_ms;
+        forever = timeout_ms <= 0;
+    }
+    if (!forever) {
         if (fut.wait_for(std::chrono::milliseconds(timeout_ms)) !=
             std::future_status::ready) {
             // Abandon: the Request keeps the shared state alive, so a late
@@ -447,7 +457,12 @@ int Connection::tcp_put(const std::string& key, const void* data, size_t size) {
     req->op = kOpTcpPut;
     TcpPutMeta meta{key, size};
     meta.encode(req->body);
-    req->tx_payload.push_back(iovec{const_cast<void*>(data), size});
+    // Own a copy of the payload: sync ops can time out and be abandoned
+    // while the reactor is still streaming the request — the iovec must not
+    // reference caller memory the caller may free after the error returns.
+    req->owned_payload.assign(static_cast<const uint8_t*>(data),
+                              static_cast<const uint8_t*>(data) + size);
+    req->tx_payload.push_back(iovec{req->owned_payload.data(), size});
     uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
     return status == kStatusOk ? 0 : -static_cast<int>(status);
 }

@@ -14,6 +14,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cmath>
 #include <future>
 
 #include "its/iovec_util.h"
@@ -29,13 +30,27 @@ uint64_t now_us() {
     return static_cast<uint64_t>(ts.tv_sec) * 1000000ull + ts.tv_nsec / 1000;
 }
 
-int log2_bucket(uint64_t us) {
-    int b = 0;
-    while (us > 1 && b < 31) {
-        us >>= 1;
-        b++;
-    }
-    return b;
+// HDR-style sub-bucketed index: values < 2^kSubBits map exactly; above, the
+// kSubBits bits below the MSB pick a sub-bucket within the octave.
+int lat_bucket(uint64_t us) {
+    constexpr int sub = OpStats::kSubBits;
+    if (us < (1ull << sub)) return static_cast<int>(us);
+    int msb = 63 - __builtin_clzll(us);
+    int shift = msb - sub;
+    int idx = (1 << sub) + (shift << sub) +
+              static_cast<int>((us >> shift) & ((1 << sub) - 1));
+    return idx < OpStats::kBuckets ? idx : OpStats::kBuckets - 1;
+}
+
+// Geometric midpoint of a bucket (inverse of lat_bucket).
+double lat_bucket_mid(int idx) {
+    constexpr int sub = OpStats::kSubBits;
+    if (idx < (1 << sub)) return static_cast<double>(idx);
+    int group = (idx - (1 << sub)) >> sub;
+    int s = (idx - (1 << sub)) & ((1 << sub) - 1);
+    uint64_t base = (static_cast<uint64_t>((1 << sub) + s)) << group;
+    uint64_t step = 1ull << group;
+    return static_cast<double>(base) + static_cast<double>(step) / 2.0;
 }
 
 }  // namespace
@@ -46,15 +61,20 @@ void OpStats::record(uint64_t us, uint64_t in_bytes, uint64_t out_bytes, bool ok
     bytes_in += in_bytes;
     bytes_out += out_bytes;
     total_us += us;
-    lat_buckets[log2_bucket(us)]++;
+    lat_buckets[lat_bucket(us)]++;
 }
 
-double OpStats::p50_us() const {
+double OpStats::percentile_us(double q) const {
     if (count == 0) return 0.0;
-    uint64_t seen = 0, half = (count + 1) / 2;
-    for (int i = 0; i < 32; i++) {
+    uint64_t seen = 0;
+    // Smallest value whose cumulative share reaches q (ceil, not truncate:
+    // p50 of 81 samples is rank 41).
+    uint64_t rank =
+        static_cast<uint64_t>(std::ceil(q * static_cast<double>(count)));
+    if (rank == 0) rank = 1;
+    for (int i = 0; i < kBuckets; i++) {
         seen += lat_buckets[i];
-        if (seen >= half) return static_cast<double>(1ull << i);
+        if (seen >= rank) return lat_bucket_mid(i);
     }
     return 0.0;
 }
@@ -271,7 +291,8 @@ std::string Server::stats_json() {
                    ",\"bytes_in\":" + std::to_string(s.bytes_in) +
                    ",\"bytes_out\":" + std::to_string(s.bytes_out) +
                    ",\"total_us\":" + std::to_string(s.total_us) +
-                   ",\"p50_us\":" + std::to_string(s.p50_us()) + "}";
+                   ",\"p50_us\":" + std::to_string(s.p50_us()) +
+                   ",\"p99_us\":" + std::to_string(s.p99_us()) + "}";
         }
         out += "}}";
     });

@@ -9,8 +9,10 @@
 #include <string.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <string>
 #include <thread>
@@ -189,8 +191,35 @@ static void test_loopback_end_to_end(bool enable_shm) {
     server.stop();
 }
 
+static void test_opstats_percentile_accuracy() {
+    // The HDR-style histogram must report percentiles within ~10% — the
+    // BASELINE latency metric is p50, so 2x power-of-two quantization is
+    // not acceptable.
+    for (uint64_t center : {7ull, 23ull, 150ull, 1234ull, 87654ull}) {
+        OpStats s;
+        std::vector<uint64_t> vals;
+        for (int d = -40; d <= 40; d++) {
+            uint64_t us = static_cast<uint64_t>(
+                static_cast<double>(center) * (1.0 + 0.004 * d));
+            vals.push_back(us);
+            s.record(us, 0, 0, true);
+        }
+        std::sort(vals.begin(), vals.end());
+        double true_p50 = static_cast<double>(vals[vals.size() / 2]);
+        double got = s.p50_us();
+        double err = std::abs(got - true_p50) / true_p50;
+        CHECK(err <= 0.10);
+    }
+    OpStats empty;
+    CHECK(empty.p50_us() == 0.0);
+    OpStats one;
+    one.record(100, 0, 0, true);
+    CHECK(std::abs(one.p99_us() - 100.0) / 100.0 <= 0.10);
+}
+
 int main() {
     set_log_level(LogLevel::kError);
+    test_opstats_percentile_accuracy();
     test_mempool_basic();
     test_mempool_exhaustion_and_rollback();
     test_kvstore_lru_eviction();

@@ -233,3 +233,52 @@ def test_concurrent_clients(server):
     for t in threads:
         t.join()
     assert not errors
+
+
+def test_sync_ops_time_out_on_hung_server():
+    """A server that accepts but never responds must fail sync control ops
+    with a typed error after op_timeout_ms — never hang the caller
+    (reference risk: its sync paths block on loop.run_until_complete with no
+    deadline; here every sync wait is bounded by config)."""
+    import socket as socklib
+    import threading
+    import time
+
+    listener = socklib.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    accepted = []
+
+    def accept_and_stall():
+        s, _ = listener.accept()
+        accepted.append(s)  # keep it open, read nothing, answer nothing
+
+    t = threading.Thread(target=accept_and_stall, daemon=True)
+    t.start()
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=port,
+            log_level="error",
+            enable_shm=False,  # skip the (also bounded) shm handshake
+            op_timeout_ms=300,
+        )
+    )
+    c.connect()
+    t0 = time.time()
+    with pytest.raises(its.InfiniStoreException):
+        c.check_exist("any-key")
+    elapsed = time.time() - t0
+    assert elapsed < 5, f"sync op took {elapsed:.1f}s — timeout not applied"
+    # tcp_put is bounded too (buffer kept alive past close: the abandoned
+    # request may still reference its own copy, never caller memory).
+    payload = np.zeros(16, np.uint8)
+    t0 = time.time()
+    with pytest.raises(its.InfiniStoreException):
+        c.tcp_write_cache("k", payload.ctypes.data, 16)
+    assert time.time() - t0 < 5
+    c.close()
+    listener.close()
+    for s in accepted:
+        s.close()
