@@ -69,8 +69,14 @@ class RegisteredTransfer:
         return hosts
 
     def release(self):
+        # Best-effort cleanup: a connection closed mid-flight already cleared
+        # its region list — that must not mask the transport error the
+        # caller is about to see (nor abort sibling releases).
         for h in self._registered:
-            self.conn.unregister_mr(h.ctypes.data)
+            try:
+                self.conn.unregister_mr(h.ctypes.data)
+            except Exception:
+                pass
         self._registered = []
 
 

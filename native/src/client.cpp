@@ -460,6 +460,9 @@ int Connection::tcp_put(const std::string& key, const void* data, size_t size) {
     // Own a copy of the payload: sync ops can time out and be abandoned
     // while the reactor is still streaming the request — the iovec must not
     // reference caller memory the caller may free after the error returns.
+    // The copy is a deliberate tax on this single-key convenience path;
+    // bulk data belongs on the batched zero-copy API (register_mr +
+    // put_batch_async), which keeps caller ownership until completion.
     req->owned_payload.assign(static_cast<const uint8_t*>(data),
                               static_cast<const uint8_t*>(data) + size);
     req->tx_payload.push_back(iovec{req->owned_payload.data(), size});
