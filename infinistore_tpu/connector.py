@@ -64,21 +64,41 @@ class KVConnector:
         model_id: str,
         max_blocks: int,
         pool: Optional[HostStagingPool] = None,
+        ici=None,
     ):
+        """``ici``: an optional ``IciBlockTransfer`` bound to the SPMD mesh
+        this engine runs in. When set, ``handoff`` moves blocks HBM->HBM over
+        the interconnect; without it (or across meshes) the same call
+        degrades to the DCN store path (SURVEY §7 hard part 4). ``conn`` may
+        be None for a pure-ICI connector (no store in the loop)."""
         self.conn = conn
         self.spec = spec
         self.model_id = model_id
         self.max_blocks = max_blocks
-        if pool is None:
-            # 6 read-staging regions (K+V each): deep enough that network
-            # fetches and H2D uploads overlap several layers (layerwise.py
-            # _LayerRegions adapts the pipeline depth to this size).
-            pool = HostStagingPool(
-                12 * max_blocks * spec.block_nbytes, spec.block_nbytes, conn=conn
+        self.ici = ici
+        if conn is None:
+            # Pure-ICI connector: no store data plane, so don't allocate the
+            # (potentially tens of MB) host staging pool it would need.
+            self.pool = pool
+            self._writer = self._reader = None
+        else:
+            if pool is None:
+                # 6 read-staging regions (K+V each): deep enough that network
+                # fetches and H2D uploads overlap several layers (layerwise.py
+                # _LayerRegions adapts the pipeline depth to this size).
+                pool = HostStagingPool(
+                    12 * max_blocks * spec.block_nbytes, spec.block_nbytes, conn=conn
+                )
+            self.pool = pool
+            self._writer = LayerwiseKVWriter(conn, pool, spec, max_blocks)
+            self._reader = LayerwiseKVReader(conn, pool, spec, max_blocks)
+
+    def _require_store(self, what: str):
+        if self.conn is None:
+            raise ValueError(
+                f"{what} needs a store connection; this connector was built "
+                "conn=None (pure-ICI)"
             )
-        self.pool = pool
-        self._writer = LayerwiseKVWriter(conn, pool, spec, max_blocks)
-        self._reader = LayerwiseKVReader(conn, pool, spec, max_blocks)
 
     # -- key scheme ----------------------------------------------------------
 
@@ -116,6 +136,7 @@ class KVConnector:
         """Stream the request's KV blocks to the store. ``block_ids[i]`` is
         the engine's physical block holding logical block i of this prompt.
         Returns blocks written (K+V across layers)."""
+        self._require_store("save")
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
         n = min(len(chains), len(block_ids))
         if n == 0:
@@ -135,6 +156,7 @@ class KVConnector:
         returned caches; do not touch the inputs again — on a real chip they
         are deleted buffers after this call.
         """
+        self._require_store("load")
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
         hit = self._lookup_chains(chains)
         n = min(hit, len(block_ids))
@@ -149,6 +171,59 @@ class KVConnector:
             # cache semantics — the engine just recomputes.
             return list(caches), 0
         return out, n
+
+    async def handoff(
+        self,
+        token_ids,
+        caches,
+        src_block_ids: np.ndarray,
+        dst_block_ids: np.ndarray,
+        src: Optional[int] = None,
+        dst: Optional[int] = None,
+    ):
+        """Move a request's KV blocks from a producer to a consumer — one
+        API, two transports (reference has only its NIC transport; on TPU
+        pods the interconnect is the fast path).
+
+        Same-mesh (``ici`` bound and ``src``/``dst`` shard indices given):
+        per-layer gather + ppermute + scatter in one jitted SPMD program per
+        layer — HBM->HBM over ICI, no host, no store. ``caches`` must be
+        per-layer (K, V) arrays of shape [axis_size, num_blocks, *block]
+        sharded over the transfer axis; inputs are donated (use the returned
+        caches).
+
+        Otherwise: degrades to the DCN store — save the blocks under the
+        request's chain keys, then load them into ``dst_block_ids`` (the
+        cross-process flow runs save on the producer and load on the
+        consumer; calling handoff on one process does both for tests and
+        single-engine reuse). ``caches`` are plain [num_blocks, *block]
+        arrays here.
+
+        Returns (updated caches, blocks moved).
+        """
+        # Both transports move the same amount: the request's COMPLETE token
+        # blocks (an incomplete tail block has no chain key, so the DCN path
+        # could never carry it — the ICI path must agree or a cross-mesh
+        # fallback would silently serve different data).
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        n = min(len(src_block_ids), len(dst_block_ids), len(chains))
+        if n == 0:
+            return list(caches), 0
+        if self.ici is not None and src is not None and dst is not None:
+            out = []
+            for k_cache, v_cache in caches:
+                out.append(self.ici.handoff_kv(
+                    k_cache, v_cache, src_block_ids[:n], dst_block_ids[:n], src, dst
+                ))
+            return out, n
+        if self.ici is not None and self.conn is None:
+            raise ValueError(
+                "pure-ICI connector: handoff needs src and dst shard indices "
+                "(no store connection to fall back to)"
+            )
+        self._require_store("handoff (DCN fallback)")
+        await self.save(token_ids, caches, np.asarray(src_block_ids)[:n])
+        return await self.load(token_ids, caches, np.asarray(dst_block_ids)[:n])
 
     def drop(self, token_ids) -> int:
         """Remove this prompt's blocks from the store (all layers). Returns
