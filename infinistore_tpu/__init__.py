@@ -20,6 +20,7 @@ from .lib import (
     InfiniStoreException,
     InfiniStoreKeyNotFound,
     InfinityConnection,
+    StripedConnection,
     Logger,
     evict_cache,
     get_kvmap_len,
@@ -47,6 +48,7 @@ __all__ = [
     "KVConnector",
     "token_chain_hashes",
     "InfinityConnection",
+    "StripedConnection",
     "register_server",
     "start_local_server",
     "unregister_server",

@@ -15,7 +15,7 @@ import uuid
 import numpy as np
 
 from .config import TYPE_RDMA, TYPE_TCP, ClientConfig
-from .lib import InfinityConnection
+from .lib import InfinityConnection, StripedConnection
 
 
 def parse_args(argv=None):
@@ -37,6 +37,11 @@ def parse_args(argv=None):
         "--latency", action="store_true",
         help="also measure single-block fetch latency p50/p99 at 4KB and 64KB "
              "(the BASELINE.md 'p50 block-fetch latency' configs)",
+    )
+    p.add_argument(
+        "--streams", type=int, default=1,
+        help="connection stripes for batched ops (cross-host DCN scaling; "
+             "see docs/multistream.md)",
     )
     return p.parse_args(argv)
 
@@ -98,7 +103,10 @@ def run(args) -> dict:
         connection_type=TYPE_RDMA if args.type == "rdma" else TYPE_TCP,
         log_level="warning",
     )
-    conn = InfinityConnection(cfg)
+    if args.streams > 1:
+        conn = StripedConnection(cfg, streams=args.streams)
+    else:
+        conn = InfinityConnection(cfg)
     conn.connect()
 
     total_bytes = args.size << 20

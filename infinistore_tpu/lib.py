@@ -398,6 +398,115 @@ class InfinityConnection:
         return json.loads(buf.value.decode())
 
 
+class StripedConnection:
+    """N socket streams to one server behind the single-connection API.
+
+    The reference reaches cross-host line rate by keeping up to 8000
+    outstanding work requests on ONE RDMA queue pair (reference
+    src/protocol.h:22-26); a TCP stream has no such depth — per-connection
+    congestion windows and the kernel's per-socket processing cap a single
+    stream well below NIC rate on DCN. Striping opens `streams` independent
+    connections and splits every batched op across them (contiguous chunks,
+    so scatter/gather runs stay long). See docs/multistream.md for when this
+    wins (cross-host) and when it cannot (same-host: memcpy-bound).
+
+    Control ops, the shm fast path, and stats ride stripe 0; batched
+    data-plane ops fan out. The surface mirrors InfinityConnection.
+    """
+
+    def __init__(self, config: ClientConfig, streams: int = 4):
+        if streams < 1:
+            raise ValueError("streams must be >= 1")
+        self.config = config
+        self.conns = [InfinityConnection(config) for _ in range(streams)]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def connect(self):
+        for c in self.conns:
+            c.connect()
+
+    async def connect_async(self):
+        await asyncio.gather(*(c.connect_async() for c in self.conns))
+
+    def close(self):
+        for c in self.conns:
+            c.close()
+
+    @property
+    def shm_active(self) -> bool:
+        return self.conns[0].shm_active
+
+    # -- memory registration (fan out: a batch may land on any stripe) -------
+
+    def register_mr(self, arg, size: Optional[int] = None):
+        for c in self.conns:
+            c.register_mr(arg, size)
+        return 0
+
+    def unregister_mr(self, arg):
+        for c in self.conns:
+            c.unregister_mr(arg)
+
+    def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
+        """Segment lives on stripe 0 (one-RTT path there); other stripes see
+        it as a plain registered region (two-phase shm / socket path)."""
+        buf = self.conns[0].alloc_shm_mr(nbytes)
+        if buf is None:
+            return None
+        for c in self.conns[1:]:
+            c.register_mr(buf.ctypes.data, nbytes)
+        return buf
+
+    # -- batched data plane: split across stripes ----------------------------
+
+    def _split(self, blocks: List[Tuple[str, int]]) -> List[List[Tuple[str, int]]]:
+        n = len(self.conns)
+        per = (len(blocks) + n - 1) // n
+        return [blocks[i : i + per] for i in range(0, len(blocks), per)]
+
+    async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
+        if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
+            return await self.conns[0].write_cache_async(blocks, block_size, ptr)
+        chunks = self._split(blocks)
+        return (await asyncio.gather(*(
+            c.write_cache_async(chunk, block_size, ptr)
+            for c, chunk in zip(self.conns, chunks)
+        )))[0]
+
+    async def rdma_read_cache_async(self, blocks, block_size: int, ptr: int):
+        if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
+            return await self.conns[0].read_cache_async(blocks, block_size, ptr)
+        chunks = self._split(blocks)
+        return (await asyncio.gather(*(
+            c.read_cache_async(chunk, block_size, ptr)
+            for c, chunk in zip(self.conns, chunks)
+        )))[0]
+
+    write_cache_async = rdma_write_cache_async
+    read_cache_async = rdma_read_cache_async
+
+    # -- control / single-key ops: stripe 0 ----------------------------------
+
+    def tcp_write_cache(self, key, ptr, size, **kw):
+        return self.conns[0].tcp_write_cache(key, ptr, size, **kw)
+
+    def tcp_read_cache(self, key, **kw):
+        return self.conns[0].tcp_read_cache(key, **kw)
+
+    def check_exist(self, key):
+        return self.conns[0].check_exist(key)
+
+    def get_match_last_index(self, keys):
+        return self.conns[0].get_match_last_index(keys)
+
+    def delete_keys(self, keys):
+        return self.conns[0].delete_keys(keys)
+
+    def get_stats(self):
+        return self.conns[0].get_stats()
+
+
 # ---------------------------------------------------------------------------
 # Server control plane (module-level, mirroring the reference's globals:
 # register_server lib.py:203, evict_cache :232, purge_kv_map :190,
