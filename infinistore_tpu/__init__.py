@@ -19,6 +19,7 @@ from .config import (
 from .lib import (
     InfiniStoreException,
     InfiniStoreKeyNotFound,
+    InfiniStoreNoMatch,
     InfinityConnection,
     StripedConnection,
     Logger,
@@ -67,5 +68,6 @@ __all__ = [
     "get_server_stats",
     "InfiniStoreException",
     "InfiniStoreKeyNotFound",
+    "InfiniStoreNoMatch",
     "evict_cache",
 ]

@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .lib import InfiniStoreKeyNotFound
+from .lib import InfiniStoreKeyNotFound, InfiniStoreNoMatch
 from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter
 from .tpu.paged import PagedKVCacheSpec
 from .tpu.staging import HostStagingPool
@@ -120,7 +120,14 @@ class KVConnector:
         block (the writer commits layer 0 last, so a present sentinel means
         every layer is present), and the store's binary-search longest-prefix
         match does the rest.
+
+        Only a semantic no-match maps to 0. A dead store, a timeout, or a
+        protocol error raises — the engine must see the difference between
+        "not cached" and "store unreachable", or it silently recomputes
+        forever (the reference likewise surfaces transport errors as their
+        own exceptions, reference lib.py:575-577).
         """
+        self._require_store("lookup")
         return self._lookup_chains(token_chain_hashes(token_ids, self.spec.block_tokens))
 
     def _lookup_chains(self, chains: List[str]) -> int:
@@ -129,7 +136,7 @@ class KVConnector:
         keys = [self.block_key(0, "k", c) for c in chains]
         try:
             return self.conn.get_match_last_index(keys) + 1
-        except Exception:
+        except InfiniStoreNoMatch:
             return 0
 
     async def save(self, token_ids, caches, block_ids: np.ndarray) -> int:
@@ -222,12 +229,28 @@ class KVConnector:
                 "(no store connection to fall back to)"
             )
         self._require_store("handoff (DCN fallback)")
+        # The DCN path gathers along axis 0 = blocks, so an ICI-layout cache
+        # ([axis_size, num_blocks, *block] — one extra leading dim) would be
+        # gathered along the DEVICE axis and ship wrong bytes under valid
+        # keys. Reject it loudly instead of corrupting silently.
+        want = 1 + len(self.spec.block_shape)  # [num_blocks, *block]
+        for k_cache, v_cache in caches:
+            for c in (k_cache, v_cache):
+                if c.ndim != want or tuple(c.shape[1:]) != tuple(self.spec.block_shape):
+                    raise ValueError(
+                        "handoff DCN fallback needs per-layer caches of shape "
+                        f"[num_blocks, {', '.join(map(str, self.spec.block_shape))}]; "
+                        f"got {tuple(c.shape)}. ICI-layout caches "
+                        "([axis_size, num_blocks, *block]) require src and dst "
+                        "shard indices so the transfer rides the interconnect."
+                    )
         await self.save(token_ids, caches, np.asarray(src_block_ids)[:n])
         return await self.load(token_ids, caches, np.asarray(dst_block_ids)[:n])
 
     def drop(self, token_ids) -> int:
         """Remove this prompt's blocks from the store (all layers). Returns
         the number of store keys deleted."""
+        self._require_store("drop")
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
         keys = [
             self.block_key(layer, kind, c)

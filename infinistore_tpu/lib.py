@@ -49,6 +49,14 @@ class InfiniStoreKeyNotFound(InfiniStoreException):
     """Typed miss for read paths (reference lib.py:33)."""
 
 
+class InfiniStoreNoMatch(InfiniStoreException):
+    """get_match_last_index found no matching prefix — a semantic miss,
+    distinct from a transport/timeout failure (which raises the base
+    InfiniStoreException). The reference conflates the two in one generic
+    exception (reference lib.py:575-577); connectors need the split so a
+    dead store is not mistaken for a cache miss."""
+
+
 class Logger:
     """Log facade over the native sink (reference Logger, lib.py:155-174)."""
 
@@ -373,7 +381,7 @@ class InfinityConnection:
         if idx == -(2**31):
             raise InfiniStoreException("get_match_last_index transport error")
         if idx < 0:
-            raise InfiniStoreException("can't find a match")
+            raise InfiniStoreNoMatch("can't find a match")
         return idx
 
     def delete_keys(self, keys: List[str]) -> int:
@@ -465,23 +473,38 @@ class StripedConnection:
         per = (len(blocks) + n - 1) // n
         return [blocks[i : i + per] for i in range(0, len(blocks), per)]
 
+    @staticmethod
+    async def _gather_settled(coros):
+        """Run the per-stripe chunk ops to completion — ALL of them — before
+        raising. A fail-fast gather would hand control back to the caller
+        (who may unregister and free the staging buffer) while sibling
+        stripes' ops are still scatter/gathering from that memory in the
+        native reactor: an error-path use-after-free window."""
+        results = await asyncio.gather(*coros, return_exceptions=True)
+        errors = [r for r in results if isinstance(r, BaseException)]
+        if errors:
+            for extra in errors[1:]:  # don't silently drop sibling failures
+                Logger.warn(f"striped op: suppressed sibling stripe error: {extra!r}")
+            raise errors[0]
+        return results[0]
+
     async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
         if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
             return await self.conns[0].write_cache_async(blocks, block_size, ptr)
         chunks = self._split(blocks)
-        return (await asyncio.gather(*(
+        return await self._gather_settled(
             c.write_cache_async(chunk, block_size, ptr)
             for c, chunk in zip(self.conns, chunks)
-        )))[0]
+        )
 
     async def rdma_read_cache_async(self, blocks, block_size: int, ptr: int):
         if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
             return await self.conns[0].read_cache_async(blocks, block_size, ptr)
         chunks = self._split(blocks)
-        return (await asyncio.gather(*(
+        return await self._gather_settled(
             c.read_cache_async(chunk, block_size, ptr)
             for c, chunk in zip(self.conns, chunks)
-        )))[0]
+        )
 
     write_cache_async = rdma_write_cache_async
     read_cache_async = rdma_read_cache_async

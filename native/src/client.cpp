@@ -247,8 +247,35 @@ int Connection::unregister_mr(void* ptr) {
     std::lock_guard<std::mutex> lock(mr_mu_);
     for (auto it = regions_.rbegin(); it != regions_.rend(); ++it) {
         if (it->first == static_cast<const char*>(ptr)) {
-            munlock(ptr, it->second);
+            const char* base = it->first;
+            size_t size = it->second;
             regions_.erase(std::next(it).base());
+            // munlock unpins whole pages no matter how many registrations
+            // cover them, so a duplicate/overlapping registration must keep
+            // its pages pinned when this one goes. Subtract every surviving
+            // region (expanded to page boundaries, since a shared boundary
+            // page must also stay pinned) and unpin only what remains.
+            const size_t pg = static_cast<size_t>(sysconf(_SC_PAGESIZE));
+            std::vector<std::pair<const char*, const char*>> unpin{
+                {base, base + size}};
+            for (const auto& [rs, rsz] : regions_) {
+                const char* lo = reinterpret_cast<const char*>(
+                    reinterpret_cast<uintptr_t>(rs) / pg * pg);
+                const char* hi = reinterpret_cast<const char*>(
+                    (reinterpret_cast<uintptr_t>(rs + rsz) + pg - 1) / pg * pg);
+                std::vector<std::pair<const char*, const char*>> next;
+                for (auto [a, b] : unpin) {
+                    if (hi <= a || lo >= b) {
+                        next.emplace_back(a, b);
+                        continue;
+                    }
+                    if (a < lo) next.emplace_back(a, lo);
+                    if (hi < b) next.emplace_back(hi, b);
+                }
+                unpin.swap(next);
+            }
+            for (auto [a, b] : unpin)
+                munlock(const_cast<char*>(a), static_cast<size_t>(b - a));
             return 0;
         }
     }

@@ -122,6 +122,10 @@ struct Server::Conn {
     struct PendingPut {
         std::vector<std::string> keys;
         std::vector<BlockRef> blocks;
+        // Stamped at the PutAlloc leg so the commit-time stats record spans
+        // the whole logical op (alloc RTT + client memcpy + commit RTT), not
+        // just the commit leg.
+        uint64_t start_us = 0;
     };
     uint64_t next_ticket = 1;
     std::unordered_map<uint64_t, PendingPut> pending_puts;
@@ -667,6 +671,7 @@ void Server::handle_shm(Conn* c) {
             resp.ticket = c->next_ticket++;
             Conn::PendingPut pending;
             pending.keys = std::move(m.keys);
+            pending.start_us = c->op_start_us;
             pending.blocks.reserve(n);
             resp.locs.reserve(n);
             bool mappable = true;
@@ -699,6 +704,7 @@ void Server::handle_shm(Conn* c) {
             }
             uint64_t in_bytes = 0;
             auto& pending = it->second;
+            uint64_t op_start = pending.start_us ? pending.start_us : c->op_start_us;
             for (size_t i = 0; i < pending.keys.size(); i++) {
                 in_bytes += pending.blocks[i]->size();
                 kv_->commit(pending.keys[i], std::move(pending.blocks[i]));
@@ -706,7 +712,8 @@ void Server::handle_shm(Conn* c) {
             c->pending_puts.erase(it);
             // Account under 'p' so /stats distinguishes which plane writes
             // rode ('W' socket, 'p' shm two-phase, 'F' one-RTT segment).
-            stats_[kOpPutAlloc].record(now_us() - c->op_start_us, in_bytes, 0, true);
+            // Latency spans alloc -> commit (see PendingPut::start_us).
+            stats_[kOpPutAlloc].record(now_us() - op_start, in_bytes, 0, true);
             c->reset_read();
             send_resp(c, kStatusOk, {}, {}, {});
             return;

@@ -134,3 +134,59 @@ def test_drop_removes_all_layers(connector, conn):
     deleted = connector.drop(tokens)
     assert deleted == 2 * 2 * SPEC.num_layers
     assert connector.lookup(tokens) == 0
+
+
+def test_lookup_raises_when_store_down():
+    """A dead store must NOT read as a cache miss: miss -> 0, failure ->
+    exception (else the engine silently recomputes forever). Mirrors the
+    reference's typed behavior (reference lib.py:575-577)."""
+    import infinistore_tpu as its
+
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    cfg = its.ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=srv.port,
+        connection_type=its.TYPE_RDMA,
+        log_level="error",
+    )
+    c = its.InfinityConnection(cfg)
+    try:
+        c.connect()
+        k = KVConnector(c, SPEC, model_id="demo-llama", max_blocks=8)
+        tokens = list(range(16))
+        assert k.lookup(tokens) == 0  # genuine miss -> 0, no exception
+        srv.stop()  # kill the server out from under the connection
+        with pytest.raises(its.InfiniStoreException) as ei:
+            k.lookup(tokens)
+        assert not isinstance(ei.value, its.InfiniStoreNoMatch)
+    finally:
+        c.close()
+        srv.stop()  # no-op on the success path (stop() is idempotent)
+
+
+def test_pure_ici_connector_typed_errors():
+    """conn=None (pure-ICI): store-needing ops raise the typed misuse error,
+    not a bare AttributeError / silent 0."""
+    k = KVConnector(None, SPEC, model_id="demo", max_blocks=8, ici=object())
+    tokens = list(range(16))
+    with pytest.raises(ValueError, match="store connection"):
+        k.lookup(tokens)
+    with pytest.raises(ValueError, match="store connection"):
+        k.drop(tokens)
+
+
+def test_handoff_rejects_ici_layout_caches_on_dcn_path(connector):
+    """An ICI-layout cache ([axis_size, num_blocks, *block]) falling through
+    to the DCN path would be gathered along the DEVICE axis and ship wrong
+    bytes under valid keys — it must raise instead."""
+    tokens = list(range(16))
+    ici_shaped = [
+        (
+            jnp.zeros((2, *SPEC.cache_shape), SPEC.dtype),
+            jnp.zeros((2, *SPEC.cache_shape), SPEC.dtype),
+        )
+        for _ in range(SPEC.num_layers)
+    ]
+    ids = np.array([0, 1], dtype=np.int32)
+    with pytest.raises(ValueError, match="ICI-layout"):
+        asyncio.run(connector.handoff(tokens, ici_shaped, ids, ids))
