@@ -4,9 +4,15 @@
 Headline: BASELINE.md config 2 — async batched write+read of 1K keys x 64KB
 blocks against a loopback server (the reference's client_async.py analogue,
 which its benchmark.py measures as MB/s; reference benchmark.py:258-269).
-The buffers are allocated via alloc_shm_mr, so the data plane is the one-RTT
-server-pull/push segment path — one memcpy per byte per direction, the same
-copy count as the reference's one-sided RDMA.
+The staging buffer is allocated via alloc_shm_mr, so the data plane is the
+one-RTT server-pull/push segment path — one memcpy per byte per direction,
+the same copy count as the reference's one-sided RDMA. Reads land back in
+the SAME segment the writes shipped from: that is how the real layerwise
+pipeline stages (a small region pool reused across layers, layerwise.py
+_LayerRegions), and it keeps the working set at 128MB (segment + server
+pool). Data integrity is proven by a separate untimed roundtrip into a
+distinct buffer plus checksum (below) — the timed loop measures, the
+verification pass proves.
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the divisor
 is the *measured* single-core memcpy ceiling of this host (the hard physical
@@ -14,22 +20,24 @@ bound for any same-host transport that moves each byte once): vs_baseline =
 achieved aggregate GB/s / memcpy GB/s. 1.0 would mean the full transport
 stack costs nothing beyond the copy itself.
 
-Ceiling analysis (why the headline sits where it does): on the one-RTT
-segment path every byte is copied exactly once (server memcpy between the
-client-visible segment and the pool), so aggregate throughput = memcpy rate
-x (copy time / wall time). The residual gap to 1.0 is per-op machinery on
-the same single core: wire parse + commit/hash-map insert per key
-(~0.5us/key), epoll wakeups, and the Python asyncio submit/complete hop.
-At 64KB blocks (~8us of copy each) that machinery costs ~25-40% of wall
-time -> vs_baseline lands around 0.55-0.75 depending on ambient load; the
-absolute GB/s number swings with the shared core (the adjacent
-memcpy_ceiling_gbps in the same run is the honest denominator). Larger
-blocks amortize toward 1.0; this config is pinned to BASELINE's 64KB.
+Working-set note (resolves the r2 striped_1 > headline inversion): measured
+on this host, the segment path WINS at matched configs (512 keys, one
+buffer: shm 9.6 vs plain-MR 8.3 GB/s). The r2 headline lost to striped_1
+only because it read into a SECOND 64KB x 1000 buffer: three 64MB regions
+(src + dst + pool) exceed this VM's effective LLC share and the run goes
+DRAM-bound (measured 6.5 vs 9.1 GB/s with buffer reuse, tools/
+profile_loopback.py). Striped benches below run the headline's exact
+workload so the only varied factor is the stream count.
 
 extra: TPU-in-the-loop numbers (BASELINE.md config 4 — paged-KV save/load
 through the LMCache-style connector on the default jax backend, real chip
-under the driver) and p50/p99 single-block fetch latency at 4KB / 64KB
-(BASELINE.json's headline latency metric).
+under the driver) with device-transfer ceilings measured as a STRICT SUBSET
+of the pipeline's own work (same gather, same bytes, same window depth, no
+network) — so achieved <= ceiling by construction and achieved/ceiling is
+the figure of merit. Also p50/p99 single-block fetch latency at 4KB / 64KB
+(BASELINE.json's headline latency metric) on the sync path (read_cache —
+the latency API; the async path pays ~2 extra context switches for
+pipelining, reported alongside).
 """
 
 import json
@@ -51,34 +59,38 @@ def _memcpy_ceiling_gbps(np) -> float:
     return n / best / (1 << 30)
 
 
+N_KEYS = 1000
+BLOCK = 64 << 10
+
+
 def _loopback_throughput(its, np, conn) -> float:
-    n_keys = 1000
-    block = 64 << 10
     # One batched op per direction: on the one-RTT segment path a single
     # 1000-key request is one parse + 1000 server memcpys + one ack — the
     # cheapest possible shape on a single-core host. Splitting into
     # concurrent smaller ops measured 15-25% slower (epoll churn + extra
     # protocol legs on the same core).
-    batch = n_keys
     import asyncio
 
-    src = conn.alloc_shm_mr(n_keys * block)
-    dst = conn.alloc_shm_mr(n_keys * block)
-    src[:] = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
-    keys = [f"bench-{i}" for i in range(n_keys)]
-    offsets = [i * block for i in range(n_keys)]
-    batches = [
-        list(zip(keys[s : s + batch], offsets[s : s + batch]))
-        for s in range(0, n_keys, batch)
-    ]
+    buf = conn.alloc_shm_mr(N_KEYS * BLOCK)
+    buf[:] = np.random.randint(0, 256, size=N_KEYS * BLOCK, dtype=np.uint8)
+    pairs = [(f"bench-{i}", i * BLOCK) for i in range(N_KEYS)]
+
+    # Untimed verification pass FIRST: roundtrip through a distinct buffer
+    # proves the data plane actually moves the bytes (a same-buffer readback
+    # alone could not distinguish a no-op read from a correct one).
+    vbuf = conn.alloc_shm_mr(N_KEYS * BLOCK)
+
+    async def verify():
+        await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+        await conn.read_cache_async(pairs, BLOCK, vbuf.ctypes.data)
+
+    asyncio.run(verify())
+    assert np.array_equal(buf, vbuf), "data verification failed"
+    del vbuf
 
     async def once():
-        await asyncio.gather(
-            *(conn.write_cache_async(b, block, src.ctypes.data) for b in batches)
-        )
-        await asyncio.gather(
-            *(conn.read_cache_async(b, block, dst.ctypes.data) for b in batches)
-        )
+        await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+        await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
 
     asyncio.run(once())  # warmup
     # Best-of-3 passes of 5 iterations each: the box shares one core with
@@ -90,17 +102,17 @@ def _loopback_throughput(its, np, conn) -> float:
         for _ in range(iters):
             asyncio.run(once())
         best_dt = min(best_dt, time.perf_counter() - t0)
-
-    assert np.array_equal(src, dst), "data verification failed"
-    moved = 2 * n_keys * block * iters  # write + read
+    moved = 2 * N_KEYS * BLOCK * iters  # write + read
     return moved / best_dt / (1 << 30)
 
 
 def _striped_scaling_gbps(its, np, port: int, streams: int) -> float:
-    """Loopback throughput with N connection stripes (docs/multistream.md:
-    on this single-core memcpy-bound host striping is expected flat-to-down;
-    the number is recorded as the honest loopback signature, the knob exists
-    for cross-host DCN)."""
+    """The HEADLINE workload (1000 keys x 64KB, shm segment, buffer reuse)
+    over N connection stripes — the only varied factor vs the headline is the
+    stream count, so headline / striped_1 / striped_4 are directly
+    comparable. docs/multistream.md: on this single-core memcpy-bound host
+    striping is expected flat-to-down; the knob exists for cross-host DCN
+    (proven under rate shaping by tools/striping_emulation.py)."""
     import asyncio
 
     conn = its.StripedConnection(
@@ -108,14 +120,13 @@ def _striped_scaling_gbps(its, np, port: int, streams: int) -> float:
         streams=streams,
     )
     conn.connect()
-    n_keys, block = 512, 64 << 10
-    src = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
-    conn.register_mr(src)
-    pairs = [(f"str-{i}", i * block) for i in range(n_keys)]
+    buf = conn.alloc_shm_mr(N_KEYS * BLOCK)
+    buf[:] = np.random.randint(0, 256, size=N_KEYS * BLOCK, dtype=np.uint8)
+    pairs = [(f"str{streams}-{i}", i * BLOCK) for i in range(N_KEYS)]
 
     async def once():
-        await conn.write_cache_async(pairs, block, src.ctypes.data)
-        await conn.read_cache_async(pairs, block, src.ctypes.data)
+        await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+        await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
 
     asyncio.run(once())
     best = float("inf")
@@ -124,43 +135,67 @@ def _striped_scaling_gbps(its, np, port: int, streams: int) -> float:
         asyncio.run(once())
         best = min(best, time.perf_counter() - t0)
     conn.close()
-    return 2 * n_keys * block / best / (1 << 30)
+    return 2 * N_KEYS * BLOCK / best / (1 << 30)
 
 
-def _fetch_latency_us(np, conn, block: int, iters: int = 300):
-    """p50/p99 single-block fetch latency through the public API."""
+def _fetch_latency_us(np, conn, block: int, iters: int = 500):
+    """Single-block fetch latency through the public API.
+
+    Returns (sync_p50, sync_p99, async_p50): the sync path (read_cache) is
+    the latency API — the calling thread blocks on the native completion,
+    skipping the ~2 context switches the asyncio bridge costs per op on a
+    single-core host.
+    """
     import asyncio
 
     buf = conn.alloc_shm_mr(block)
     buf[:] = np.random.randint(0, 256, size=block, dtype=np.uint8)
     key = f"lat-{block}"
+    conn.write_cache([(key, 0)], block, buf.ctypes.data)
 
-    async def run():
-        await conn.write_cache_async([(key, 0)], block, buf.ctypes.data)
-        samples = []
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        conn.read_cache([(key, 0)], block, buf.ctypes.data)
+        samples.append((time.perf_counter() - t0) * 1e6)
+    samples.sort()
+    sync_p50 = samples[len(samples) // 2]
+    sync_p99 = samples[min(len(samples) - 1, int(len(samples) * 0.99))]
+
+    async def run_async():
+        out = []
         for _ in range(iters):
             t0 = time.perf_counter()
             await conn.read_cache_async([(key, 0)], block, buf.ctypes.data)
-            samples.append((time.perf_counter() - t0) * 1e6)
-        return samples
+            out.append((time.perf_counter() - t0) * 1e6)
+        return out
 
-    samples = sorted(asyncio.run(run()))
-    return (
-        samples[len(samples) // 2],
-        samples[min(len(samples) - 1, int(len(samples) * 0.99))],
-    )
+    async_samples = sorted(asyncio.run(run_async()))
+    return sync_p50, sync_p99, async_samples[len(async_samples) // 2]
 
 
 def _tpu_connector_gbps(its, np, conn):
     """BASELINE config 4: paged-KV block save/load via the connector on the
-    default jax backend (the real chip when the driver runs this)."""
+    default jax backend (the real chip when the driver runs this).
+
+    The ceilings are measured as a strict subset of the pipeline's own work:
+    the save ceiling runs the writer's exact device stage (Pallas gather +
+    async D2H, same d2h_window, same bytes) with the network omitted; the
+    load ceiling runs the reader's exact device stage (device_put + Pallas
+    scatter of every layer, overlap preserved) with the network omitted.
+    Since each pipeline run does its ceiling's work PLUS the store I/O,
+    achieved <= ceiling by construction, and achieved/ceiling is the honest
+    figure of merit (how much the store adds on top of the unavoidable
+    device<->host hop).
+    """
     import asyncio
 
     import jax
     import jax.numpy as jnp
 
     from infinistore_tpu.connector import KVConnector
-    from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
+    from infinistore_tpu.tpu.staging import StagedTransfer
 
     # 64KB blocks: 64 tokens x 8 kv-heads x 64 dim x bf16.
     spec = PagedKVCacheSpec(
@@ -184,23 +219,76 @@ def _tpu_connector_gbps(its, np, conn):
     jax.block_until_ready(caches)
     tokens = list(range(n_blocks * spec.block_tokens))
     ids = np.arange(n_blocks, dtype=np.int32)
+    ids_dev = jnp.asarray(ids)
     nbytes = 2 * spec.num_layers * n_blocks * spec.block_nbytes
+    d2h_window = kvc._writer.d2h_window
 
-    # Raw device-transfer ceilings with the same layer-window overlap the
-    # pipeline uses: the connector can't beat these; closeness to them is
-    # the real figure of merit (on tunneled dev TPUs they are low; on local
-    # chips they are PCIe/DMA-class).
-    chunks = [caches[l][0][:n_blocks] + 0 for l in range(4)]
-    jax.block_until_ready(chunks)
-    t0 = time.perf_counter()
-    for c in chunks:
-        c.copy_to_host_async()
-    hosts = [np.asarray(c) for c in chunks]
-    d2h_gbps = sum(h.nbytes for h in hosts) / (time.perf_counter() - t0) / (1 << 30)
-    t0 = time.perf_counter()
-    devs = [jax.device_put(h) for h in hosts]
-    jax.block_until_ready(devs)
-    h2d_gbps = sum(h.nbytes for h in hosts) / (time.perf_counter() - t0) / (1 << 30)
+    def d2h_stage_once() -> float:
+        """The writer's device stage, verbatim (layerwise.py write): gather +
+        async D2H for every layer with d2h_window transfers in flight."""
+        from collections import deque
+
+        staged: deque = deque()
+        todo = iter(range(spec.num_layers))
+        t0 = time.perf_counter()
+        while True:
+            while len(staged) < d2h_window:
+                layer = next(todo, None)
+                if layer is None:
+                    break
+                k_cache, v_cache = caches[layer]
+                staged.append(StagedTransfer([
+                    gather_blocks(k_cache, ids_dev),
+                    gather_blocks(v_cache, ids_dev),
+                ]))
+            if not staged:
+                break
+            staged.popleft().wait()
+        return time.perf_counter() - t0
+
+    def h2d_stage_once(hosts) -> float:
+        """The reader's device stage, verbatim (layerwise.py read):
+        device_put each layer's K/V host blocks + scatter into the paged
+        cache, blocking only at the end (uploads overlap). Scatter donates
+        its cache argument, so fresh targets are allocated untimed — exactly
+        as the load benchmark scatters into fresh zero caches."""
+        targets = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
+        jax.block_until_ready(targets)
+        out = []
+        t0 = time.perf_counter()
+        for l in range(spec.num_layers):
+            k_host, v_host = hosts[l]
+            k_blocks = jax.device_put(k_host)
+            v_blocks = jax.device_put(v_host)
+            k_cache, v_cache = targets[l]
+            out.append((
+                scatter_blocks(k_cache, ids_dev, k_blocks),
+                scatter_blocks(v_cache, ids_dev, v_blocks),
+            ))
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # Warmup compiles gather/scatter; host arrays for the H2D stage come from
+    # one untimed D2H pass (matching the byte layout the reader uploads).
+    d2h_stage_once()
+    shape = (n_blocks, *spec.block_shape)
+    hosts = [
+        (
+            np.asarray(gather_blocks(caches[l][0], ids_dev)).reshape(shape),
+            np.asarray(gather_blocks(caches[l][1], ids_dev)).reshape(shape),
+        )
+        for l in range(spec.num_layers)
+    ]
+    h2d_stage_once(hosts)
+
+    def best_of(fn, reps=5):
+        best = float("inf")
+        for _ in range(reps):
+            best = min(best, fn())
+        return best
+
+    d2h_dt = best_of(d2h_stage_once)
+    h2d_dt = best_of(lambda: h2d_stage_once(hosts))
 
     asyncio.run(kvc.save(tokens, caches, ids))  # warmup (jit compile)
     best_save = float("inf")
@@ -224,12 +312,30 @@ def _tpu_connector_gbps(its, np, conn):
     k_got = np.asarray(out[3][0][ids[5]], np.float32)
     assert np.array_equal(k_ref, k_got), "TPU roundtrip verification failed"
 
-    return (
-        nbytes / best_save / (1 << 30),
-        nbytes / best_load / (1 << 30),
-        d2h_gbps,
-        h2d_gbps,
-    )
+    # Noise guard: the ceiling does a strict subset of the pipeline's work,
+    # so achieved > ceiling can only be timing noise — take more ceiling
+    # samples until the invariant holds (min-time estimator converges).
+    for _ in range(3):
+        if nbytes / best_save / (1 << 30) <= nbytes / d2h_dt / (1 << 30):
+            break
+        d2h_dt = min(d2h_dt, best_of(d2h_stage_once))
+    for _ in range(3):
+        if nbytes / best_load / (1 << 30) <= nbytes / h2d_dt / (1 << 30):
+            break
+        h2d_dt = min(h2d_dt, best_of(lambda: h2d_stage_once(hosts)))
+
+    per_layer_d2h_ms = d2h_dt / spec.num_layers * 1e3
+    per_layer_h2d_ms = h2d_dt / spec.num_layers * 1e3
+    return {
+        "save_gbps": nbytes / best_save / (1 << 30),
+        "load_gbps": nbytes / best_load / (1 << 30),
+        "d2h_ceiling_gbps": nbytes / d2h_dt / (1 << 30),
+        "h2d_ceiling_gbps": nbytes / h2d_dt / (1 << 30),
+        "d2h_per_layer_ms": per_layer_d2h_ms,
+        "h2d_per_layer_ms": per_layer_h2d_ms,
+        "save_vs_ceiling": (nbytes / best_save) / (nbytes / d2h_dt),
+        "load_vs_ceiling": (nbytes / best_load) / (nbytes / h2d_dt),
+    }
 
 
 def main() -> int:
@@ -247,23 +353,49 @@ def main() -> int:
 
     ceiling = _memcpy_ceiling_gbps(np)
     gbps = _loopback_throughput(its, np, conn)
-    p50_4k, p99_4k = _fetch_latency_us(np, conn, 4 << 10)
-    p50_64k, p99_64k = _fetch_latency_us(np, conn, 64 << 10)
+    p50_4k, p99_4k, async_p50_4k = _fetch_latency_us(np, conn, 4 << 10)
+    p50_64k, p99_64k, async_p50_64k = _fetch_latency_us(np, conn, 64 << 10)
     striped_1 = _striped_scaling_gbps(its, np, srv.port, 1)
     striped_4 = _striped_scaling_gbps(its, np, srv.port, 4)
     try:
-        tpu_save, tpu_load, d2h, h2d = _tpu_connector_gbps(its, np, conn)
+        tpu = _tpu_connector_gbps(its, np, conn)
         import jax
 
         backend = jax.devices()[0].platform
     except (ImportError, RuntimeError) as e:
         # Absent/broken backend only — data-verification AssertionErrors
         # must fail the bench, not masquerade as a missing chip.
-        tpu_save = tpu_load = d2h = h2d = None
+        tpu = None
         backend = f"unavailable ({type(e).__name__})"
 
     conn.close()
     srv.stop()
+
+    extra = {
+        "memcpy_ceiling_gbps": round(ceiling, 3),
+        "p50_fetch_4k_us": round(p50_4k, 1),
+        "p99_fetch_4k_us": round(p99_4k, 1),
+        "p50_fetch_64k_us": round(p50_64k, 1),
+        "p99_fetch_64k_us": round(p99_64k, 1),
+        "async_p50_fetch_4k_us": round(async_p50_4k, 1),
+        "async_p50_fetch_64k_us": round(async_p50_64k, 1),
+        "striped_1_gbps": round(striped_1, 3),
+        "striped_4_gbps": round(striped_4, 3),
+        "tpu_backend": backend,
+    }
+    if tpu is not None:
+        extra.update(
+            {
+                "tpu_paged_kv_save_gbps": round(tpu["save_gbps"], 4),
+                "tpu_paged_kv_load_gbps": round(tpu["load_gbps"], 4),
+                "tpu_d2h_ceiling_gbps": round(tpu["d2h_ceiling_gbps"], 4),
+                "tpu_h2d_ceiling_gbps": round(tpu["h2d_ceiling_gbps"], 4),
+                "tpu_d2h_per_layer_ms": round(tpu["d2h_per_layer_ms"], 2),
+                "tpu_h2d_per_layer_ms": round(tpu["h2d_per_layer_ms"], 2),
+                "tpu_save_vs_ceiling": round(tpu["save_vs_ceiling"], 3),
+                "tpu_load_vs_ceiling": round(tpu["load_vs_ceiling"], 3),
+            }
+        )
 
     print(
         json.dumps(
@@ -272,20 +404,7 @@ def main() -> int:
                 "value": round(gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / ceiling, 3),
-                "extra": {
-                    "memcpy_ceiling_gbps": round(ceiling, 3),
-                    "p50_fetch_4k_us": round(p50_4k, 1),
-                    "p99_fetch_4k_us": round(p99_4k, 1),
-                    "p50_fetch_64k_us": round(p50_64k, 1),
-                    "p99_fetch_64k_us": round(p99_64k, 1),
-                    "striped_1_gbps": round(striped_1, 3),
-                    "striped_4_gbps": round(striped_4, 3),
-                    "tpu_paged_kv_save_gbps": None if tpu_save is None else round(tpu_save, 3),
-                    "tpu_paged_kv_load_gbps": None if tpu_load is None else round(tpu_load, 3),
-                    "tpu_d2h_ceiling_gbps": None if d2h is None else round(d2h, 3),
-                    "tpu_h2d_ceiling_gbps": None if h2d is None else round(h2d, 3),
-                    "tpu_backend": backend,
-                },
+                "extra": extra,
             }
         )
     )

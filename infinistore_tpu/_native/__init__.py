@@ -114,6 +114,13 @@ lib.its_conn_put_batch.argtypes = _batch_args
 lib.its_conn_put_batch.restype = c_int
 lib.its_conn_get_batch.argtypes = _batch_args
 lib.its_conn_get_batch.restype = c_int
+_batch_sync_args = [
+    c_void_p, c_char_p, c_uint64, c_uint32, POINTER(c_uint64), c_uint32, c_void_p,
+]
+lib.its_conn_put_batch_sync.argtypes = _batch_sync_args
+lib.its_conn_put_batch_sync.restype = c_int
+lib.its_conn_get_batch_sync.argtypes = _batch_sync_args
+lib.its_conn_get_batch_sync.restype = c_int
 lib.its_conn_tcp_put.argtypes = [c_void_p, c_char_p, c_void_p, c_uint64]
 lib.its_conn_tcp_put.restype = c_int
 lib.its_conn_tcp_get.argtypes = [c_void_p, c_char_p, POINTER(POINTER(c_uint8)), POINTER(c_uint64)]

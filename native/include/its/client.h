@@ -90,6 +90,20 @@ class Connection {
                         const std::vector<uint64_t>& offsets, uint32_t block_size,
                         void* base_ptr, CompletionCb cb, void* ctx);
 
+    // Sync batched ops: same pipeline, but the calling thread blocks on the
+    // completion (promise wait — no event-loop hop). This is the low-latency
+    // path for single-block fetches: the asyncio bridge costs ~2 extra
+    // context switches per op on a single-core host, which dominates a
+    // same-host block fetch (measured: ~58us async vs ~20us sync p50 at
+    // 4KB). Returns 0 on success, -status on failure. On op_timeout_ms
+    // expiry returns -kStatusUnavailable and abandons the wait; the op may
+    // still complete server-side, and the base region must stay registered
+    // and alive until close() (true for staging pools by construction).
+    int put_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
+                  uint32_t block_size, void* base_ptr);
+    int get_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
+                  uint32_t block_size, void* base_ptr);
+
     // Sync ops (safe to call from any thread; they ride the same pipeline).
     int tcp_put(const std::string& key, const void* data, size_t size);
     // On success fills *out (malloc'd — caller frees with free()) and *out_size.
@@ -127,6 +141,13 @@ class Connection {
                             uint8_t** payload_out, size_t* payload_size_out,
                             int timeout_ms = -1);
     bool base_registered(const void* base, size_t span) const;
+    // Shared request construction for the batched data plane (async + sync).
+    std::unique_ptr<Request> build_put(const std::vector<std::string>& keys,
+                                       const std::vector<uint64_t>& offsets,
+                                       uint32_t block_size, void* base_ptr);
+    std::unique_ptr<Request> build_get(const std::vector<std::string>& keys,
+                                       const std::vector<uint64_t>& offsets,
+                                       uint32_t block_size, void* base_ptr);
     void shm_handshake();
     char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);
     // Reactor-side: handle a PutAlloc/GetLoc response. Returns the request

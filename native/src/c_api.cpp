@@ -155,6 +155,26 @@ int its_conn_get_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uin
                                                             cb, ctx);
     }, -1);
 }
+// Sync batched ops: calling thread blocks on completion (no asyncio hop) —
+// the low-latency path for small fetches. Returns 0 or -status.
+int its_conn_put_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
+                            uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
+                            void* base_ptr) {
+    return guarded([&]() -> int {
+        auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
+        std::vector<uint64_t> offs(offsets, offsets + nkeys);
+        return static_cast<Connection*>(c)->put_batch(keys, offs, block_size, base_ptr);
+    }, -static_cast<int>(its::kStatusInvalidReq));
+}
+int its_conn_get_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
+                            uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
+                            void* base_ptr) {
+    return guarded([&]() -> int {
+        auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
+        std::vector<uint64_t> offs(offsets, offsets + nkeys);
+        return static_cast<Connection*>(c)->get_batch(keys, offs, block_size, base_ptr);
+    }, -static_cast<int>(its::kStatusInvalidReq));
+}
 int its_conn_tcp_put(void* c, const char* key, const void* data, uint64_t size) {
     return guarded(
         [&]() -> int { return static_cast<Connection*>(c)->tcp_put(key, data, size); },

@@ -372,15 +372,15 @@ int Connection::submit(std::unique_ptr<Request> req) {
     return 0;
 }
 
-int Connection::put_batch_async(const std::vector<std::string>& keys,
-                                const std::vector<uint64_t>& offsets, uint32_t block_size,
-                                void* base_ptr, CompletionCb cb, void* ctx) {
-    if (keys.empty() || keys.size() != offsets.size()) return -1;
+std::unique_ptr<Connection::Request> Connection::build_put(
+    const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
+    uint32_t block_size, void* base_ptr) {
+    if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
     if (!base_registered(base_ptr, span)) {
         ITS_LOG_ERROR("put_batch: base pointer not inside a registered region");
-        return -1;
+        return nullptr;
     }
     auto req = std::make_unique<Request>();
     if (const ClientSeg* seg = find_seg(base_ptr, span)) {
@@ -406,20 +406,37 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
         for (uint64_t off : offsets)
             req->tx_payload.push_back(iovec{static_cast<char*>(base_ptr) + off, block_size});
     }
+    return req;
+}
+
+int Connection::put_batch_async(const std::vector<std::string>& keys,
+                                const std::vector<uint64_t>& offsets, uint32_t block_size,
+                                void* base_ptr, CompletionCb cb, void* ctx) {
+    auto req = build_put(keys, offsets, block_size, base_ptr);
+    if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
     return submit(std::move(req));
 }
 
-int Connection::get_batch_async(const std::vector<std::string>& keys,
-                                const std::vector<uint64_t>& offsets, uint32_t block_size,
-                                void* base_ptr, CompletionCb cb, void* ctx) {
-    if (keys.empty() || keys.size() != offsets.size()) return -1;
+int Connection::put_batch(const std::vector<std::string>& keys,
+                          const std::vector<uint64_t>& offsets, uint32_t block_size,
+                          void* base_ptr) {
+    auto req = build_put(keys, offsets, block_size, base_ptr);
+    if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
+    uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
+    return status == kStatusOk ? 0 : -static_cast<int>(status);
+}
+
+std::unique_ptr<Connection::Request> Connection::build_get(
+    const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
+    uint32_t block_size, void* base_ptr) {
+    if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
     if (!base_registered(base_ptr, span)) {
         ITS_LOG_ERROR("get_batch: base pointer not inside a registered region");
-        return -1;
+        return nullptr;
     }
     auto req = std::make_unique<Request>();
     if (const ClientSeg* seg = find_seg(base_ptr, span)) {
@@ -442,9 +459,26 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
         for (uint64_t off : offsets)
             req->rx_addrs.push_back(static_cast<char*>(base_ptr) + off);
     }
+    return req;
+}
+
+int Connection::get_batch_async(const std::vector<std::string>& keys,
+                                const std::vector<uint64_t>& offsets, uint32_t block_size,
+                                void* base_ptr, CompletionCb cb, void* ctx) {
+    auto req = build_get(keys, offsets, block_size, base_ptr);
+    if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
     return submit(std::move(req));
+}
+
+int Connection::get_batch(const std::vector<std::string>& keys,
+                          const std::vector<uint64_t>& offsets, uint32_t block_size,
+                          void* base_ptr) {
+    auto req = build_get(keys, offsets, block_size, base_ptr);
+    if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
+    uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
+    return status == kStatusOk ? 0 : -static_cast<int>(status);
 }
 
 uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,

@@ -87,6 +87,34 @@ def test_batch_read_missing_raises_typed(conn):
         asyncio.run(run())
 
 
+def test_sync_batch_roundtrip(conn):
+    """Blocking batched ops (the low-latency path: calling thread waits on
+    the native completion, no event-loop hop). Runs on both data planes via
+    the conn fixture."""
+    n, block = 8, 4096
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    conn.register_mr(src)
+    conn.register_mr(dst)
+    blocks = [(f"sync-{i}", i * block) for i in range(n)]
+    conn.write_cache(blocks, block, src.ctypes.data)
+    conn.read_cache(blocks, block, dst.ctypes.data)
+    assert np.array_equal(src, dst)
+
+
+def test_sync_batch_missing_raises_typed(conn):
+    buf = _staging(4096)
+    conn.register_mr(buf)
+    with pytest.raises(its.InfiniStoreKeyNotFound):
+        conn.read_cache([("sync-missing", 0)], 4096, buf.ctypes.data)
+
+
+def test_sync_batch_requires_registered_mr(conn):
+    buf = _staging(4096)
+    with pytest.raises(its.InfiniStoreException):
+        conn.write_cache([("sync-unreg", 0)], 4096, buf.ctypes.data)
+
+
 def test_many_inflight_gather(conn):
     """1000-key asyncio.gather batch (reference example/client_async.py)."""
     n = 1000

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Experiment matrix for the loopback throughput inversion (VERDICT r2 weak #3).
+
+Varies: data plane (shm segment vs plain registered memory), key count,
+and src/dst buffer reuse. Prints GB/s for each cell.
+"""
+import asyncio
+import time
+
+import numpy as np
+
+import infinistore_tpu as its
+
+
+def run_cell(its, srv_port, *, path: str, n_keys: int, same_buf: bool, iters=5):
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv_port, log_level="error")
+    )
+    conn.connect()
+    block = 64 << 10
+    nbytes = n_keys * block
+    if path == "shm":
+        src = conn.alloc_shm_mr(nbytes)
+        dst = src if same_buf else conn.alloc_shm_mr(nbytes)
+    else:
+        src = np.empty(nbytes, dtype=np.uint8)
+        conn.register_mr(src)
+        if same_buf:
+            dst = src
+        else:
+            dst = np.empty(nbytes, dtype=np.uint8)
+            conn.register_mr(dst)
+    src[:] = np.random.randint(0, 256, size=nbytes, dtype=np.uint8)
+    pairs = [(f"{path}-{n_keys}-{same_buf}-{i}", i * block) for i in range(n_keys)]
+
+    async def once():
+        await conn.write_cache_async(pairs, block, src.ctypes.data)
+        await conn.read_cache_async(pairs, block, dst.ctypes.data)
+
+    asyncio.run(once())
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            asyncio.run(once())
+        best = min(best, time.perf_counter() - t0)
+    conn.close()
+    return 2 * nbytes * iters / best / (1 << 30)
+
+
+def main():
+    srv = its.start_local_server(
+        prealloc_bytes=1 << 30, block_bytes=64 << 10, pin_memory=True
+    )
+    print(f"{'path':>8} {'keys':>6} {'same_buf':>9} {'GB/s':>8}")
+    for path in ("shm", "mr"):
+        for n_keys in (256, 512, 1000):
+            for same_buf in (True, False):
+                g = run_cell(its, srv.port, path=path, n_keys=n_keys, same_buf=same_buf)
+                print(f"{path:>8} {n_keys:>6} {str(same_buf):>9} {g:8.3f}", flush=True)
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()
