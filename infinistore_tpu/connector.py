@@ -193,11 +193,13 @@ class KVConnector:
         pods the interconnect is the fast path).
 
         Same-mesh (``ici`` bound and ``src``/``dst`` shard indices given):
-        per-layer gather + ppermute + scatter in one jitted SPMD program per
-        layer — HBM->HBM over ICI, no host, no store. ``caches`` must be
-        per-layer (K, V) arrays of shape [axis_size, num_blocks, *block]
-        sharded over the transfer axis; inputs are donated (use the returned
-        caches).
+        gather + ppermute + scatter for ALL layers fused into ONE jitted
+        SPMD program with a single collective (IciBlockTransfer.
+        handoff_layers) — HBM->HBM over ICI, no host, no store, one launch.
+        ``caches`` must be per-layer (K, V) arrays of shape
+        [axis_size, num_blocks, *block] sharded over the transfer axis, with
+        a uniform shape/dtype across layers (ragged layers raise ValueError);
+        inputs are donated (use the returned caches).
 
         Otherwise: degrades to the DCN store — save the blocks under the
         request's chain keys, then load them into ``dst_block_ids`` (the
@@ -217,11 +219,12 @@ class KVConnector:
         if n == 0:
             return list(caches), 0
         if self.ici is not None and src is not None and dst is not None:
-            out = []
-            for k_cache, v_cache in caches:
-                out.append(self.ici.handoff_kv(
-                    k_cache, v_cache, src_block_ids[:n], dst_block_ids[:n], src, dst
-                ))
+            # All layers in ONE SPMD launch (single collective over the
+            # stacked blocks) — a per-layer loop here would pay L sequential
+            # dispatch round-trips on the latency-critical path.
+            out = self.ici.handoff_layers(
+                list(caches), src_block_ids[:n], dst_block_ids[:n], src, dst
+            )
             return out, n
         if self.ici is not None and self.conn is None:
             raise ValueError(

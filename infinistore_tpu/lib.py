@@ -352,14 +352,24 @@ class InfinityConnection:
         native completion directly (no event-loop hop). ~3x lower p50 than
         the async path for single-block ops on a same-host store — use it on
         latency-critical paths; the async API remains the throughput path
-        (pipelining many ops). The ctypes call releases the GIL."""
+        (pipelining many ops). The ctypes call releases the GIL.
+
+        Timeout (``op_timeout_ms``, default 30s): raises status 503 and
+        abandons the wait. The native layer guarantees the abandoned op never
+        touches the buffer again — an unsent request is dropped, a late
+        response is drained into scratch (never scattered into ``ptr``), and
+        a request half-streamed from the buffer fails the connection rather
+        than read it — so the buffer may be freed after the exception
+        (unregister_mr first if it was explicitly registered)."""
         return self._batch_op_sync(
             lib.its_conn_put_batch_sync, blocks, block_size, ptr, "write_cache"
         )
 
     def read_cache(self, blocks: List[Tuple[str, int]], block_size: int, ptr: int):
-        """Blocking batched block read (see write_cache). Raises
-        InfiniStoreKeyNotFound when any key is missing."""
+        """Blocking batched block read (see write_cache for latency/timeout
+        semantics — on timeout the late payload is drained, never written
+        into ``ptr``). Raises InfiniStoreKeyNotFound when any key is
+        missing."""
         return self._batch_op_sync(
             lib.its_conn_get_batch_sync, blocks, block_size, ptr, "read_cache"
         )

@@ -59,9 +59,27 @@ class IciBlockTransfer:
     def __init__(self, mesh: Mesh, axis_name: str, perm: Sequence[Tuple[int, int]]):
         self.mesh = mesh
         self.axis_name = axis_name
+        self.axis_size = mesh.shape[axis_name]
         self.perm = tuple((int(s), int(d)) for s, d in perm)
+        for s, d in self.perm:
+            self._check_index(s, "perm src")
+            self._check_index(d, "perm dst")
         self.sharding = NamedSharding(mesh, P(axis_name))
         self._jit_cache = {}
+        # Dispatches of a compiled transfer program (one per host->device
+        # launch). The whole point of the fused paths is to keep this at 1
+        # per logical handoff; tests pin it.
+        self.launches = 0
+
+    def _check_index(self, i: int, what: str):
+        """Out-of-range shard indices otherwise surface as an IndexError
+        deep inside jit tracing (found when a 1-device axon mesh met a
+        perm built for 8) — validate at the API boundary instead."""
+        if not 0 <= int(i) < self.axis_size:
+            raise ValueError(
+                f"{what} index {i} out of range for mesh axis "
+                f"'{self.axis_name}' of size {self.axis_size}"
+            )
 
     def _cached(self, key, build):
         fn = self._jit_cache.get(key)
@@ -84,6 +102,7 @@ class IciBlockTransfer:
         shardable) over axis 0. Returns the same shape with row dst holding
         what row src sent."""
         blocks = self._ensure_sharded(blocks_by_device)
+        self.launches += 1
         return _permute_sharded(
             blocks, mesh=self.mesh, axis_name=self.axis_name, perm=self.perm
         )
@@ -95,6 +114,8 @@ class IciBlockTransfer:
         ([axis_size, num_blocks, ...], sharded over axis 0) on shard `src` and
         deliver them to shard `dst`. Returns [n, *block_shape] living on the
         dst device's shard row."""
+        self._check_index(src, "src")
+        self._check_index(dst, "dst")
         ids = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
         mesh, axis = self.mesh, self.axis_name
 
@@ -112,6 +133,7 @@ class IciBlockTransfer:
             )
 
         fn = self._cached(("send", int(src), int(dst)), build)
+        self.launches += 1
         return fn(self._ensure_sharded(cache), ids)
 
     def handoff_blocks(
@@ -122,6 +144,8 @@ class IciBlockTransfer:
         into shard `dst`'s pages. `cache`: [axis_size, num_blocks, *block],
         sharded over axis 0; it is donated — on TPU the update is in-place
         and only the moved blocks' bytes cross the interconnect."""
+        self._check_index(src, "src")
+        self._check_index(dst, "dst")
         s_ids = jax.numpy.asarray(src_ids, dtype=jax.numpy.int32)
         d_ids = jax.numpy.asarray(dst_ids, dtype=jax.numpy.int32)
         mesh, axis = self.mesh, self.axis_name
@@ -144,6 +168,7 @@ class IciBlockTransfer:
             )
 
         fn = self._cached(("handoff", int(src), int(dst)), build)
+        self.launches += 1
         return fn(self._ensure_sharded(cache), s_ids, d_ids)
 
     def handoff_kv(
@@ -153,6 +178,8 @@ class IciBlockTransfer:
         """One layer's K and V handoff fused into a single SPMD program —
         one collective launch per layer instead of two on the
         latency-critical prefill->decode path. Both caches are donated."""
+        self._check_index(src, "src")
+        self._check_index(dst, "dst")
         s_ids = jax.numpy.asarray(src_ids, dtype=jax.numpy.int32)
         d_ids = jax.numpy.asarray(dst_ids, dtype=jax.numpy.int32)
         mesh, axis = self.mesh, self.axis_name
@@ -180,9 +207,76 @@ class IciBlockTransfer:
             )
 
         fn = self._cached(("handoff_kv", int(src), int(dst)), build)
+        self.launches += 1
         return fn(
             self._ensure_sharded(k_cache), self._ensure_sharded(v_cache), s_ids, d_ids
         )
+
+    def handoff_layers(
+        self, caches, src_ids, dst_ids, src: int, dst: int
+    ) -> List[Tuple[jax.Array, jax.Array]]:
+        """ALL layers' K+V handoff in one SPMD program with ONE collective.
+
+        ``caches`` is the engine's full paged cache: a list of per-layer
+        (K, V) arrays, each [axis_size, num_blocks, *block] sharded over the
+        transfer axis. The per-layer path (`handoff_kv` in a Python loop)
+        costs L sequential dispatch round-trips on the latency-critical
+        prefill->decode handoff — the exact per-layer latency the reference's
+        streaming design exists to hide (reference docs/source/design.rst:54-63).
+        Here the gathered blocks of all 2L caches are stacked into a single
+        [2L, n, *block] tensor, moved with one ppermute, and scattered back —
+        one launch, one ICI transfer, still only the moved blocks' bytes on
+        the wire. All caches are donated (updates are in-place in HBM).
+
+        Requires uniform per-layer cache shape/dtype (true for every model
+        family here; stacking is what buys the single collective).
+        """
+        L = len(caches)
+        if L == 0:
+            return []
+        flat = [c for kv in caches for c in kv]
+        shape, dtype = flat[0].shape, flat[0].dtype
+        for c in flat:
+            if c.shape != shape or c.dtype != dtype:
+                raise ValueError(
+                    "handoff_layers needs uniform per-layer cache shape/dtype; "
+                    f"got {c.shape}/{c.dtype} vs {shape}/{dtype}"
+                )
+        self._check_index(src, "src")
+        self._check_index(dst, "dst")
+        s_ids = jax.numpy.asarray(src_ids, dtype=jax.numpy.int32)
+        d_ids = jax.numpy.asarray(dst_ids, dtype=jax.numpy.int32)
+        mesh, axis = self.mesh, self.axis_name
+
+        def build():
+            perm = ((int(src), int(dst)),)
+
+            def step(sids, dids, *locals_):
+                # One gather per cache, ONE ppermute for the stack of all of
+                # them, then per-cache scatter. locals_[i]: [1, num_blocks, *block].
+                gathered = jax.numpy.stack(
+                    [jax.numpy.take(c[0], sids, axis=0) for c in locals_]
+                )  # [2L, n, *block]
+                moved = jax.lax.ppermute(gathered[None], axis, perm)[0]
+                is_dst = jax.lax.axis_index(axis) == dst
+                outs = []
+                for i, c in enumerate(locals_):
+                    updated = c[0].at[dids].set(moved[i])
+                    outs.append(jax.numpy.where(is_dst, updated, c[0])[None])
+                return tuple(outs)
+
+            in_specs = (P(), P()) + tuple(P(axis) for _ in range(2 * L))
+            out_specs = tuple(P(axis) for _ in range(2 * L))
+            return jax.jit(
+                shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs),
+                donate_argnums=tuple(range(2, 2 + 2 * L)),
+            )
+
+        fn = self._cached(("handoff_layers", L, int(src), int(dst)), build)
+        sharded = [self._ensure_sharded(c) for c in flat]
+        self.launches += 1
+        outs = fn(s_ids, d_ids, *sharded)
+        return [(outs[2 * i], outs[2 * i + 1]) for i in range(L)]
 
 
 def mesh_from_devices(devices: List = None, axis_name: str = "store") -> Mesh:

@@ -133,7 +133,10 @@ class Connection {
     void fail_all(int code);
     bool flush_send();
     bool read_ready();
-    void complete(std::unique_ptr<Request> req, int code);
+    // take_body: move rbody_ into the sync state — ONLY when this request's
+    // response was actually received (fail_all / abandoned-drop completions
+    // must not steal a different in-flight response's partially read body).
+    void complete(std::unique_ptr<Request> req, int code, bool take_body);
     // timeout_ms < 0 = use config_.op_timeout_ms (which <= 0 waits forever);
     // on timeout returns kStatusUnavailable and abandons the wait (a late
     // response completes into shared state, FIFO matching stays intact).
@@ -165,6 +168,14 @@ class Connection {
 
     std::mutex submit_mu_;
     std::vector<std::unique_ptr<Request>> submitted_;
+
+    // Seqlock-style counter bracketing every reactor region that touches
+    // caller memory (writev from tx_payload, readv into rx_addrs, shm
+    // memcpys): odd = inside a region. A timed-out sync waiter sets
+    // SyncState::abandoned and then waits for this to be even, so after
+    // sync_roundtrip returns the reactor can never again touch the caller's
+    // buffers (regions check the flag AFTER going odd — Dekker pairing).
+    std::atomic<uint64_t> io_seq_{0};
 
     // Reactor-owned state.
     std::deque<std::unique_ptr<Request>> sendq_;

@@ -31,6 +31,13 @@ struct ScatterCursor {
         return n;
     }
 
+    // Bytes not yet received across the remaining regions.
+    uint64_t remaining(const std::vector<iovec>& v) const {
+        uint64_t n = 0;
+        for (size_t i = idx; i < v.size(); i++) n += v[i].iov_len;
+        return n - off;
+    }
+
     // Consume nbytes of progress.
     void advance(const std::vector<iovec>& v, size_t nbytes) {
         while (nbytes > 0) {

@@ -35,11 +35,29 @@ struct Connection::SyncState {
     std::vector<uint8_t> body;
     uint8_t* payload = nullptr;  // malloc'd; freed here unless the waiter takes it
     size_t payload_size = 0;
+    // Set by a timed-out waiter. From that moment the caller may free the
+    // buffers the request's iovecs point at, so the reactor must never touch
+    // them again: unsent requests are dropped, late get payloads are drained
+    // into scratch, and a request half-streamed from caller memory fails the
+    // connection (it has been wedged for op_timeout_ms anyway).
+    std::atomic<bool> abandoned{false};
 
     ~SyncState() {
         if (payload != nullptr) free(payload);
     }
 };
+
+// RAII bracket for reactor regions that touch caller memory: io_seq_ odd
+// while inside. Paired with SyncState::abandoned (see client.h io_seq_).
+namespace {
+struct IoSection {
+    std::atomic<uint64_t>& seq;
+    explicit IoSection(std::atomic<uint64_t>& s) : seq(s) { seq.fetch_add(1); }
+    ~IoSection() { seq.fetch_add(1); }
+    IoSection(const IoSection&) = delete;
+    IoSection& operator=(const IoSection&) = delete;
+};
+}  // namespace
 
 struct Connection::Request {
     uint8_t op = 0;
@@ -499,6 +517,21 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
             std::future_status::ready) {
             // Abandon: the Request keeps the shared state alive, so a late
             // response completes harmlessly and FIFO matching stays intact.
+            // The flag tells the reactor the caller's buffers are off-limits
+            // from here on (see SyncState::abandoned) — but the reactor may
+            // be INSIDE a buffer-touching region right now, so wait for
+            // io_seq_ to go even before returning. Regions check the flag
+            // after going odd, so once we observe even here no later region
+            // can touch the buffers (Dekker pairing; regions are one
+            // nonblocking syscall or a bounded memcpy loop, so this wait is
+            // microseconds).
+            state->abandoned.store(true);
+            uint64_t s = io_seq_.load();
+            if (s & 1) {
+                // Wait for THIS section to exit (any change: a later section
+                // entered after our store and so already sees the flag).
+                while (io_seq_.load() == s) std::this_thread::yield();
+            }
             return kStatusUnavailable;
         }
     } else {
@@ -585,10 +618,14 @@ std::string Connection::stat_json() {
     return std::string(body.begin(), body.end());
 }
 
-void Connection::complete(std::unique_ptr<Request> req, int code) {
+void Connection::complete(std::unique_ptr<Request> req, int code, bool take_body) {
     if (req->sync != nullptr) {
         req->sync->status = static_cast<uint32_t>(code);
-        req->sync->body = std::move(rbody_);
+        // Only a request whose response was actually received may take
+        // rbody_ — completions from fail_all or an abandoned-drop would
+        // otherwise move out a DIFFERENT response's partially read body and
+        // desync the stream.
+        if (take_body) req->sync->body = std::move(rbody_);
         req->sync->payload = req->rx_buf;
         req->sync->payload_size = req->rx_buf_size;
         req->rx_buf = nullptr;
@@ -609,12 +646,12 @@ void Connection::fail_all(int code) {
     while (!awaiting_.empty()) {
         auto req = std::move(awaiting_.front());
         awaiting_.pop_front();
-        complete(std::move(req), code);
+        complete(std::move(req), code, /*take_body=*/false);
     }
     while (!sendq_.empty()) {
         auto req = std::move(sendq_.front());
         sendq_.pop_front();
-        complete(std::move(req), code);
+        complete(std::move(req), code, /*take_body=*/false);
     }
 }
 
@@ -622,6 +659,28 @@ bool Connection::flush_send() {
     static const std::vector<iovec> kNoPayload;
     while (!sendq_.empty()) {
         Request* req = sendq_.front().get();
+        // Section covers the abandoned check AND the writev reading from
+        // tx_payload: a timed-out waiter blocks until we exit it.
+        IoSection sec(io_seq_);
+        if (req->sync != nullptr && req->sync->abandoned.load()) {
+            if (req->sent == 0) {
+                // Never reached the wire: drop it whole — the server never
+                // saw it, so FIFO response matching is unaffected.
+                auto dead = std::move(sendq_.front());
+                sendq_.pop_front();
+                complete(std::move(dead), static_cast<int>(kStatusUnavailable),
+                         /*take_body=*/false);
+                continue;
+            }
+            if (req->payload_on_wire && !req->tx_payload.empty() &&
+                req->owned_payload.empty() && req->sent < req->send_total) {
+                // Half-streamed from caller memory the caller may have freed
+                // after the timeout. Abandoning mid-frame would desync the
+                // protocol; the only safe move is to fail the connection.
+                ITS_LOG_ERROR("abandoned sync op mid-stream; failing connection");
+                return false;
+            }
+        }
         iovec iov[64];
         const std::vector<iovec>& wire_payload =
             req->payload_on_wire ? req->tx_payload : kNoPayload;
@@ -690,7 +749,10 @@ bool Connection::read_ready() {
             rx_discard_ = 0;
             rx_failed_ = false;
             if (rhdr_.payload_size > 0) {
-                if (req->op == kOpGetBatch && rhdr_.status == kStatusOk) {
+                if (req->sync != nullptr && req->sync->abandoned.load()) {
+                    // The waiter timed out; its buffers may be gone. Drain.
+                    rx_discard_ = rhdr_.payload_size;
+                } else if (req->op == kOpGetBatch && rhdr_.status == kStatusOk) {
                     WireReader rd(rbody_.data(), rbody_.size());
                     uint32_t n = rd.u32();
                     if (n != req->rx_addrs.size()) {
@@ -732,6 +794,17 @@ bool Connection::read_ready() {
             rx_discard_ -= static_cast<uint64_t>(r);
             if (rx_discard_ > 0) continue;
         } else if (!rx_cur_.done(rx_iov_)) {
+            // Section covers the abandoned check AND the readv scattering
+            // into rx_addrs: a timed-out waiter blocks until we exit it.
+            IoSection sec(io_seq_);
+            if (req->sync != nullptr && req->sync->abandoned.load()) {
+                // Timed out mid-scatter: stop touching the caller's buffers
+                // and drain the rest of the payload into scratch.
+                rx_discard_ = rx_cur_.remaining(rx_iov_);
+                rx_iov_.clear();
+                rx_cur_.reset();
+                continue;
+            }
             iovec iov[64];
             size_t niov = rx_cur_.fill(rx_iov_, iov, 64);
             ssize_t r = readv(fd_, iov, static_cast<int>(niov));
@@ -748,13 +821,15 @@ bool Connection::read_ready() {
         rhdr_got_ = 0;
         if (rx_failed_) {
             rx_failed_ = false;
-            complete(std::move(done), static_cast<int>(kStatusInternal));
+            complete(std::move(done), static_cast<int>(kStatusInternal),
+                     /*take_body=*/true);
         } else if (done->op == kOpPutAlloc || done->op == kOpGetLoc) {
             auto requeue = shm_phase(std::move(done), rhdr_.status);
             if (requeue != nullptr) sendq_.push_back(std::move(requeue));
             if (!sendq_.empty() && !flush_send()) return false;
         } else {
-            complete(std::move(done), static_cast<int>(rhdr_.status));
+            complete(std::move(done), static_cast<int>(rhdr_.status),
+                     /*take_body=*/true);
         }
     }
 }
@@ -781,7 +856,7 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
         return fall_back(std::move(req));
     }
     if (status != kStatusOk) {
-        complete(std::move(req), static_cast<int>(status));
+        complete(std::move(req), static_cast<int>(status), /*take_body=*/true);
         return nullptr;
     }
     ShmLocResp resp;
@@ -789,7 +864,8 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
         resp = ShmLocResp::decode(rbody_.data(), rbody_.size());
     } catch (const std::exception& e) {
         ITS_LOG_ERROR("shm response parse failed: %s", e.what());
-        complete(std::move(req), static_cast<int>(kStatusInternal));
+        complete(std::move(req), static_cast<int>(kStatusInternal),
+                 /*take_body=*/true);
         return nullptr;
     }
     size_t n = resp.locs.size();
@@ -825,7 +901,8 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
             ITS_LOG_ERROR("shm get: stored block (%u) exceeds requested block_size (%u)",
                           l.size, req->block_size);
             queue_release(resp.ticket);
-            complete(std::move(req), static_cast<int>(kStatusInternal));
+            complete(std::move(req), static_cast<int>(kStatusInternal),
+                 /*take_body=*/true);
             return nullptr;
         }
         // Bounds-check against the mapping: a malformed location must not
@@ -842,6 +919,17 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
         queue_release(resp.ticket);  // abort: drop the server-side ticket
         return fall_back(std::move(req));
     }
+    // Section covers the abandoned check AND the memcpys against caller
+    // memory: a timed-out waiter blocks until we exit it (bounded loop).
+    IoSection sec(io_seq_);
+    if (req->sync != nullptr && req->sync->abandoned.load()) {
+        // Timed-out waiter: tx_payload/rx_addrs point at memory the caller
+        // may have freed — abort the ticket instead of memcpy'ing.
+        queue_release(resp.ticket);
+        complete(std::move(req), static_cast<int>(kStatusUnavailable),
+                 /*take_body=*/true);
+        return nullptr;
+    }
     if (put) {
         for (size_t i = 0; i < n; i++)
             memcpy(at[i], req->tx_payload[i].iov_base, req->tx_payload[i].iov_len);
@@ -855,7 +943,7 @@ std::unique_ptr<Connection::Request> Connection::shm_phase(std::unique_ptr<Reque
     }
     for (size_t i = 0; i < n; i++) memcpy(req->rx_addrs[i], at[i], resp.locs[i].size);
     queue_release(resp.ticket);
-    complete(std::move(req), static_cast<int>(kStatusOk));
+    complete(std::move(req), static_cast<int>(kStatusOk), /*take_body=*/true);
     return nullptr;
 }
 

@@ -312,6 +312,77 @@ def test_sync_ops_time_out_on_hung_server():
         s.close()
 
 
+def test_abandoned_sync_read_never_touches_buffer():
+    """A sync get that times out must NEVER scatter a late server response
+    into the caller's buffer — the caller may free it after catching the
+    exception. The reactor drains the late payload into scratch instead
+    (SyncState::abandoned, client.cpp). Regression for the abandoned-op
+    use-after-free window."""
+    import socket as socklib
+    import struct
+    import threading
+    import time
+
+    from infinistore_tpu import wire
+
+    listener = socklib.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    served = threading.Event()
+
+    def serve_late():
+        s, _ = listener.accept()
+        s.settimeout(5)
+        hdr = b""
+        while len(hdr) < 9:
+            hdr += s.recv(9 - len(hdr))
+        _, op, body_size = struct.unpack("<IBI", hdr)
+        assert op == wire.OP_GET_BATCH
+        body = b""
+        while len(body) < body_size:
+            body += s.recv(body_size - len(body))
+        meta = wire.BatchMeta.decode(body)
+        time.sleep(0.8)  # well past the client's 300ms deadline
+        n = len(meta.keys)
+        sizes = struct.pack("<I", n) + struct.pack("<I", meta.block_size) * n
+        payload = b"\xab" * (meta.block_size * n)
+        s.sendall(
+            wire.pack_resp_header(wire.STATUS_OK, len(sizes), len(payload))
+            + sizes
+            + payload
+        )
+        served.set()
+        time.sleep(0.5)  # give the reactor time to drain before we close
+        s.close()
+
+    t = threading.Thread(target=serve_late, daemon=True)
+    t.start()
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1",
+            service_port=port,
+            log_level="error",
+            enable_shm=False,
+            op_timeout_ms=300,
+        )
+    )
+    c.connect()
+    block = 4096
+    buf = np.zeros(2 * block, dtype=np.uint8)
+    c.register_mr(buf)
+    t0 = time.time()
+    with pytest.raises(its.InfiniStoreException):
+        c.read_cache([("a", 0), ("b", block)], block, buf.ctypes.data)
+    assert time.time() - t0 < 3
+    buf[:] = 0x55  # the caller reuses (or could have freed) the buffer
+    assert served.wait(5), "fake server never sent the late response"
+    time.sleep(0.5)  # let the reactor consume the late payload
+    assert (buf == 0x55).all(), "late response was scattered into caller memory"
+    c.close()
+    listener.close()
+
+
 def test_striped_connection_roundtrip():
     """StripedConnection splits batched ops across N sockets while keeping
     the single-connection API: data correctness, control ops, shm segment on
