@@ -70,6 +70,7 @@ lib.its_log.argtypes = [c_int, c_char_p]
 # ---- server ----
 lib.its_server_create.argtypes = [
     c_char_p, c_int, c_uint64, c_uint64, c_int, c_uint64, c_int, c_double, c_double, c_int,
+    c_int,
 ]
 lib.its_server_create.restype = c_void_p
 lib.its_server_start.argtypes = [c_void_p]
@@ -90,7 +91,7 @@ lib.its_server_stats_json.argtypes = [c_void_p, c_char_p, c_int]
 lib.its_server_stats_json.restype = c_int
 
 # ---- client ----
-lib.its_conn_create.argtypes = [c_char_p, c_int, c_int, c_int, c_int]
+lib.its_conn_create.argtypes = [c_char_p, c_int, c_int, c_int, c_int, c_int]
 lib.its_conn_create.restype = c_void_p
 lib.its_conn_connect.argtypes = [c_void_p]
 lib.its_conn_connect.restype = c_int

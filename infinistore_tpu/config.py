@@ -45,6 +45,12 @@ class ClientConfig:
     # batched payloads with one memcpy instead of the socket. Auto-degrades
     # to the socket path for remote servers.
     enable_shm: bool = True
+    # Egress cap for this connection in MB/s (SO_MAX_PACING_RATE — TCP
+    # internal pacing, no qdisc needed). 0 = unlimited. Production: fairness
+    # on a shared DCN link; tests: emulate a bandwidth-capped cross-host
+    # stream on loopback (tools/striping_emulation.py). Caps PUTs; the
+    # server-side knob caps GETs.
+    pacing_rate_mbps: int = 0
     # Reference-compat knobs, advisory on TPU (no ibverbs device to pick):
     dev_name: str = ""
     ib_port: int = 1
@@ -88,6 +94,9 @@ class ServerConfig:
     # Back pools with named /dev/shm segments so same-host clients get the
     # one-memcpy fast path (falls back to anonymous memory when unavailable).
     enable_shm: bool = True
+    # Egress cap per accepted connection in MB/s (SO_MAX_PACING_RATE). Caps
+    # the server->client GET direction; 0 = unlimited.
+    pacing_rate_mbps: int = 0
     # Reference-compat knobs, advisory on TPU:
     dev_name: str = ""
     ib_port: int = 1

@@ -219,12 +219,27 @@ class KVConnector:
         if n == 0:
             return list(caches), 0
         if self.ici is not None and src is not None and dst is not None:
-            # All layers in ONE SPMD launch (single collective over the
-            # stacked blocks) — a per-layer loop here would pay L sequential
-            # dispatch round-trips on the latency-critical path.
-            out = self.ici.handoff_layers(
-                list(caches), src_block_ids[:n], dst_block_ids[:n], src, dst
+            flat = [c for kv in caches for c in kv]
+            uniform = all(
+                c.shape == flat[0].shape and c.dtype == flat[0].dtype for c in flat
             )
+            if uniform:
+                # All layers in ONE SPMD launch (single collective over the
+                # stacked blocks) — a per-layer loop here would pay L
+                # sequential dispatch round-trips on the latency-critical path.
+                out = self.ici.handoff_layers(
+                    list(caches), src_block_ids[:n], dst_block_ids[:n], src, dst
+                )
+            else:
+                # Ragged layers (hybrid architectures: sliding-window layers
+                # with fewer blocks, mixed precision) cannot stack into one
+                # collective — fall back to one fused K+V launch per layer.
+                out = [
+                    self.ici.handoff_kv(
+                        k, v, src_block_ids[:n], dst_block_ids[:n], src, dst
+                    )
+                    for k, v in caches
+                ]
             return out, n
         if self.ici is not None and self.conn is None:
             raise ValueError(

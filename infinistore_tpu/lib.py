@@ -165,6 +165,7 @@ class InfinityConnection:
             self.config.connect_timeout_ms,
             1 if self.config.enable_shm else 0,
             self.config.op_timeout_ms,
+            self.config.pacing_rate_mbps,
         )
         rc = lib.its_conn_connect(handle)
         if rc != 0:
@@ -620,6 +621,7 @@ def register_server(loop, config: ServerConfig):
             config.on_demand_evict_min,
             config.on_demand_evict_max,
             1 if config.enable_shm else 0,
+            config.pacing_rate_mbps,
         )
         if not handle:
             raise InfiniStoreException("failed to create server (allocation failed?)")
@@ -659,6 +661,7 @@ def start_local_server(
     evict_min: float = 0.8,
     evict_max: float = 0.95,
     enable_shm: bool = True,
+    pacing_rate_mbps: int = 0,
 ):
     """Start an anonymous in-process server; returns a ``LocalServer``.
 
@@ -679,6 +682,7 @@ def start_local_server(
         evict_min,
         evict_max,
         1 if enable_shm else 0,
+        pacing_rate_mbps,
     )
     if not handle:
         raise InfiniStoreException("failed to create server (allocation failed?)")

@@ -1,0 +1,73 @@
+"""Shared rate-shaped measurement helper.
+
+One implementation of the shaped striped roundtrip, used by ``bench.py``,
+``tools/striping_emulation.py``, and ``tests/test_striping_shaped.py`` — the
+three must measure the same workload or the bench, tool, and CI test silently
+diverge. The shaping itself is ``pacing_rate_mbps`` (SO_MAX_PACING_RATE, TCP
+internal pacing): the client knob caps PUT egress, the server knob caps GET
+egress, together emulating a bandwidth-limited cross-host stream on loopback.
+"""
+
+import asyncio
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .config import ClientConfig
+from .lib import InfinityConnection, StripedConnection
+
+BLOCK = 64 << 10
+
+
+def shaped_config(port: int, cap_mbps: int) -> ClientConfig:
+    return ClientConfig(
+        host_addr="127.0.0.1",
+        service_port=port,
+        log_level="error",
+        enable_shm=False,  # force the socket path: that is what stripes split
+        pacing_rate_mbps=cap_mbps,
+    )
+
+
+def shaped_roundtrip_mbps(
+    port: int,
+    cap_mbps: int,
+    streams: int,
+    nbytes: int,
+    key_prefix: str = "shaped",
+    verify: bool = False,
+) -> Tuple[float, Optional[bool]]:
+    """Aggregate write+read MB/s of the headline workload over N paced
+    stripes against the (server-side paced) store on ``port``.
+
+    Returns (mbps, verified): ``verified`` is None unless ``verify`` — the
+    verifying variant reads into a second buffer and compares, at the cost of
+    a larger working set.
+    """
+    cfg = shaped_config(port, cap_mbps)
+    conn = (
+        StripedConnection(cfg, streams=streams)
+        if streams > 1
+        else InfinityConnection(cfg)
+    )
+    conn.connect()
+    n = nbytes // BLOCK
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    conn.register_mr(src)
+    dst = src
+    if verify:
+        dst = np.zeros_like(src)
+        conn.register_mr(dst)
+    pairs = [(f"{key_prefix}{streams}-{i}", i * BLOCK) for i in range(n)]
+
+    async def once():
+        await conn.write_cache_async(pairs, BLOCK, src.ctypes.data)
+        await conn.read_cache_async(pairs, BLOCK, dst.ctypes.data)
+
+    t0 = time.perf_counter()
+    asyncio.run(once())
+    dt = time.perf_counter() - t0
+    verified = bool(np.array_equal(src, dst)) if verify else None
+    conn.close()
+    return 2 * n * BLOCK / dt / (1 << 20), verified

@@ -46,6 +46,11 @@ struct ClientConfig {
     // Degrades automatically to the socket path when the server is remote or
     // shm-less.
     bool enable_shm = true;
+    // Egress cap for this connection in MB/s via SO_MAX_PACING_RATE (TCP
+    // internal pacing; no qdisc needed). 0 = unlimited. Production use:
+    // fairness on a shared DCN link. Test use: emulating a bandwidth-capped
+    // cross-host stream on loopback to exercise connection striping.
+    uint32_t pacing_rate_mbps = 0;
 };
 
 using CompletionCb = void (*)(void* ctx, int code);

@@ -1,0 +1,26 @@
+// Socket helpers shared by the client and server reactors.
+#pragma once
+
+#include <errno.h>
+#include <string.h>
+#include <sys/socket.h>
+
+#include <cstdint>
+
+#include "its/log.h"
+
+namespace its {
+
+// Cap a socket's egress with SO_MAX_PACING_RATE (TCP internal pacing — works
+// without an fq qdisc since Linux 4.13). mbps == 0 leaves the socket
+// unlimited. The u32 sockopt form caps at 4 GB/s; rates at or above 4096
+// MB/s mean "unlimited" here, which is the only sane reading of such a cap.
+inline void set_pacing_rate(int fd, uint32_t mbps, const char* who) {
+    if (mbps == 0) return;
+    uint32_t rate = mbps >= (1u << 12) ? UINT32_MAX : mbps << 20;  // MB/s -> B/s
+    if (setsockopt(fd, SOL_SOCKET, SO_MAX_PACING_RATE, &rate, sizeof(rate)) != 0)
+        ITS_LOG_WARN("%s: SO_MAX_PACING_RATE(%u MB/s) failed: %s — egress UNCAPPED",
+                     who, mbps, strerror(errno));
+}
+
+}  // namespace its

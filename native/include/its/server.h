@@ -52,6 +52,10 @@ struct ServerConfig {
     // payloads with one memcpy instead of the socket (degrades to anonymous
     // memory + socket path automatically when /dev/shm is unavailable).
     bool enable_shm = true;
+    // Egress cap per accepted connection in MB/s via SO_MAX_PACING_RATE
+    // (caps the server->client GET direction; the client-side knob caps
+    // PUTs). 0 = unlimited. See ClientConfig::pacing_rate_mbps.
+    uint32_t pacing_rate_mbps = 0;
 };
 
 // Per-op service counters (SURVEY.md §5.1: the reference has no tracing at

@@ -74,7 +74,8 @@ void its_log(int level, const char* msg) {
 // ---- server ----
 void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes,
                         uint64_t block_bytes, int auto_increase, uint64_t extend_bytes,
-                        int pin, double evict_min, double evict_max, int enable_shm) {
+                        int pin, double evict_min, double evict_max, int enable_shm,
+                        int pacing_rate_mbps) {
     ServerConfig cfg;
     cfg.bind_addr = bind_addr;
     cfg.service_port = port;
@@ -86,6 +87,7 @@ void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes
     cfg.evict_min_ratio = evict_min;
     cfg.evict_max_ratio = evict_max;
     cfg.enable_shm = enable_shm != 0;
+    cfg.pacing_rate_mbps = pacing_rate_mbps > 0 ? static_cast<uint32_t>(pacing_rate_mbps) : 0;
     try {
         return new Server(cfg);
     } catch (const std::exception& e) {
@@ -109,13 +111,14 @@ int its_server_stats_json(void* s, char* buf, int buf_len) {
 
 // ---- client ----
 void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm,
-                      int op_timeout_ms) {
+                      int op_timeout_ms, int pacing_rate_mbps) {
     ClientConfig cfg;
     cfg.host = host;
     cfg.port = port;
     cfg.connect_timeout_ms = timeout_ms;
     cfg.op_timeout_ms = op_timeout_ms;
     cfg.enable_shm = enable_shm != 0;
+    cfg.pacing_rate_mbps = pacing_rate_mbps > 0 ? static_cast<uint32_t>(pacing_rate_mbps) : 0;
     return new Connection(cfg);
 }
 int its_conn_connect(void* c) { return static_cast<Connection*>(c)->connect(); }

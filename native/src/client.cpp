@@ -21,6 +21,7 @@
 #include <random>
 
 #include "its/iovec_util.h"
+#include "its/net_util.h"
 #include "its/log.h"
 #include "its/mempool.h"  // shm_registry_* (crash-time segment cleanup)
 
@@ -152,6 +153,7 @@ int Connection::connect() {
     setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     // SO_SNDBUF/SO_RCVBUF intentionally left to kernel autotuning (see
     // server accept path).
+    set_pacing_rate(fd_, config_.pacing_rate_mbps, "client");
 
     epoll_fd_ = epoll_create1(EPOLL_CLOEXEC);
     wake_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
@@ -663,17 +665,25 @@ bool Connection::flush_send() {
         // tx_payload: a timed-out waiter blocks until we exit it.
         IoSection sec(io_seq_);
         if (req->sync != nullptr && req->sync->abandoned.load()) {
-            if (req->sent == 0) {
+            // Only a request whose WIRE payload gathers from caller memory
+            // is dangerous to send. Everything else proceeds normally even
+            // when abandoned — in particular a queued kOpPutCommit (body
+            // only) MUST still go out, or the server-side ticket's pinned
+            // pool blocks leak; late responses are drained/completed into
+            // the shared SyncState.
+            bool refs_caller = req->payload_on_wire && !req->tx_payload.empty() &&
+                               req->owned_payload.empty();
+            if (refs_caller && req->sent == 0) {
                 // Never reached the wire: drop it whole — the server never
-                // saw it, so FIFO response matching is unaffected.
+                // saw it, so FIFO response matching is unaffected and there
+                // is no server-side state to clean up.
                 auto dead = std::move(sendq_.front());
                 sendq_.pop_front();
                 complete(std::move(dead), static_cast<int>(kStatusUnavailable),
                          /*take_body=*/false);
                 continue;
             }
-            if (req->payload_on_wire && !req->tx_payload.empty() &&
-                req->owned_payload.empty() && req->sent < req->send_total) {
+            if (refs_caller && req->sent < req->send_total) {
                 // Half-streamed from caller memory the caller may have freed
                 // after the timeout. Abandoning mid-frame would desync the
                 // protocol; the only safe move is to fail the connection.

@@ -173,6 +173,37 @@ def test_connector_handoff_routes_ici_without_store(mesh):
             assert np.array_equal(got[5][0], ref[0][6])
 
 
+def test_connector_handoff_ragged_layers_fall_back_per_layer(mesh):
+    """Hybrid architectures (e.g. sliding-window layers with fewer blocks)
+    cannot stack into one collective: the connector must fall back to one
+    fused K+V launch per layer instead of raising."""
+    import asyncio
+
+    from infinistore_tpu.connector import KVConnector
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+    spec = PagedKVCacheSpec(
+        num_layers=2, num_blocks=8, block_tokens=4, num_kv_heads=2, head_dim=8,
+        dtype=jnp.float32,
+    )
+    tr = IciBlockTransfer(mesh, "store", perm=[(0, 3)])
+    kvc = KVConnector(None, spec, "ragged", max_blocks=4, ici=tr)
+    # Layer 1 has twice the blocks of layer 0 (ragged).
+    caches = [
+        (jnp.ones((8, 8, 4, 2, 8)), jnp.ones((8, 8, 4, 2, 8)) * 2),
+        (jnp.ones((8, 16, 4, 2, 8)) * 3, jnp.ones((8, 16, 4, 2, 8)) * 4),
+    ]
+    out, n = asyncio.run(
+        kvc.handoff(list(range(8)), caches, np.array([1, 2]), np.array([5, 0]),
+                    src=0, dst=3)
+    )
+    assert n == 2
+    assert tr.launches == 2  # one fused K+V launch per ragged layer
+    for l, scale in ((0, 1), (1, 3)):
+        got_k = np.asarray(out[l][0])
+        assert got_k[3][5].flatten()[0] == scale  # src shard 0's block 1 content
+
+
 def test_connector_handoff_degrades_to_dcn():
     """Without a bound mesh the same handoff call rides the DCN store."""
     import asyncio

@@ -1,0 +1,60 @@
+"""Striping under a rate-shaped link: the regime where stripes win.
+
+On this box's unshaped loopback, striping hurts (single core, memcpy-bound —
+docs/multistream.md). These tests build the cross-host regime the knob exists
+for: ``pacing_rate_mbps`` caps each connection with SO_MAX_PACING_RATE (TCP
+internal pacing), like a bandwidth-limited DCN stream, and striping must then
+scale aggregate throughput with the stream count. The reference gets the same
+effect from pipeline depth over one RC QP (8000 outstanding WRs,
+reference src/protocol.h:22-26); multiple TCP streams are the socket-world
+equivalent.
+"""
+
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu.shaping import BLOCK, shaped_roundtrip_mbps
+
+CAP_MBPS = 40
+N = 64  # 4MB per direction: >=0.1s single-stream at the cap, fast at 4
+
+
+@pytest.fixture(scope="module")
+def paced_server():
+    srv = its.start_local_server(
+        prealloc_bytes=64 << 20,
+        block_bytes=BLOCK,
+        enable_shm=False,  # stripes split socket traffic; shm would bypass it
+        pacing_rate_mbps=CAP_MBPS,
+    )
+    yield srv
+    srv.stop()
+
+
+def _roundtrip_mbps(port: int, streams: int) -> float:
+    mbps, verified = shaped_roundtrip_mbps(
+        port, CAP_MBPS, streams, nbytes=N * BLOCK, verify=True
+    )
+    assert verified, "shaped roundtrip corrupted data"
+    return mbps
+
+
+def test_single_stream_pins_at_the_cap(paced_server):
+    """One paced connection must cap near pacing_rate_mbps — proof the
+    shaping emulates a bandwidth-limited stream (not a no-op flag)."""
+    mbps = _roundtrip_mbps(paced_server.port, 1)
+    # Write and read legs are paced separately, so the aggregate cannot
+    # meaningfully exceed the cap; generous floor for scheduler noise.
+    assert mbps < CAP_MBPS * 1.5, f"pacing not applied: {mbps:.0f} MB/s"
+    assert mbps > CAP_MBPS * 0.4, f"paced stream unreasonably slow: {mbps:.0f} MB/s"
+
+
+def test_striping_scales_under_shaping(paced_server):
+    """4 stripes must deliver >=2x one stripe when each stream is capped —
+    the claim docs/multistream.md made and round 2 shipped unproven."""
+    one = _roundtrip_mbps(paced_server.port, 1)
+    four = _roundtrip_mbps(paced_server.port, 4)
+    assert four >= 2.0 * one, (
+        f"striping failed to scale under shaping: 1 stream {one:.0f} MB/s, "
+        f"4 streams {four:.0f} MB/s"
+    )

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Rate-shaped cross-host emulation: prove striping where it can win.
+
+On this single-core loopback box, striping measurably HURTS (memcpy-bound;
+docs/multistream.md) — but the knob exists for cross-host DCN, where one TCP
+stream caps well below the NIC. This harness builds that regime on-box:
+``pacing_rate_mbps`` (SO_MAX_PACING_RATE — TCP internal pacing, no qdisc or
+privileges needed) caps every connection's egress in BOTH directions
+(client knob caps PUTs, server knob caps GETs), exactly the shape of a
+bandwidth-limited cross-host stream. Under the cap:
+
+  - 1 stream pins at the per-connection rate,
+  - ``StripedConnection(streams=N)`` scales ~linearly until the payload is
+    small enough that per-stream fixed costs bite.
+
+Two experiments, one JSON line each:
+
+1. ``scaling``: the loopback bench's exact workload (batched write+read,
+   shm disabled so everything rides the paced socket) at 1/2/4 streams.
+2. ``disagg``  (BASELINE config 5 emulation): two PROCESSES — a prefill
+   role that streams L layers of paged-KV blocks to the store, and a decode
+   role that reads them back — over the shaped link, the 2-host
+   prefill→decode split this environment cannot run for real (reference
+   cross-node usage: /root/reference/README.md:13-16,
+   docs/source/design.rst:33-37).
+
+Run: ``python tools/striping_emulation.py [--cap-mbps 50] [--mb 16]``
+"""
+
+import argparse
+import asyncio
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import infinistore_tpu as its  # noqa: E402
+from infinistore_tpu.shaping import (  # noqa: E402
+    BLOCK,
+    shaped_config as _shaped_config,
+    shaped_roundtrip_mbps,
+)
+
+
+def measure_streams(port: int, cap_mbps: int, streams: int, nbytes: int) -> float:
+    """Aggregate write+read MB/s of the headline workload over N stripes
+    (the shared shaped-roundtrip measurement, infinistore_tpu/shaping.py)."""
+    mbps, _ = shaped_roundtrip_mbps(port, cap_mbps, streams, nbytes, key_prefix="em")
+    return mbps
+
+
+# ---- BASELINE config 5: two-process prefill→decode over the shaped link ----
+
+
+def _prefill_role(port, cap_mbps, layers, blocks_per_layer, streams, done_q):
+    """Producer process: stream L layers of KV blocks to the store, layer 0
+    last (the connector's sentinel ordering, tpu/layerwise.py). Keys are
+    namespaced by stream count: each experiment must write fresh keys, or a
+    later run's decode role would see the previous run's layer-0 sentinel
+    and read stale bytes while the new prefill is still writing."""
+    cfg = _shaped_config(port, cap_mbps)
+    conn = its.StripedConnection(cfg, streams=streams) if streams > 1 else its.InfinityConnection(cfg)
+    conn.connect()
+    buf = np.random.randint(0, 256, size=blocks_per_layer * BLOCK, dtype=np.uint8)
+    conn.register_mr(buf)
+
+    async def run():
+        for layer in list(range(1, layers)) + [0]:
+            pairs = [(f"d{streams}/L{layer}/{i}", i * BLOCK) for i in range(blocks_per_layer)]
+            await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+    t0 = time.perf_counter()
+    asyncio.run(run())
+    done_q.put(("prefill_s", time.perf_counter() - t0, buf[:64].tolist()))
+    conn.close()
+
+
+def _decode_role(port, cap_mbps, layers, blocks_per_layer, streams, done_q):
+    """Consumer process: wait for the layer-0 sentinel, then pull every
+    layer's blocks (what the decode host does before serving tokens).
+
+    With striping the layer-0 batch commits per-stripe, so one key is not a
+    sufficient sentinel — confirm every layer-0 block before reading (the
+    real connector gets this per-block granularity from lookup()'s
+    longest-prefix match over per-block chain keys)."""
+    cfg = _shaped_config(port, cap_mbps)
+    conn = its.StripedConnection(cfg, streams=streams) if streams > 1 else its.InfinityConnection(cfg)
+    conn.connect()
+    buf = np.zeros(blocks_per_layer * BLOCK, dtype=np.uint8)
+    conn.register_mr(buf)
+    t0 = time.perf_counter()
+    pending = set(range(blocks_per_layer))
+    while pending:
+        pending = {i for i in pending if not conn.check_exist(f"d{streams}/L0/{i}")}
+        if not pending:
+            break
+        time.sleep(0.005)
+        if time.perf_counter() - t0 > 120:
+            done_q.put(("decode_timeout", -1.0, []))
+            return
+
+    async def run():
+        for layer in range(layers):
+            pairs = [(f"d{streams}/L{layer}/{i}", i * BLOCK) for i in range(blocks_per_layer)]
+            await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+    t1 = time.perf_counter()
+    asyncio.run(run())
+    done_q.put(("decode_s", time.perf_counter() - t1, buf[:64].tolist()))
+    conn.close()
+
+
+def disagg_emulation(port, cap_mbps, streams, layers=8, blocks_per_layer=32):
+    """Returns (prefill MB/s, decode MB/s, verified) for the 2-process split."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=role, args=(port, cap_mbps, layers, blocks_per_layer, streams, q)
+        )
+        for role in (_prefill_role, _decode_role)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    payloads = {}
+    for _ in range(2):
+        tag, secs, head = q.get(timeout=180)
+        results[tag] = secs
+        payloads[tag] = head
+    for p in procs:
+        p.join(timeout=30)
+    if "decode_timeout" in results:
+        raise RuntimeError("decode role never saw the layer-0 sentinel")
+    nbytes = layers * blocks_per_layer * BLOCK
+    verified = payloads["prefill_s"] == payloads["decode_s"]
+    return (
+        nbytes / results["prefill_s"] / (1 << 20),
+        nbytes / results["decode_s"] / (1 << 20),
+        verified,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cap-mbps", type=int, default=50,
+                    help="per-connection egress cap, both directions")
+    ap.add_argument("--mb", type=int, default=16, help="payload MB per direction")
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--blocks-per-layer", type=int, default=32)
+    args = ap.parse_args()
+
+    srv = its.start_local_server(
+        prealloc_bytes=max(256 << 20, 4 * args.mb << 20),
+        block_bytes=BLOCK,
+        enable_shm=False,
+        pacing_rate_mbps=args.cap_mbps,
+    )
+    try:
+        scaling = {
+            str(s): round(measure_streams(srv.port, args.cap_mbps, s, args.mb << 20), 1)
+            for s in (1, 2, 4)
+        }
+        print(json.dumps({
+            "experiment": "scaling",
+            "cap_mbps": args.cap_mbps,
+            "aggregate_mbps_by_streams": scaling,
+            "speedup_4_over_1": round(scaling["4"] / scaling["1"], 2),
+        }))
+
+        for streams in (1, 4):
+            pre, dec, ok = disagg_emulation(
+                srv.port, args.cap_mbps, streams, args.layers, args.blocks_per_layer
+            )
+            print(json.dumps({
+                "experiment": "disagg_prefill_decode",
+                "streams": streams,
+                "cap_mbps": args.cap_mbps,
+                "prefill_mbps": round(pre, 1),
+                "decode_mbps": round(dec, 1),
+                "data_verified": ok,
+            }))
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()
