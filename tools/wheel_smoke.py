@@ -1,0 +1,44 @@
+"""Smoke test run against an INSTALLED wheel (tools/build_wheel.sh copies
+this file to a temp dir so the repo tree is not importable): the bundled .so
+must load without a native/ source tree, and the full public surface must
+work — server up, sync + async batched roundtrip, control ops, stats."""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+
+import infinistore_tpu as its
+
+pkg = os.path.dirname(its.__file__)
+assert not os.path.exists(os.path.join(pkg, "..", "native")), (
+    "smoke test imported the repo tree, not the installed wheel"
+)
+
+srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=64 << 10)
+conn = its.InfinityConnection(
+    its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+)
+conn.connect()
+
+n, block = 16, 64 << 10
+src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+dst = np.zeros_like(src)
+conn.register_mr(src)
+conn.register_mr(dst)
+pairs = [(f"wheel-{i}", i * block) for i in range(n)]
+asyncio.run(conn.write_cache_async(pairs, block, src.ctypes.data))
+conn.read_cache(pairs, block, dst.ctypes.data)
+assert np.array_equal(src, dst), "roundtrip mismatch"
+
+assert conn.check_exist("wheel-0") is True
+assert conn.get_match_last_index([f"wheel-{i}" for i in range(n)]) == n - 1
+assert conn.delete_keys([f"wheel-{i}" for i in range(n)]) == n
+stats = conn.get_stats()
+assert stats.get("conns_accepted", 0) >= 1
+
+conn.close()
+srv.stop()
+print(f"wheel smoke ok (python {sys.version_info.major}.{sys.version_info.minor}, "
+      f"{n * block >> 10}KB roundtrip verified)")
