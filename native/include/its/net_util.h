@@ -11,6 +11,17 @@
 
 namespace its {
 
+// writev for sockets that cannot raise SIGPIPE: a peer that closes mid-write
+// must surface as EPIPE to the reactor, not kill the embedding process
+// (Python masks SIGPIPE, so only native embedders ever saw the default
+// disposition — found by the native abandoned-op stress test).
+inline ssize_t writev_nosignal(int fd, const struct iovec* iov, int niov) {
+    msghdr msg{};
+    msg.msg_iov = const_cast<struct iovec*>(iov);
+    msg.msg_iovlen = static_cast<size_t>(niov);
+    return sendmsg(fd, &msg, MSG_NOSIGNAL);
+}
+
 // Cap a socket's egress with SO_MAX_PACING_RATE (TCP internal pacing — works
 // without an fq qdisc since Linux 4.13). mbps == 0 leaves the socket
 // unlimited. The u32 sockopt form caps at 4 GB/s; rates at or above 4096

@@ -696,7 +696,7 @@ bool Connection::flush_send() {
             req->payload_on_wire ? req->tx_payload : kNoPayload;
         size_t niov = build_send_iov(&req->hdr, sizeof(ReqHeader), req->body, wire_payload,
                                      req->sent, iov, 64);
-        ssize_t r = writev(fd_, iov, static_cast<int>(niov));
+        ssize_t r = writev_nosignal(fd_, iov, static_cast<int>(niov));
         if (r < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 epoll_event ev{};

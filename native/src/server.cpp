@@ -1035,7 +1035,7 @@ void Server::flush_out(Conn* c) {
             c->outq.pop_front();
             continue;
         }
-        ssize_t r = writev(c->fd, iov, static_cast<int>(niov));
+        ssize_t r = writev_nosignal(c->fd, iov, static_cast<int>(niov));
         if (r < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 arm(c, true);

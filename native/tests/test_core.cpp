@@ -191,6 +191,89 @@ static void test_loopback_end_to_end(bool enable_shm) {
     server.stop();
 }
 
+static void test_abandoned_sync_ops_stress(bool enable_shm) {
+    // The documented timeout contract: after a sync op raises, the caller
+    // may unregister and FREE the buffer — the reactor must never touch it
+    // again (SyncState::abandoned + io_seq_ Dekker pairing, client.cpp).
+    // Regime: 16MB ops (several ms of streaming/memcpy) against a 1ms
+    // deadline, so ops are abandoned unsent, mid-stream, mid-scatter, and
+    // awaiting a late response. Each iteration frees its buffer immediately
+    // — under ASAN/TSAN any late reactor touch is a hard failure. A
+    // mid-stream put abandonment intentionally fails the connection; the
+    // loop reconnects, covering that path too.
+    ServerConfig scfg;
+    scfg.bind_addr = "127.0.0.1";
+    scfg.service_port = 0;
+    scfg.prealloc_bytes = 256 << 20;
+    scfg.block_size = 64 << 10;
+    scfg.pin_memory = false;
+    scfg.enable_shm = enable_shm;
+    Server server(scfg);
+    CHECK(server.start());
+
+    const size_t n = 64, bs = 256 << 10;  // 16MB per op
+    std::vector<std::string> keys;
+    std::vector<uint64_t> offs;
+    for (size_t i = 0; i < n; i++) {
+        keys.push_back("ab" + std::to_string(i));
+        offs.push_back(i * bs);
+    }
+
+    // Seed the keys with a patient connection so gets have data to return.
+    {
+        ClientConfig seed_cfg;
+        seed_cfg.host = "127.0.0.1";
+        seed_cfg.port = server.port();
+        seed_cfg.enable_shm = enable_shm;
+        Connection seed(seed_cfg);
+        CHECK(seed.connect() == 0);
+        std::vector<char> src(n * bs, 'S');
+        seed.register_mr(src.data(), src.size());
+        CHECK(seed.put_batch(keys, offs, bs, src.data()) == 0);
+        seed.close();
+    }
+
+    ClientConfig ccfg;
+    ccfg.host = "127.0.0.1";
+    ccfg.port = server.port();
+    ccfg.enable_shm = enable_shm;
+    ccfg.op_timeout_ms = 1;
+    auto conn = std::make_unique<Connection>(ccfg);
+    CHECK(conn->connect() == 0);
+
+    int fails = 0, oks = 0, reconnects = 0;
+    for (int it = 0; it < 40; it++) {
+        auto buf = std::make_unique<std::vector<char>>(n * bs,
+                                                       static_cast<char>(it));
+        conn->register_mr(buf->data(), buf->size());
+        int rc = (it & 1) ? conn->get_batch(keys, offs, bs, buf->data())
+                          : conn->put_batch(keys, offs, bs, buf->data());
+        rc == 0 ? oks++ : fails++;
+        // The documented sequence after a timeout: unregister, scribble,
+        // free. If the reactor still holds an iovec into this memory, the
+        // sanitizers see the touch after the delete below.
+        conn->unregister_mr(buf->data());
+        memset(buf->data(), 0xDD, 4096);
+        buf.reset();
+        if (rc != 0) {
+            // Mid-stream abandonment fails the connection by design; a
+            // fresh connection also covers connect/teardown under churn.
+            conn->close();
+            conn = std::make_unique<Connection>(ccfg);
+            CHECK(conn->connect() == 0);
+            reconnects++;
+        }
+    }
+    // Let any last late responses land (and be drained) while the final
+    // connection is still alive.
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    CHECK(fails > 0);  // the abandoned regime was actually exercised
+    conn->close();
+    server.stop();
+    (void)oks;
+    (void)reconnects;
+}
+
 static void test_opstats_percentile_accuracy() {
     // The HDR-style histogram must report percentiles within ~10% — the
     // BASELINE latency metric is p50, so 2x power-of-two quantization is
@@ -226,6 +309,8 @@ int main() {
     test_wire_codec_roundtrip();
     test_loopback_end_to_end(/*enable_shm=*/true);
     test_loopback_end_to_end(/*enable_shm=*/false);
+    test_abandoned_sync_ops_stress(/*enable_shm=*/true);
+    test_abandoned_sync_ops_stress(/*enable_shm=*/false);
     if (g_failures == 0) {
         printf("native tests: all passed\n");
         return 0;
