@@ -58,6 +58,8 @@ class ClientConfig:
     hint_gid_index: int = -1
 
     def verify(self) -> None:
+        """Validate field values; raises ValueError on any bad setting
+        (mirrors the reference ClientConfig.verify, lib.py:76-91)."""
         if self.connection_type not in SUPPORTED_CONN_TYPES:
             raise ValueError(
                 f"connection_type must be one of {SUPPORTED_CONN_TYPES}, "
@@ -106,6 +108,8 @@ class ServerConfig:
     extra: dict = field(default_factory=dict)
 
     def verify(self) -> None:
+        """Validate field values; raises ValueError on any bad setting
+        (mirrors the reference ServerConfig.verify, lib.py:140-152)."""
         if not (0 < self.service_port < 65536) or not (0 < self.manage_port < 65536):
             raise ValueError("ports must be in (0, 65536)")
         if self.service_port == self.manage_port:

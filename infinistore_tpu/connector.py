@@ -103,6 +103,7 @@ class KVConnector:
     # -- key scheme ----------------------------------------------------------
 
     def block_key(self, layer: int, kind: str, chain_hash: str) -> str:
+        """Store key for one block: ``{model}/L{layer}/{k|v}/{chain_hash}``."""
         return f"{self.model_id}/L{layer}/{kind}/{chain_hash}"
 
     def _key_fn(self, chains: List[str]):

@@ -62,22 +62,27 @@ class Logger:
 
     @staticmethod
     def debug(msg):
+        """Log at debug level through the native sink."""
         lib.its_log(0, str(msg).encode())
 
     @staticmethod
     def info(msg):
+        """Log at info level through the native sink."""
         lib.its_log(1, str(msg).encode())
 
     @staticmethod
     def warn(msg):
+        """Log at warning level through the native sink."""
         lib.its_log(2, str(msg).encode())
 
     @staticmethod
     def error(msg):
+        """Log at error level through the native sink."""
         lib.its_log(3, str(msg).encode())
 
     @staticmethod
     def set_log_level(level: str):
+        """Set the process-wide level: debug|info|warning|error|off."""
         lib.its_set_log_level(_LOG_LEVELS[level.lower()])
 
 
@@ -158,6 +163,8 @@ class InfinityConnection:
     # -- lifecycle ----------------------------------------------------------
 
     def connect(self):
+        """Connect to the store (blocking; bounded by connect_timeout_ms).
+        Attempts the same-host shm handshake when enable_shm is set."""
         ip = _resolve_hostname(self.config.host_addr)
         handle = lib.its_conn_create(
             ip.encode(),
@@ -185,9 +192,13 @@ class InfinityConnection:
         return self._handle is not None and lib.its_conn_shm_active(self._handle) == 1
 
     async def connect_async(self):
+        """connect() off the event loop thread (reference connect_async)."""
         await asyncio.to_thread(self.connect)
 
     def close(self):
+        """Tear down the connection: stops the native reactor, unmaps shm
+        segments (invalidating alloc_shm_mr views), releases registrations.
+        ``close_connection`` is the reference-compatible alias."""
         if self._handle is not None:
             lib.its_conn_close(self._handle)
             lib.its_conn_destroy(self._handle)
@@ -477,13 +488,16 @@ class StripedConnection:
     # -- lifecycle -----------------------------------------------------------
 
     def connect(self):
+        """Open every stripe's connection (blocking)."""
         for c in self.conns:
             c.connect()
 
     async def connect_async(self):
+        """Open every stripe's connection concurrently."""
         await asyncio.gather(*(c.connect_async() for c in self.conns))
 
     def close(self):
+        """Close every stripe (unmaps stripe 0's shm segments)."""
         for c in self.conns:
             c.close()
 
@@ -494,11 +508,14 @@ class StripedConnection:
     # -- memory registration (fan out: a batch may land on any stripe) -------
 
     def register_mr(self, arg, size: Optional[int] = None):
+        """Register the region on EVERY stripe (a batch chunk may land on
+        any of them). Same argument forms as InfinityConnection.register_mr."""
         for c in self.conns:
             c.register_mr(arg, size)
         return 0
 
     def unregister_mr(self, arg):
+        """Drop the region's registration from every stripe."""
         for c in self.conns:
             c.unregister_mr(arg)
 
@@ -535,6 +552,9 @@ class StripedConnection:
         return results[0]
 
     async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
+        """Batched block write split across stripes in contiguous chunks
+        (write_cache_async is the TPU-native alias). Small batches stay on
+        stripe 0 — splitting them would only add per-op round trips."""
         if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
             return await self.conns[0].write_cache_async(blocks, block_size, ptr)
         chunks = self._split(blocks)
@@ -544,6 +564,9 @@ class StripedConnection:
         )
 
     async def rdma_read_cache_async(self, blocks, block_size: int, ptr: int):
+        """Batched block read split across stripes (read_cache_async is the
+        TPU-native alias); KeyNotFound on any stripe raises after all
+        stripes settle."""
         if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
             return await self.conns[0].read_cache_async(blocks, block_size, ptr)
         chunks = self._split(blocks)
@@ -561,26 +584,34 @@ class StripedConnection:
         return self.conns[0].write_cache(blocks, block_size, ptr)
 
     def read_cache(self, blocks, block_size: int, ptr: int):
+        """Blocking batched read on stripe 0 (see write_cache)."""
         return self.conns[0].read_cache(blocks, block_size, ptr)
 
     # -- control / single-key ops: stripe 0 ----------------------------------
 
     def tcp_write_cache(self, key, ptr, size, **kw):
+        """Single-key blocking put (stripe 0)."""
         return self.conns[0].tcp_write_cache(key, ptr, size, **kw)
 
     def tcp_read_cache(self, key, **kw):
+        """Single-key blocking get (stripe 0); returns a numpy view."""
         return self.conns[0].tcp_read_cache(key, **kw)
 
     def check_exist(self, key):
+        """True when the key is committed in the store (stripe 0)."""
         return self.conns[0].check_exist(key)
 
     def get_match_last_index(self, keys):
+        """Longest-prefix match over a key chain (stripe 0); raises
+        InfiniStoreNoMatch when nothing matches."""
         return self.conns[0].get_match_last_index(keys)
 
     def delete_keys(self, keys):
+        """Delete keys from the store; returns the count removed (stripe 0)."""
         return self.conns[0].delete_keys(keys)
 
     def get_stats(self):
+        """Server-side per-op stats snapshot as a dict (stripe 0)."""
         return self.conns[0].get_stats()
 
 
@@ -643,6 +674,7 @@ class LocalServer:
     _stopped: bool = False
 
     def stop(self):
+        """Stop the reactor and free the pools (idempotent)."""
         if not self._stopped:
             self._stopped = True
             lib.its_server_stop(self.handle)

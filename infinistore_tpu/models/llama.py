@@ -43,6 +43,7 @@ class LlamaConfig:
         return self.dim // self.n_heads
 
     def kv_spec(self, num_blocks: int) -> PagedKVCacheSpec:
+        """Paged-KV cache spec matching this model's layers/heads/dtype."""
         return PagedKVCacheSpec(
             num_layers=self.n_layers,
             num_blocks=num_blocks,
@@ -255,6 +256,8 @@ def loss_fn(params: Params, tokens: jax.Array, config: LlamaConfig) -> jax.Array
 def train_step(
     params: Params, tokens: jax.Array, config: LlamaConfig, lr: float = 1e-3
 ) -> Tuple[Params, jax.Array]:
+    """One SGD step on next-token loss; returns (new_params, loss). Shards
+    follow the inputs (pjit-compatible: used by the multichip dryrun)."""
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, config)
     new_params = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype), params, grads)
     return new_params, loss

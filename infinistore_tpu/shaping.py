@@ -21,6 +21,8 @@ BLOCK = 64 << 10
 
 
 def shaped_config(port: int, cap_mbps: int) -> ClientConfig:
+    """Loopback client config with per-connection pacing and shm disabled
+    (every byte rides the paced socket)."""
     return ClientConfig(
         host_addr="127.0.0.1",
         service_port=port,

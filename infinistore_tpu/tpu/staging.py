@@ -61,6 +61,8 @@ class RegisteredTransfer:
         self._registered: List[np.ndarray] = []
 
     def wait(self) -> List[np.ndarray]:
+        """Block for the D2H copies, then register the host views with the
+        connection (idempotent); returns the registered views."""
         hosts = self.transfer.wait()
         if not self._registered:
             for h in hosts:
@@ -69,6 +71,8 @@ class RegisteredTransfer:
         return hosts
 
     def release(self):
+        """Unregister the host views (call after the network op's future
+        resolves). Best-effort on a closed connection."""
         # Best-effort cleanup: a connection closed mid-flight already cleared
         # its region list — that must not mask the transport error the
         # caller is about to see (nor abort sibling releases).
@@ -120,11 +124,13 @@ class HostStagingPool:
         return self.buf.ctypes.data
 
     def slot_offset(self, slot: int) -> int:
+        """Byte offset of a slot within the pool's registered buffer."""
         if not (0 <= slot < self.num_slots):
             raise IndexError(f"slot {slot} out of range [0, {self.num_slots})")
         return slot * self.block_size
 
     def slot_view(self, slot: int, nbytes: Optional[int] = None) -> np.ndarray:
+        """Zero-copy uint8 view of one slot (nbytes trims the tail)."""
         off = self.slot_offset(slot)
         return self.buf[off : off + (nbytes or self.block_size)]
 
