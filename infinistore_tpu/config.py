@@ -51,6 +51,11 @@ class ClientConfig:
     # stream on loopback (tools/striping_emulation.py). Caps PUTs; the
     # server-side knob caps GETs.
     pacing_rate_mbps: int = 0
+    # Opt-in recovery: when the native reactor reports the connection dead,
+    # blocking ops reconnect (re-registering plain MRs) and retry once. A
+    # restarted server looks like a cold cache, never a dead engine. The
+    # reference has no reconnection at all (SURVEY.md §5.3).
+    auto_reconnect: bool = False
     # Reference-compat knobs, advisory on TPU (no ibverbs device to pick):
     dev_name: str = ""
     ib_port: int = 1

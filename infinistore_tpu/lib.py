@@ -14,6 +14,7 @@ uvloop (no uvloop/PyCapsule dance needed).
 
 import asyncio
 import ctypes
+import functools
 import itertools
 import json
 import os
@@ -143,6 +144,59 @@ def _extract_ptr_size(arg, size: Optional[int]) -> Tuple[int, int]:
     raise NotImplementedError(f"register_mr: unsupported type {type(arg)}")
 
 
+def _reconnecting(ptr_arg: Optional[int] = None):
+    """Retry a blocking op ONCE over a fresh connection when the previous
+    one is dead and ``auto_reconnect`` is configured.
+
+    Scope is deliberately narrow: only sync ops (all idempotent — puts
+    rewrite the same bytes, control ops are reads or absolute deletes), and
+    only when the native reactor reports the connection down — a timeout on
+    a LIVE connection re-raises untouched (retrying would double latency and
+    re-queue work on a server that is merely slow). Async batched ops are
+    not wrapped: their caller owns pipelining and should call
+    ``reconnect()`` itself.
+
+    ``ptr_arg``: positional index (after self) of a raw buffer pointer. A
+    retry whose buffer lived in a now-unmapped shm segment of the OLD
+    connection would touch unmapped memory — it gets a typed error telling
+    the caller to reallocate via alloc_shm_mr instead.
+
+    The reference has no reconnection at all (SURVEY.md §5.3); this is
+    cache-semantics-safe recovery for the disaggregation flow, where a
+    restarted store must look like a cold cache, not a dead engine."""
+
+    def deco(method):
+        @functools.wraps(method)
+        def wrapper(self, *args, **kwargs):
+            try:
+                return method(self, *args, **kwargs)
+            except InfiniStoreKeyNotFound:
+                raise
+            except InfiniStoreException:
+                if not (
+                    self.config.auto_reconnect
+                    and self._ever_connected
+                    and not self.is_connected
+                ):
+                    raise
+                Logger.warn("store connection lost; auto-reconnecting")
+                self.reconnect()
+                if ptr_arg is not None and ptr_arg < len(args):
+                    ptr = args[ptr_arg]
+                    if isinstance(ptr, int) and self._in_dead_shm(ptr):
+                        raise InfiniStoreException(
+                            "reconnected, but this op's buffer was an "
+                            "alloc_shm_mr view of the previous connection "
+                            "(its segment is unmapped) — reallocate the "
+                            "buffer via alloc_shm_mr and retry"
+                        )
+                return method(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
 class InfinityConnection:
     """A connection to one store server (reference InfinityConnection,
     lib.py:288)."""
@@ -155,6 +209,16 @@ class InfinityConnection:
         self._handle = None
         self._semaphores: dict = {}  # per-loop inflight caps
         self._shm_bufs: list = []  # keeps alloc_shm_mr views (and mappings) alive
+        self._plain_mrs: list = []  # (ptr, nbytes) re-registered on reconnect
+        self._ever_connected = False  # auto-reconnect only after a first connect
+        # Old native handles parked by reconnect(): destroying them there
+        # could free a Connection another thread is still inside (sync ops
+        # run without the GIL) — they are closed immediately (reactor stops,
+        # in-flight ops fail out) but destroyed only in close().
+        self._dead_handles: list = []
+        # Address ranges of shm segments unmapped by reconnect(): a retried
+        # op whose buffer lived there must get a clear error, not a segfault.
+        self._dead_shm_ranges: list = []
         self._lock = threading.Lock()
         self.rdma_connected = False  # name kept for drop-in compatibility
         self.tcp_connected = False
@@ -181,6 +245,7 @@ class InfinityConnection:
                 f"failed to connect to {ip}:{self.config.service_port} (rc={rc})"
             )
         self._handle = handle
+        self._ever_connected = True
         if self.config.connection_type == TYPE_RDMA:
             self.rdma_connected = True
         else:
@@ -204,15 +269,80 @@ class InfinityConnection:
             lib.its_conn_destroy(self._handle)
             self._handle = None
             self._shm_bufs.clear()  # views are dead once the segment unmaps
+            self._plain_mrs.clear()
             self.rdma_connected = False
             self.tcp_connected = False
+        for h in self._dead_handles:  # parked by reconnect(); see __init__
+            lib.its_conn_destroy(h)
+        self._dead_handles.clear()
+        self._dead_shm_ranges.clear()
 
     # reference name (lib.py:380)
     close_connection = close
 
+    @property
+    def is_connected(self) -> bool:
+        """Liveness as the native reactor sees it: False once the socket
+        died or fail_all ran, even if close() was never called."""
+        return self._handle is not None and lib.its_conn_connected(self._handle) == 1
+
+    def reconnect(self):
+        """Tear down and re-establish the connection, re-registering every
+        plain memory region (register_mr) on the new one.
+
+        alloc_shm_mr views do NOT survive: their segments die with the old
+        connection, and touching an old view afterwards is undefined —
+        reallocate them (a retried sync op whose buffer lived there gets a
+        typed error instead). A restarted server comes back EMPTY (the
+        store is a cache, reference kv_map is in-RAM only): after
+        reconnect, misses mean recompute, exactly like a cold cache.
+
+        A FAILED reconnect (server still down) leaves the connection
+        retryable: the MR list is preserved and the next call (or
+        auto-reconnect attempt) tries again. Safe to race from several
+        threads — one performs the reconnect, the rest see it done — but a
+        thread still blocked inside a native op keeps the OLD handle: that
+        handle is closed here (its ops fail out) yet destroyed only at
+        close(), so it is never freed under a live call."""
+        with self._lock:
+            if self.is_connected:
+                return  # another thread already reconnected
+            mrs = list(self._plain_mrs)
+            if self._handle is not None:
+                self._dead_shm_ranges += [
+                    (b.ctypes.data, b.nbytes) for b in self._shm_bufs
+                ]
+                lib.its_conn_close(self._handle)
+                self._dead_handles.append(self._handle)
+                self._handle = None
+                self._shm_bufs.clear()
+                self._plain_mrs.clear()
+                self.rdma_connected = False
+                self.tcp_connected = False
+            try:
+                self.connect()
+                for ptr, nbytes in mrs:
+                    self.register_mr(ptr, nbytes)
+            except BaseException:
+                # Keep the MR list so the NEXT attempt re-registers them;
+                # the connection stays in a retryable state.
+                self._plain_mrs = list(mrs)
+                raise
+
     def _require(self):
         if self._handle is None:
             raise InfiniStoreException("not connected")
+
+    def _in_dead_shm(self, ptr: int) -> bool:
+        return any(base <= ptr < base + n for base, n in self._dead_shm_ranges)
+
+    def _prune_dead_shm(self, ptr: int, nbytes: int):
+        """A new mapping/registration can legitimately land at a recycled
+        address — ranges it covers are no longer 'dead'."""
+        self._dead_shm_ranges = [
+            (b, n) for b, n in self._dead_shm_ranges
+            if b + n <= ptr or ptr + nbytes <= b
+        ]
 
     # -- memory registration ------------------------------------------------
 
@@ -224,6 +354,8 @@ class InfinityConnection:
         ret = lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes)
         if ret < 0:
             raise InfiniStoreException("register memory region failed")
+        self._plain_mrs.append((ptr, nbytes))
+        self._prune_dead_shm(ptr, nbytes)
         return ret
 
     def unregister_mr(self, arg: Union[int, np.ndarray]):
@@ -236,6 +368,10 @@ class InfinityConnection:
             raise InfiniStoreException(
                 f"unregister_mr: no region registered at base 0x{ptr:x}"
             )
+        for i, (p, _) in enumerate(self._plain_mrs):
+            if p == ptr:
+                del self._plain_mrs[i]
+                break
 
     def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
         """Allocate a staging buffer the server maps too (one-RTT data plane:
@@ -251,6 +387,7 @@ class InfinityConnection:
             return None
         buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
         arr = np.frombuffer(buf, dtype=np.uint8)
+        self._prune_dead_shm(ptr, nbytes)
         # ndarrays forbid new attributes, so anchor the view on the connection
         # instead; the mapping lives until close() anyway.
         self._shm_bufs.append(arr)
@@ -359,6 +496,7 @@ class InfinityConnection:
             raise InfiniStoreKeyNotFound(f"{op_name}: key not found")
         raise InfiniStoreException(f"{op_name} failed: status={-rc}")
 
+    @_reconnecting(ptr_arg=2)
     def write_cache(self, blocks: List[Tuple[str, int]], block_size: int, ptr: int):
         """Blocking batched block write; the calling thread waits on the
         native completion directly (no event-loop hop). ~3x lower p50 than
@@ -377,6 +515,7 @@ class InfinityConnection:
             lib.its_conn_put_batch_sync, blocks, block_size, ptr, "write_cache"
         )
 
+    @_reconnecting(ptr_arg=2)
     def read_cache(self, blocks: List[Tuple[str, int]], block_size: int, ptr: int):
         """Blocking batched block read (see write_cache for latency/timeout
         semantics — on timeout the late payload is drained, never written
@@ -388,6 +527,7 @@ class InfinityConnection:
 
     # -- single-key TCP path -------------------------------------------------
 
+    @_reconnecting(ptr_arg=1)
     def tcp_write_cache(self, key: str, ptr: int, size: int, **kwargs):
         """Blocking single-key put from a raw pointer (reference lib.py:399)."""
         self._require()
@@ -396,6 +536,7 @@ class InfinityConnection:
             raise InfiniStoreException(f"tcp_write_cache failed: status={-rc}")
         return wire.STATUS_OK
 
+    @_reconnecting()
     def tcp_read_cache(self, key: str, **kwargs) -> np.ndarray:
         """Blocking single-key get; zero-copy numpy view over the native
         buffer (the reference zero-copies via a pybind capsule,
@@ -421,6 +562,7 @@ class InfinityConnection:
 
     # -- control ops ---------------------------------------------------------
 
+    @_reconnecting()
     def check_exist(self, key: str) -> bool:
         """True if the key is committed on the server (reference lib.py:544)."""
         self._require()
@@ -429,6 +571,7 @@ class InfinityConnection:
             raise InfiniStoreException(f"check_exist failed: status={-rc}")
         return rc == 1
 
+    @_reconnecting()
     def get_match_last_index(self, keys: List[str]) -> int:
         """Longest-prefix match index over a key chain (reference lib.py:562;
         server does binary search under the prefix property, SURVEY.md §3.6)."""
@@ -441,6 +584,7 @@ class InfinityConnection:
             raise InfiniStoreNoMatch("can't find a match")
         return idx
 
+    @_reconnecting()
     def delete_keys(self, keys: List[str]) -> int:
         """Delete keys; returns how many were present (reference lib.py:618)."""
         self._require()
@@ -452,6 +596,7 @@ class InfinityConnection:
             )
         return int(ret)
 
+    @_reconnecting()
     def get_stats(self) -> dict:
         """Server-side per-op latency/throughput counters — first-class
         observability the reference lacks (SURVEY.md §5.1)."""

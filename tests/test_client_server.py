@@ -312,6 +312,120 @@ def test_sync_ops_time_out_on_hung_server():
         s.close()
 
 
+def test_auto_reconnect_after_server_restart():
+    """Opt-in recovery (the reference has none, SURVEY §5.3): when the store
+    restarts, blocking ops on an auto_reconnect connection transparently
+    reconnect + retry once, re-registering plain MRs; the restarted store
+    looks like a COLD CACHE (keys gone), never a dead engine."""
+    import time
+
+    srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=16 << 10)
+    port = srv.port
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=port, log_level="error",
+            enable_shm=False, auto_reconnect=True,
+        )
+    )
+    c.connect()
+    block = 16 << 10
+    buf = np.random.randint(0, 256, size=2 * block, dtype=np.uint8)
+    c.register_mr(buf)
+    c.write_cache([("ar-a", 0), ("ar-b", block)], block, buf.ctypes.data)
+    assert c.check_exist("ar-a") is True
+
+    srv.stop()
+    # Rebind the SAME port so reconnect finds the restarted server.
+    for _ in range(20):
+        try:
+            srv2 = its.start_local_server(
+                host="127.0.0.1", service_port=port,
+                prealloc_bytes=32 << 20, block_bytes=16 << 10,
+            )
+            break
+        except its.InfiniStoreException:
+            time.sleep(0.1)
+    else:
+        pytest.skip("could not rebind the port for the restarted server")
+
+    # First op after the restart: the dead connection is detected, the
+    # client reconnects, and the restarted store reports a cold cache.
+    assert c.check_exist("ar-a") is False
+    assert c.is_connected
+    # Plain MRs were re-registered: batched ops work without user action.
+    buf2 = np.zeros_like(buf)
+    c.register_mr(buf2)
+    c.write_cache([("ar2-a", 0), ("ar2-b", block)], block, buf.ctypes.data)
+    c.read_cache([("ar2-a", 0), ("ar2-b", block)], block, buf2.ctypes.data)
+    assert np.array_equal(buf, buf2)
+    c.close()
+    srv2.stop()
+
+
+def test_failed_reconnect_stays_retryable():
+    """A reconnect attempt while the server is STILL down must not brick
+    the connection: once the server returns, the next op recovers and the
+    MR list is intact (re-registered on the successful attempt)."""
+    import time
+
+    srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=16 << 10)
+    port = srv.port
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=port, log_level="error",
+            enable_shm=False, auto_reconnect=True, connect_timeout_ms=300,
+        )
+    )
+    c.connect()
+    block = 16 << 10
+    buf = np.random.randint(0, 256, size=block, dtype=np.uint8)
+    c.register_mr(buf)
+    c.write_cache([("fr-a", 0)], block, buf.ctypes.data)
+    srv.stop()
+
+    # Server down: the auto-reconnect attempt itself fails and surfaces.
+    with pytest.raises(its.InfiniStoreException):
+        for _ in range(10):
+            c.check_exist("fr-a")
+    assert not c.is_connected
+
+    # Server returns on the same port: the connection must recover, with
+    # the registered MR usable again.
+    for _ in range(20):
+        try:
+            srv2 = its.start_local_server(
+                host="127.0.0.1", service_port=port,
+                prealloc_bytes=32 << 20, block_bytes=16 << 10,
+            )
+            break
+        except its.InfiniStoreException:
+            time.sleep(0.1)
+    else:
+        pytest.skip("could not rebind the port for the restarted server")
+    assert c.check_exist("fr-a") is False  # cold cache
+    c.write_cache([("fr-b", 0)], block, buf.ctypes.data)  # MR re-registered
+    assert c.check_exist("fr-b") is True
+    c.close()
+    srv2.stop()
+
+
+def test_dead_connection_without_auto_reconnect_raises():
+    """Default behavior unchanged: no auto_reconnect -> the op raises."""
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=srv.port, log_level="error",
+            enable_shm=False,
+        )
+    )
+    c.connect()
+    srv.stop()
+    with pytest.raises(its.InfiniStoreException):
+        for _ in range(10):  # first op may still squeak through a socket buffer
+            c.check_exist("x")
+    c.close()
+
+
 def test_abandoned_sync_read_never_touches_buffer():
     """A sync get that times out must NEVER scatter a late server response
     into the caller's buffer — the caller may free it after catching the
