@@ -43,6 +43,13 @@ def parse_args(argv=None):
         help="connection stripes for batched ops (cross-host DCN scaling; "
              "see docs/multistream.md)",
     )
+    p.add_argument(
+        "--pacing-mbps", type=int, default=0,
+        help="cap each connection's egress in MB/s (SO_MAX_PACING_RATE); "
+             "implies the socket path (shm off — a same-host memcpy would "
+             "bypass the cap). Emulates a bandwidth-limited cross-host "
+             "stream; see tools/striping_emulation.py",
+    )
     return p.parse_args(argv)
 
 
@@ -67,9 +74,19 @@ def _measure_latency(conn, samples: int = 200) -> dict:
             return lats
 
         lats = sorted(asyncio.run(sample()))
+        # Sync path (read_cache): the low-latency API — the calling thread
+        # blocks on the native completion, skipping the asyncio hop.
+        sync_lats = []
+        for _ in range(samples):
+            t0 = time.perf_counter()
+            conn.read_cache([(key, 0)], size, dst.ctypes.data)
+            sync_lats.append((time.perf_counter() - t0) * 1e6)
+        sync_lats.sort()
         out[f"fetch_{size >> 10}kb"] = {
             "p50_us": round(lats[len(lats) // 2], 1),
             "p99_us": round(lats[int(len(lats) * 0.99)], 1),
+            "sync_p50_us": round(sync_lats[len(sync_lats) // 2], 1),
+            "sync_p99_us": round(sync_lats[int(len(sync_lats) * 0.99)], 1),
         }
         conn.delete_keys([key])
     return out
@@ -102,6 +119,10 @@ def run(args) -> dict:
         service_port=args.service_port,
         connection_type=TYPE_RDMA if args.type == "rdma" else TYPE_TCP,
         log_level="warning",
+        pacing_rate_mbps=args.pacing_mbps,
+        # Pacing shapes SOCKET egress; the same-host shm fast path moves
+        # payloads by memcpy and would silently bypass the cap.
+        enable_shm=args.pacing_mbps == 0,
     )
     if args.streams > 1:
         conn = StripedConnection(cfg, streams=args.streams)
