@@ -312,6 +312,46 @@ def test_sync_ops_time_out_on_hung_server():
         s.close()
 
 
+def test_sync_ops_from_many_threads():
+    """The sync data plane is documented as callable from any thread (the
+    ctypes call releases the GIL): hammer one connection from 8 threads
+    with interleaved sync puts/gets on disjoint buffers and verify every
+    byte. Guards the reactor's promise-based completion path against
+    cross-thread mixups (FIFO matching is per-connection)."""
+    import threading
+
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    block = 16 << 10
+    errors = []
+
+    def worker(tid):
+        try:
+            src = np.full(block, (tid * 37) % 251, dtype=np.uint8)
+            dst = np.zeros_like(src)
+            c.register_mr(src)
+            c.register_mr(dst)
+            for i in range(25):
+                key = f"mt-{tid}-{i}"
+                c.write_cache([(key, 0)], block, src.ctypes.data)
+                c.read_cache([(key, 0)], block, dst.ctypes.data)
+                assert np.array_equal(src, dst), f"thread {tid} iter {i} mismatch"
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    c.close()
+    srv.stop()
+
+
 def test_auto_reconnect_after_server_restart():
     """Opt-in recovery (the reference has none, SURVEY §5.3): when the store
     restarts, blocking ops on an auto_reconnect connection transparently
