@@ -70,7 +70,7 @@ lib.its_log.argtypes = [c_int, c_char_p]
 # ---- server ----
 lib.its_server_create.argtypes = [
     c_char_p, c_int, c_uint64, c_uint64, c_int, c_uint64, c_int, c_double, c_double, c_int,
-    c_int,
+    c_int, c_char_p, c_uint64,
 ]
 lib.its_server_create.restype = c_void_p
 lib.its_server_start.argtypes = [c_void_p]

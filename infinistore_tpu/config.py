@@ -104,6 +104,13 @@ class ServerConfig:
     # Egress cap per accepted connection in MB/s (SO_MAX_PACING_RATE). Caps
     # the server->client GET direction; 0 = unlimited.
     pacing_rate_mbps: int = 0
+    # File-backed spill tier: evicted blocks demote to an mmap'd (and
+    # immediately unlinked — crash-safe) file under spill_dir instead of
+    # being dropped, and promote back to RAM on access. Capacity beyond RAM
+    # — the tier the reference only aspired to (its design.rst:36). Empty
+    # dir or 0 size = off (evict drops, reference behavior).
+    spill_dir: str = ""
+    spill_size: int = 0  # GB
     # Reference-compat knobs, advisory on TPU:
     dev_name: str = ""
     ib_port: int = 1
@@ -144,3 +151,7 @@ class ServerConfig:
     @property
     def extend_bytes(self) -> int:
         return self.extend_size << 30
+
+    @property
+    def spill_bytes(self) -> int:
+        return self.spill_size << 30

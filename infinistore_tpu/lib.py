@@ -798,6 +798,8 @@ def register_server(loop, config: ServerConfig):
             config.on_demand_evict_max,
             1 if config.enable_shm else 0,
             config.pacing_rate_mbps,
+            config.spill_dir.encode(),
+            config.spill_bytes,
         )
         if not handle:
             raise InfiniStoreException("failed to create server (allocation failed?)")
@@ -839,6 +841,8 @@ def start_local_server(
     evict_max: float = 0.95,
     enable_shm: bool = True,
     pacing_rate_mbps: int = 0,
+    spill_dir: str = "",
+    spill_bytes: int = 0,
 ):
     """Start an anonymous in-process server; returns a ``LocalServer``.
 
@@ -860,6 +864,8 @@ def start_local_server(
         evict_max,
         1 if enable_shm else 0,
         pacing_rate_mbps,
+        spill_dir.encode(),
+        spill_bytes,
     )
     if not handle:
         raise InfiniStoreException("failed to create server (allocation failed?)")

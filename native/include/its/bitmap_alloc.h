@@ -1,0 +1,80 @@
+// First-fit contiguous-run bitmap allocator, shared by the RAM pools
+// (mempool.cpp) and the spill file (spillfile.cpp) — one implementation so
+// an allocator fix lands in both tiers at once.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+namespace its {
+
+struct BitmapAlloc {
+    std::vector<uint64_t> bits;  // 1 = used
+    size_t total = 0;
+    size_t used = 0;
+
+    void init(size_t nblocks) {
+        total = nblocks;
+        used = 0;
+        bits.assign((nblocks + 63) / 64, 0);
+    }
+
+    bool is_used(size_t i) const { return (bits[i / 64] >> (i % 64)) & 1; }
+
+    // First-fit scan. Fast path: skip fully-used words, find the first zero
+    // bit with ctz (reference uses ctz the same way,
+    // /root/reference/src/mempool.cpp:55-112), then verify run length.
+    size_t find_free_run(size_t nblocks) const {
+        size_t idx = 0;
+        while (idx < total) {
+            size_t word = idx / 64;
+            if (bits[word] == ~0ull) {
+                idx = (word + 1) * 64;
+                continue;
+            }
+            uint64_t inv = ~bits[word] & (~0ull << (idx % 64));
+            if (inv == 0) {
+                idx = (word + 1) * 64;
+                continue;
+            }
+            size_t start = word * 64 + static_cast<size_t>(__builtin_ctzll(inv));
+            if (start >= total) break;
+            size_t run = 0;
+            while (run < nblocks && start + run < total) {
+                if (is_used(start + run)) break;
+                run++;
+            }
+            if (run == nblocks) return start;
+            idx = start + run + 1;
+        }
+        return SIZE_MAX;
+    }
+
+    void mark(size_t first, size_t nblocks, bool set_used) {
+        for (size_t i = first; i < first + nblocks; i++) {
+            uint64_t bit = 1ull << (i % 64);
+            if (set_used) {
+                bits[i / 64] |= bit;
+            } else {
+                bits[i / 64] &= ~bit;
+            }
+        }
+    }
+
+    // Returns the first block of an allocated run, or SIZE_MAX.
+    size_t alloc_run(size_t nblocks) {
+        size_t start = find_free_run(nblocks);
+        if (start == SIZE_MAX) return SIZE_MAX;
+        mark(start, nblocks, true);
+        used += nblocks;
+        return start;
+    }
+
+    void free_run(size_t first, size_t nblocks) {
+        mark(first, nblocks, false);
+        used -= nblocks;
+    }
+};
+
+}  // namespace its

@@ -16,6 +16,7 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <functional>
 #include <list>
 #include <memory>
 #include <string>
@@ -23,6 +24,7 @@
 #include <vector>
 
 #include "its/mempool.h"
+#include "its/spillfile.h"
 
 namespace its {
 
@@ -49,16 +51,25 @@ using BlockRef = std::shared_ptr<Block>;
 
 class KVStore {
   public:
-    explicit KVStore(MM* mm) : mm_(mm) {}
+    // spill: optional file-backed tier (spillfile.h). With it, eviction
+    // demotes LRU entries to the file instead of dropping them — capacity
+    // beyond RAM, the tier the reference only aspired to
+    // (reference docs/source/design.rst:36) — and get() promotes them back
+    // into a RAM pool on access. nullptr (or !spill->ok()) = off: eviction
+    // drops, exactly the reference's behavior.
+    explicit KVStore(MM* mm, SpillFile* spill = nullptr)
+        : mm_(mm), spill_(spill != nullptr && spill->ok() ? spill : nullptr) {}
+    ~KVStore() { purge(); }
 
     // Insert/overwrite. Called only after the payload transfer completed —
     // commit-on-completion, no partially-visible keys (SURVEY.md §3.3).
     void commit(const std::string& key, BlockRef block);
 
-    // Lookup + LRU touch. Returns nullptr when missing.
+    // Lookup + LRU touch. Returns nullptr when missing — AND, with a spill
+    // tier, when a spilled entry cannot be promoted back into RAM (the
+    // entry is then dropped; callers must treat present-then-null as a
+    // miss, not an invariant violation).
     BlockRef get(const std::string& key);
-    // Lookup without touching the LRU.
-    BlockRef peek(const std::string& key) const;
     bool exists(const std::string& key) const;
 
     // Remove listed keys; returns how many were present.
@@ -75,18 +86,48 @@ class KVStore {
 
     // If pool usage >= max_ratio, evict LRU entries until usage <= min_ratio
     // (reference evict_cache, /root/reference/src/infinistore.cpp:223).
-    // Returns evicted entry count.
+    // With a spill tier, "evict" means demote-to-file; only when the file is
+    // also full are the oldest spilled entries dropped for real.
+    // Returns the number of entries demoted or dropped.
     size_t evict(double min_ratio, double max_ratio);
+
+    // Promotion RAM allocator override: the server routes this through its
+    // configured policy (on-demand evict ratios + auto_increase pool
+    // extension), so promotion behaves exactly like any other allocation.
+    // Unset = allocate from MM with a conservative evict-and-retry.
+    using RamAlloc = std::function<bool(size_t, std::vector<Lease>*)>;
+    void set_promote_alloc(RamAlloc fn) { promote_alloc_ = std::move(fn); }
+
+    // Spill-tier observability (all zero when the tier is off).
+    size_t spilled_entries() const { return spill_lru_.size(); }
+    size_t spilled_bytes() const { return spill_ != nullptr ? spill_->used_bytes() : 0; }
+    size_t spill_capacity() const { return spill_ != nullptr ? spill_->total_bytes() : 0; }
+    uint64_t spill_promotions() const { return promotions_; }
+    uint64_t spill_drops() const { return spill_drops_; }
 
   private:
     struct Entry {
-        BlockRef block;
-        std::list<std::string>::iterator lru_it;
+        BlockRef block;                  // set when resident in RAM
+        int64_t spill_off = -1;          // set when demoted to the file
+        uint32_t spill_size = 0;
+        std::list<std::string>::iterator lru_it;  // in lru_ or spill_lru_
+        bool spilled() const { return block == nullptr && spill_off >= 0; }
     };
 
+    void release_entry(Entry& e);  // frees the spill slot if any
+    bool demote(const std::string& key, Entry& e);
+    BlockRef promote(const std::string& key,
+                     std::unordered_map<std::string, Entry>::iterator it);
+    bool drop_oldest_spilled();
+
     MM* mm_;
+    SpillFile* spill_;
+    RamAlloc promote_alloc_;
     std::unordered_map<std::string, Entry> map_;
-    std::list<std::string> lru_;  // front = most recently used
+    std::list<std::string> lru_;        // RAM-resident entries; front = MRU
+    std::list<std::string> spill_lru_;  // spilled entries; front = MRU
+    uint64_t promotions_ = 0;
+    uint64_t spill_drops_ = 0;
 };
 
 }  // namespace its

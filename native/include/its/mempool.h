@@ -19,6 +19,8 @@
 #include <string>
 #include <vector>
 
+#include "its/bitmap_alloc.h"
+
 namespace its {
 
 // Reference constants (/root/reference/src/mempool.h:11-13).
@@ -54,8 +56,8 @@ class MemoryPool {
     }
 
     size_t block_size() const { return block_size_; }
-    size_t total_blocks() const { return total_blocks_; }
-    size_t used_blocks() const { return used_blocks_; }
+    size_t total_blocks() const { return alloc_.total; }
+    size_t used_blocks() const { return alloc_.used; }
     void* base() const { return base_; }
     size_t size() const { return pool_size_; }
     bool pinned() const { return pinned_; }
@@ -63,19 +65,14 @@ class MemoryPool {
     const std::string& shm_name() const { return shm_name_; }
 
   private:
-    size_t find_free_run(size_t nblocks);
-    void mark(size_t first_block, size_t nblocks, bool used);
-
     char* base_ = nullptr;
     size_t pool_size_;
     size_t block_size_;
-    size_t total_blocks_;
-    size_t used_blocks_ = 0;
     bool pinned_ = false;
     bool shm_backed_ = false;
     int shm_fd_ = -1;  // kept open: holds the liveness flock for sweep
     std::string shm_name_;
-    std::vector<uint64_t> bitmap_;  // 1 = used
+    BitmapAlloc alloc_;  // shared first-fit bitmap (bitmap_alloc.h)
 };
 
 // A (pool, ptr, size) lease. Deallocation goes back to the owning pool.

@@ -56,6 +56,12 @@ struct ServerConfig {
     // (caps the server->client GET direction; the client-side knob caps
     // PUTs). 0 = unlimited. See ClientConfig::pacing_rate_mbps.
     uint32_t pacing_rate_mbps = 0;
+    // File-backed spill tier (spillfile.h): evicted blocks demote to an
+    // mmap'd file in spill_dir instead of being dropped, and promote back
+    // on access — capacity beyond RAM. Empty dir or 0 bytes = off (evict
+    // drops, the reference's behavior).
+    std::string spill_dir;
+    size_t spill_bytes = 0;
 };
 
 // Per-op service counters (SURVEY.md §5.1: the reference has no tracing at
@@ -126,6 +132,7 @@ class Server {
 
     ServerConfig config_;
     std::unique_ptr<MM> mm_;
+    std::unique_ptr<SpillFile> spill_;  // may be null (tier off)
     std::unique_ptr<KVStore> kv_;
 
     int epoll_fd_ = -1;

@@ -1,0 +1,50 @@
+// File-backed spill tier for evicted KV blocks.
+//
+// The reference aspires to an SSD tier but never built one (reference
+// docs/source/design.rst:36 "SSD" is listed as a future pool; kv_map is
+// in-RAM only). This is that tier: a single mmap'd file carved into
+// block-granular slots by the same first-fit bitmap discipline as the RAM
+// pools (mempool.h). Eviction memcpys a block's bytes into a slot instead of
+// dropping them; a later get() promotes the bytes back into a RAM pool. All
+// I/O rides the page cache (mmap MAP_SHARED), so spills are memcpy-speed and
+// the kernel writes back lazily; the file is unlinked at open, so any crash
+// (including SIGKILL) reclaims the space with zero cleanup code.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "its/bitmap_alloc.h"
+
+namespace its {
+
+class SpillFile {
+  public:
+    // Creates <dir>/its-spill-<pid>-<seq>.dat of `bytes` (rounded down to a
+    // block multiple), mmaps it, and unlinks it immediately. ok() is false
+    // (and the tier disabled) when the directory is unwritable or the
+    // mapping fails.
+    SpillFile(const std::string& dir, size_t bytes, size_t block_size);
+    ~SpillFile();
+    SpillFile(const SpillFile&) = delete;
+    SpillFile& operator=(const SpillFile&) = delete;
+
+    bool ok() const { return base_ != nullptr; }
+
+    // Allocate ceil(size/block_size) contiguous blocks; returns the byte
+    // offset, or -1 when no run is free.
+    int64_t alloc(size_t size);
+    void free_slot(int64_t offset, size_t size);
+
+    char* data(int64_t offset) const { return base_ + offset; }
+    size_t total_bytes() const { return alloc_.total * block_size_; }
+    size_t used_bytes() const { return alloc_.used * block_size_; }
+
+  private:
+    char* base_ = nullptr;
+    size_t block_size_ = 0;
+    BitmapAlloc alloc_;
+};
+
+}  // namespace its

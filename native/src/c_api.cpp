@@ -75,7 +75,8 @@ void its_log(int level, const char* msg) {
 void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes,
                         uint64_t block_bytes, int auto_increase, uint64_t extend_bytes,
                         int pin, double evict_min, double evict_max, int enable_shm,
-                        int pacing_rate_mbps) {
+                        int pacing_rate_mbps, const char* spill_dir,
+                        uint64_t spill_bytes) {
     ServerConfig cfg;
     cfg.bind_addr = bind_addr;
     cfg.service_port = port;
@@ -88,6 +89,8 @@ void* its_server_create(const char* bind_addr, int port, uint64_t prealloc_bytes
     cfg.evict_max_ratio = evict_max;
     cfg.enable_shm = enable_shm != 0;
     cfg.pacing_rate_mbps = pacing_rate_mbps > 0 ? static_cast<uint32_t>(pacing_rate_mbps) : 0;
+    cfg.spill_dir = spill_dir != nullptr ? spill_dir : "";
+    cfg.spill_bytes = spill_bytes;
     try {
         return new Server(cfg);
     } catch (const std::exception& e) {

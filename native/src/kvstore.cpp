@@ -1,36 +1,119 @@
 #include "its/kvstore.h"
 
+#include <cstring>
+
 #include "its/log.h"
 
 namespace its {
 
+void KVStore::release_entry(Entry& e) {
+    if (e.spilled()) spill_->free_slot(e.spill_off, e.spill_size);
+    e.spill_off = -1;
+}
+
 void KVStore::commit(const std::string& key, BlockRef block) {
     auto it = map_.find(key);
     if (it != map_.end()) {
-        // Overwrite: replace the block in place and touch. The old block is
-        // freed once in-flight readers release it.
-        lru_.erase(it->second.lru_it);
+        // Overwrite: replace in place and touch. The old RAM block is freed
+        // once in-flight readers release it; an old spill slot is freed now.
+        Entry& e = it->second;
+        (e.spilled() ? spill_lru_ : lru_).erase(e.lru_it);
+        release_entry(e);
         lru_.push_front(key);
-        it->second.block = std::move(block);
-        it->second.lru_it = lru_.begin();
+        e.block = std::move(block);
+        e.lru_it = lru_.begin();
         return;
     }
     lru_.push_front(key);
-    map_.emplace(key, Entry{std::move(block), lru_.begin()});
+    map_.emplace(key, Entry{std::move(block), -1, 0, lru_.begin()});
+}
+
+// Demote the entry's bytes into the spill file; true on success. Frees the
+// RAM block (modulo in-flight readers holding the BlockRef).
+bool KVStore::demote(const std::string& key, Entry& e) {
+    size_t size = e.block->size();
+    // An entry larger than the whole spill file can never fit — bail BEFORE
+    // the drop loop, or one oversized cold value would drain every spilled
+    // entry (mass data loss) and still fail.
+    if (size > spill_->total_bytes()) return false;
+    int64_t off = spill_->alloc(size);
+    while (off < 0 && drop_oldest_spilled()) off = spill_->alloc(size);
+    if (off < 0) return false;
+    memcpy(spill_->data(off), e.block->data(), size);
+    e.block.reset();
+    e.spill_off = off;
+    e.spill_size = static_cast<uint32_t>(size);
+    spill_lru_.push_front(key);
+    e.lru_it = spill_lru_.begin();
+    return true;
+}
+
+// Drop the coldest spilled entry for real. Returns false when none exist.
+bool KVStore::drop_oldest_spilled() {
+    if (spill_lru_.empty()) return false;
+    const std::string victim = spill_lru_.back();
+    auto it = map_.find(victim);
+    if (it != map_.end()) {
+        release_entry(it->second);
+        map_.erase(it);
+    }
+    spill_lru_.pop_back();
+    spill_drops_++;
+    return true;
+}
+
+// Bring a spilled entry back into a RAM pool. Owns the entry's full
+// lifecycle: on success it is re-linked into the RAM LRU; on failure (RAM
+// unobtainable even after demoting colder entries) it is ERASED and nullptr
+// returned — a miss, cache semantics: recompute beats blocking the reactor.
+BlockRef KVStore::promote(const std::string& key,
+                          std::unordered_map<std::string, Entry>::iterator it) {
+    Entry& e = it->second;
+    // Detach from the spill LRU FIRST: the eviction below may demote other
+    // entries and, if the file fills, drop the oldest spilled — which must
+    // never be able to select (and erase) the entry we are promoting.
+    spill_lru_.erase(e.lru_it);
+    size_t size = e.spill_size;
+    std::vector<Lease> leases;
+    bool got;
+    if (promote_alloc_) {
+        // The server's configured allocation policy (evict ratios +
+        // auto_increase extension) — promotion behaves like any other
+        // allocation.
+        got = promote_alloc_(size, &leases);
+    } else {
+        auto no_op = [](void*, size_t) {};
+        got = mm_->allocate(size, 1, no_op, &leases);
+        if (!got) {
+            evict(0.8, 0.0);  // conservative fallback: demote colder entries
+            got = mm_->allocate(size, 1, no_op, &leases);
+        }
+    }
+    if (!got) {
+        ITS_LOG_WARN("spill: cannot promote %zu bytes (RAM exhausted)", size);
+        release_entry(e);
+        map_.erase(it);
+        return nullptr;
+    }
+    auto block = std::make_shared<Block>(mm_, leases[0].ptr, size);
+    memcpy(block->data(), spill_->data(e.spill_off), size);
+    release_entry(e);
+    e.block = block;
+    lru_.push_front(key);
+    e.lru_it = lru_.begin();
+    promotions_++;
+    return block;
 }
 
 BlockRef KVStore::get(const std::string& key) {
     auto it = map_.find(key);
     if (it == map_.end()) return nullptr;
-    lru_.erase(it->second.lru_it);
+    Entry& e = it->second;
+    if (e.spilled()) return promote(key, it);
+    lru_.erase(e.lru_it);
     lru_.push_front(key);
-    it->second.lru_it = lru_.begin();
-    return it->second.block;
-}
-
-BlockRef KVStore::peek(const std::string& key) const {
-    auto it = map_.find(key);
-    return it == map_.end() ? nullptr : it->second.block;
+    e.lru_it = lru_.begin();
+    return e.block;
 }
 
 bool KVStore::exists(const std::string& key) const { return map_.count(key) != 0; }
@@ -40,7 +123,9 @@ size_t KVStore::remove(const std::vector<std::string>& keys) {
     for (const auto& key : keys) {
         auto it = map_.find(key);
         if (it == map_.end()) continue;
-        lru_.erase(it->second.lru_it);
+        Entry& e = it->second;
+        (e.spilled() ? spill_lru_ : lru_).erase(e.lru_it);
+        release_entry(e);
         map_.erase(it);
         removed++;
     }
@@ -49,15 +134,18 @@ size_t KVStore::remove(const std::vector<std::string>& keys) {
 
 size_t KVStore::purge() {
     size_t n = map_.size();
+    for (auto& [key, e] : map_) release_entry(e);
     map_.clear();
     lru_.clear();
+    spill_lru_.clear();
     return n;
 }
 
 int32_t KVStore::match_last_index(const std::vector<std::string>& keys) const {
     // Binary search is only correct under the prefix property; this matches
     // the reference's behavior exactly, including on inputs that violate it
-    // (test_infinistore.py:291-311 relies on that).
+    // (test_infinistore.py:291-311 relies on that). Spilled entries count as
+    // present — no promotion on a control op.
     size_t lo = 0, hi = keys.size();
     while (lo < hi) {
         size_t mid = lo + (hi - lo) / 2;
@@ -74,14 +162,23 @@ size_t KVStore::evict(double min_ratio, double max_ratio) {
     if (mm_->usage() < max_ratio) return 0;
     size_t evicted = 0;
     while (mm_->usage() > min_ratio && !lru_.empty()) {
-        const std::string& victim = lru_.back();
+        const std::string victim = lru_.back();
+        lru_.pop_back();
         auto it = map_.find(victim);
         // The LRU and map are kept in lockstep; a miss here is a logic bug.
-        if (it != map_.end()) map_.erase(it);
-        lru_.pop_back();
+        if (it == map_.end()) continue;
+        if (spill_ != nullptr && demote(victim, it->second)) {
+            evicted++;
+            continue;
+        }
+        release_entry(it->second);
+        map_.erase(it);
         evicted++;
     }
-    if (evicted > 0) ITS_LOG_INFO("evicted %zu entries, usage now %.2f", evicted, mm_->usage());
+    if (evicted > 0) {
+        ITS_LOG_INFO("evicted %zu entries (%zu now spilled), usage %.2f", evicted,
+                     spill_lru_.size(), mm_->usage());
+    }
     return evicted;
 }
 

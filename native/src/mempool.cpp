@@ -87,7 +87,7 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
     if (!is_pow2(block_size)) throw std::invalid_argument("block_size must be a power of two");
     if (pool_size == 0 || pool_size % block_size != 0)
         throw std::invalid_argument("pool_size must be a positive multiple of block_size");
-    total_blocks_ = pool_size / block_size;
+    alloc_.init(pool_size / block_size);
 
     if (!shm_name.empty()) {
         int err = 0;
@@ -145,9 +145,8 @@ MemoryPool::MemoryPool(size_t pool_size, size_t block_size, bool pin,
             ITS_LOG_WARN("mlock(%zu bytes) failed; pool is unpinned", pool_size_);
         }
     }
-    bitmap_.assign((total_blocks_ + 63) / 64, 0);
     ITS_LOG_INFO("mempool: %zu MB, block %zu KB, %zu blocks, pinned=%d",
-                 pool_size_ >> 20, block_size_ >> 10, total_blocks_, (int)pinned_);
+                 pool_size_ >> 20, block_size_ >> 10, alloc_.total, (int)pinned_);
 }
 
 MemoryPool::~MemoryPool() {
@@ -164,55 +163,13 @@ MemoryPool::~MemoryPool() {
     }
 }
 
-size_t MemoryPool::find_free_run(size_t nblocks) {
-    // First-fit scan. Fast path: skip fully-used words, find the first zero
-    // bit with ffsll (reference uses ctz the same way,
-    // /root/reference/src/mempool.cpp:55-112), then verify run length.
-    size_t idx = 0;
-    while (idx < total_blocks_) {
-        size_t word = idx / 64;
-        if (bitmap_[word] == ~0ull) {
-            idx = (word + 1) * 64;
-            continue;
-        }
-        uint64_t inv = ~bitmap_[word] & (~0ull << (idx % 64));
-        if (inv == 0) {
-            idx = (word + 1) * 64;
-            continue;
-        }
-        size_t start = word * 64 + static_cast<size_t>(__builtin_ctzll(inv));
-        if (start >= total_blocks_) break;
-        // Check the run [start, start+nblocks).
-        size_t run = 0;
-        while (run < nblocks && start + run < total_blocks_) {
-            size_t b = start + run;
-            if (bitmap_[b / 64] & (1ull << (b % 64))) break;
-            run++;
-        }
-        if (run == nblocks) return start;
-        idx = start + run + 1;
-    }
-    return SIZE_MAX;
-}
-
-void MemoryPool::mark(size_t first_block, size_t nblocks, bool used) {
-    for (size_t i = first_block; i < first_block + nblocks; i++) {
-        uint64_t bit = 1ull << (i % 64);
-        if (used) {
-            bitmap_[i / 64] |= bit;
-        } else {
-            bitmap_[i / 64] &= ~bit;
-        }
-    }
-}
-
+// The first-fit run scan itself lives in bitmap_alloc.h, shared with the
+// spill tier (one allocator, two backing stores).
 void* MemoryPool::allocate(size_t size) {
     if (size == 0) return nullptr;
     size_t nblocks = (size + block_size_ - 1) / block_size_;
-    size_t start = find_free_run(nblocks);
+    size_t start = alloc_.alloc_run(nblocks);
     if (start == SIZE_MAX) return nullptr;
-    mark(start, nblocks, /*used=*/true);
-    used_blocks_ += nblocks;
     return base_ + start * block_size_;
 }
 
@@ -224,19 +181,18 @@ bool MemoryPool::deallocate(void* ptr, size_t size) {
     }
     size_t first = static_cast<size_t>(p - base_) / block_size_;
     size_t nblocks = (size + block_size_ - 1) / block_size_;
-    if (first + nblocks > total_blocks_) {
+    if (first + nblocks > alloc_.total) {
         ITS_LOG_ERROR("deallocate past pool end (%zu blocks at %zu)", nblocks, first);
         return false;
     }
     // Double-free detection (reference /root/reference/src/mempool.cpp:114-156).
     for (size_t i = first; i < first + nblocks; i++) {
-        if (!(bitmap_[i / 64] & (1ull << (i % 64)))) {
+        if (!alloc_.is_used(i)) {
             ITS_LOG_ERROR("double free detected at block %zu", i);
             return false;
         }
     }
-    mark(first, nblocks, /*used=*/false);
-    used_blocks_ -= nblocks;
+    alloc_.free_run(first, nblocks);
     return true;
 }
 

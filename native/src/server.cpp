@@ -160,7 +160,17 @@ struct Server::Conn {
 Server::Server(const ServerConfig& config) : config_(config) {
     mm_ = std::make_unique<MM>(config.prealloc_bytes, config.block_size, config.pin_memory,
                                config.enable_shm);
-    kv_ = std::make_unique<KVStore>(mm_.get());
+    if (!config.spill_dir.empty() && config.spill_bytes > 0) {
+        spill_ = std::make_unique<SpillFile>(config.spill_dir, config.spill_bytes,
+                                             config.block_size);
+        if (!spill_->ok()) spill_.reset();  // tier disabled; already logged
+    }
+    kv_ = std::make_unique<KVStore>(mm_.get(), spill_.get());
+    // Promotion allocates through the server's configured policy (evict
+    // ratios + auto_increase extension) — same treatment as PUT allocations.
+    kv_->set_promote_alloc([this](size_t size, std::vector<Lease>* leases) {
+        return alloc_blocks(size, 1, leases);
+    });
 }
 
 Server::~Server() { stop(); }
@@ -218,6 +228,11 @@ void Server::stop() {
     ssize_t rc = write(wake_fd_, &one, sizeof(one));
     (void)rc;
     if (thread_.joinable()) thread_.join();
+    // The reactor has exited: now the fds it waited on can close safely.
+    close(listen_fd_);
+    close(wake_fd_);
+    close(epoll_fd_);
+    listen_fd_ = wake_fd_ = epoll_fd_ = -1;
     running_.store(false);
 }
 
@@ -285,7 +300,13 @@ std::string Server::stats_json() {
               ",\"pools\":" + std::to_string(mm_->pool_count()) +
               ",\"pinned\":" + (mm_->pinned() ? std::string("true") : std::string("false")) +
               ",\"connections\":" + std::to_string(conns_.size()) +
-              ",\"conns_accepted\":" + std::to_string(conns_accepted_) + ",\"ops\":{";
+              ",\"conns_accepted\":" + std::to_string(conns_accepted_) +
+              ",\"spill\":{\"entries\":" + std::to_string(kv_->spilled_entries()) +
+              ",\"bytes\":" + std::to_string(kv_->spilled_bytes()) +
+              ",\"capacity\":" + std::to_string(kv_->spill_capacity()) +
+              ",\"promotions\":" + std::to_string(kv_->spill_promotions()) +
+              ",\"dropped\":" + std::to_string(kv_->spill_drops()) + "}" +
+              ",\"ops\":{";
         bool first = true;
         for (const auto& [op, s] : stats_) {
             if (!first) out += ",";
@@ -352,13 +373,12 @@ void Server::loop() {
         }
         for (auto& fn : fns) fn();
     }
-    // Teardown on the reactor thread.
+    // Teardown on the reactor thread: connection fds only. The listen/wake/
+    // epoll fds are closed by stop() AFTER the join — stop() writes to
+    // wake_fd_ to interrupt this loop, and closing it here would race that
+    // write (a recycled fd number could receive the byte; TSAN-caught).
     for (auto& [fd, c] : conns_) close(fd);
     conns_.clear();
-    close(listen_fd_);
-    close(wake_fd_);
-    close(epoll_fd_);
-    listen_fd_ = wake_fd_ = epoll_fd_ = -1;
 }
 
 void Server::accept_ready() {
@@ -742,6 +762,13 @@ void Server::handle_shm(Conn* c) {
             uint64_t total = 0;
             for (const auto& key : m.keys) {
                 BlockRef b = kv_->get(key);  // LRU touch
+                if (b == nullptr) {
+                    // exists() passed, but a spilled entry can fail
+                    // promotion (RAM exhausted) — that is a miss now.
+                    c->reset_read();
+                    send_status(c, kStatusKeyNotFound);
+                    return;
+                }
                 if (b->size() > m.block_size) {
                     c->reset_read();
                     send_status(c, kStatusInvalidReq);
@@ -866,6 +893,11 @@ void Server::handle_shm(Conn* c) {
             blocks.reserve(m.keys.size());
             for (size_t i = 0; i < m.keys.size(); i++) {
                 BlockRef b = kv_->get(m.keys[i]);  // LRU touch
+                if (b == nullptr) {  // spilled + unpromotable = miss
+                    c->reset_read();
+                    send_status(c, kStatusKeyNotFound);
+                    return;
+                }
                 uint64_t off = m.offsets[i];
                 if (b->size() > m.block_size || off > seg.size ||
                     b->size() > seg.size - off) {
@@ -934,6 +966,11 @@ void Server::handle_get_batch(Conn* c) {
     uint64_t total = 0;
     for (const auto& key : m.keys) {
         BlockRef b = kv_->get(key);  // touches LRU (reference :629-634)
+        if (b == nullptr) {  // spilled + unpromotable = miss
+            c->reset_read();
+            send_status(c, kStatusKeyNotFound);
+            return;
+        }
         // ...and each stored size must fit the client's block stride (:620-624).
         if (b->size() > m.block_size) {
             c->reset_read();

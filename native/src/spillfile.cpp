@@ -1,0 +1,73 @@
+#include "its/spillfile.h"
+
+#include <fcntl.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <atomic>
+
+#include "its/log.h"
+
+namespace its {
+
+static std::atomic<uint32_t> g_spill_seq{0};
+
+SpillFile::SpillFile(const std::string& dir, size_t bytes, size_t block_size)
+    : block_size_(block_size) {
+    size_t nblocks = bytes / block_size;
+    if (nblocks == 0) {
+        ITS_LOG_ERROR("spill: %zu bytes < one %zu-byte block; tier disabled", bytes,
+                      block_size);
+        return;
+    }
+    std::string path = dir + "/its-spill-" + std::to_string(getpid()) + "-" +
+                       std::to_string(g_spill_seq.fetch_add(1)) + ".dat";
+    int fd = open(path.c_str(), O_RDWR | O_CREAT | O_EXCL | O_CLOEXEC, 0600);
+    if (fd < 0) {
+        ITS_LOG_ERROR("spill: cannot create %s: %s; tier disabled", path.c_str(),
+                      strerror(errno));
+        return;
+    }
+    // Unlink NOW: the mapping keeps the inode alive, and any exit — clean,
+    // crash, or SIGKILL — reclaims the space with no sweeper.
+    unlink(path.c_str());
+    size_t total = nblocks * block_size;
+    if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+        ITS_LOG_ERROR("spill: ftruncate(%zu) failed: %s; tier disabled", total,
+                      strerror(errno));
+        close(fd);
+        return;
+    }
+    void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);  // the mapping holds its own reference
+    if (mem == MAP_FAILED) {
+        ITS_LOG_ERROR("spill: mmap(%zu) failed: %s; tier disabled", total,
+                      strerror(errno));
+        return;
+    }
+    base_ = static_cast<char*>(mem);
+    alloc_.init(nblocks);
+    ITS_LOG_INFO("spill tier: %zu MB at %s (unlinked), block %zu KB", total >> 20,
+                 path.c_str(), block_size >> 10);
+}
+
+SpillFile::~SpillFile() {
+    if (base_ != nullptr) munmap(base_, alloc_.total * block_size_);
+}
+
+int64_t SpillFile::alloc(size_t size) {
+    if (base_ == nullptr || size == 0) return -1;
+    size_t nblocks = (size + block_size_ - 1) / block_size_;
+    size_t first = alloc_.alloc_run(nblocks);
+    if (first == SIZE_MAX) return -1;
+    return static_cast<int64_t>(first * block_size_);
+}
+
+void SpillFile::free_slot(int64_t offset, size_t size) {
+    if (base_ == nullptr || offset < 0) return;
+    alloc_.free_run(static_cast<size_t>(offset) / block_size_,
+                    (size + block_size_ - 1) / block_size_);
+}
+
+}  // namespace its

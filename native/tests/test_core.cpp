@@ -191,6 +191,65 @@ static void test_loopback_end_to_end(bool enable_shm) {
     server.stop();
 }
 
+static void test_spill_tier_demote_promote() {
+    // KVStore + SpillFile: evict demotes to the file, get promotes back,
+    // bytes survive the round trip, slots are freed on delete/overwrite,
+    // and a full spill file drops only the coldest entries.
+    MM mm(8 * 64 << 10, 64 << 10, /*pin=*/false);  // 8 blocks of RAM
+    SpillFile spill("/tmp", 32 * 64 << 10, 64 << 10);
+    CHECK(spill.ok());
+    KVStore kv(&mm, &spill);
+
+    auto put = [&](const std::string& key, char fill) {
+        std::vector<Lease> leases;
+        CHECK(mm.allocate(64 << 10, 1, [](void*, size_t) {}, &leases));
+        memset(leases[0].ptr, fill, 64 << 10);
+        kv.commit(key, std::make_shared<Block>(&mm, leases[0].ptr, 64 << 10));
+    };
+
+    for (int i = 0; i < 24; i++) {
+        kv.evict(0.5, 0.9);  // the server's on-demand pattern
+        put("k" + std::to_string(i), static_cast<char>('a' + i));
+    }
+    CHECK(kv.size() == 24);               // nothing lost: 8 RAM + 16 spilled
+    CHECK(kv.spilled_entries() >= 16);
+    CHECK(kv.spill_drops() == 0);
+
+    // Promote an old (spilled) entry; its bytes must be intact.
+    BlockRef b = kv.get("k0");
+    CHECK(b != nullptr);
+    CHECK(static_cast<char*>(b->data())[0] == 'a');
+    CHECK(static_cast<char*>(b->data())[(64 << 10) - 1] == 'a');
+    CHECK(kv.spill_promotions() == 1);
+
+    // Control ops: spilled entries are present without promotion.
+    uint64_t promos = kv.spill_promotions();
+    CHECK(kv.exists("k1"));
+    std::vector<std::string> chain;
+    for (int i = 0; i < 24; i++) chain.push_back("k" + std::to_string(i));
+    CHECK(kv.match_last_index(chain) == 23);
+    CHECK(kv.spill_promotions() == promos);
+
+    // Delete frees spill slots.
+    size_t bytes_before = kv.spilled_bytes();
+    CHECK(bytes_before > 0);
+    CHECK(kv.remove({"k1", "k2"}) == 2);
+    CHECK(kv.spilled_bytes() < bytes_before);
+
+    // Fill far beyond RAM+spill: the coldest spilled entries drop, the
+    // newest stay readable.
+    for (int i = 100; i < 200; i++) {
+        kv.evict(0.5, 0.9);
+        put("z" + std::to_string(i), static_cast<char>(i));
+    }
+    CHECK(kv.spill_drops() > 0);
+    BlockRef newest = kv.get("z199");
+    CHECK(newest != nullptr);
+    CHECK(static_cast<char*>(newest->data())[7] == static_cast<char>(199));
+    kv.purge();
+    CHECK(kv.spilled_bytes() == 0);
+}
+
 static void test_abandoned_sync_ops_stress(bool enable_shm) {
     // The documented timeout contract: after a sync op raises, the caller
     // may unregister and FREE the buffer — the reactor must never touch it
@@ -306,6 +365,7 @@ int main() {
     test_mempool_basic();
     test_mempool_exhaustion_and_rollback();
     test_kvstore_lru_eviction();
+    test_spill_tier_demote_promote();
     test_wire_codec_roundtrip();
     test_loopback_end_to_end(/*enable_shm=*/true);
     test_loopback_end_to_end(/*enable_shm=*/false);

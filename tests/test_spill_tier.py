@@ -1,0 +1,157 @@
+"""File-backed spill tier: capacity beyond RAM, the tier the reference only
+aspired to (reference docs/source/design.rst:36 lists SSD as a future pool;
+its kv_map is in-RAM only, so eviction is data loss).
+
+With ``spill_dir`` set, eviction demotes LRU blocks into an mmap'd
+(immediately unlinked — crash-safe by construction) file, and access
+promotes them back into a RAM pool. Everything below runs through the public
+surface against a live server.
+"""
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+
+BLOCK = 64 << 10
+
+
+def _server(**kw):
+    defaults = dict(
+        prealloc_bytes=4 << 20,  # 64 blocks of RAM
+        block_bytes=BLOCK,
+        spill_dir="/tmp",
+        spill_bytes=64 << 20,
+    )
+    defaults.update(kw)
+    return its.start_local_server(**defaults)
+
+
+def _connect(srv):
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    return c
+
+
+def test_capacity_beyond_ram_with_data_intact():
+    """Write 2x the RAM pool; every key stays present and byte-correct."""
+    srv = _server()
+    c = _connect(srv)
+    n = 128  # 8MB through a 4MB pool
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(n):
+        c.write_cache([(f"sp-{i}", i * BLOCK)], BLOCK, src.ctypes.data)
+    spill = c.get_stats()["spill"]
+    assert spill["entries"] > 0, "nothing spilled — pool should have overflowed"
+    assert spill["dropped"] == 0
+
+    dst = np.zeros(BLOCK, dtype=np.uint8)
+    c.register_mr(dst)
+    for i in range(n):
+        assert c.check_exist(f"sp-{i}"), f"sp-{i} lost despite spill tier"
+        c.read_cache([(f"sp-{i}", 0)], BLOCK, dst.ctypes.data)
+        assert np.array_equal(dst, src[i * BLOCK : (i + 1) * BLOCK]), f"sp-{i} corrupt"
+    assert c.get_stats()["spill"]["promotions"] >= n - 64  # spilled ones came back
+    c.close()
+    srv.stop()
+
+
+def test_prefix_match_and_delete_cover_spilled_entries():
+    """Control ops see spilled entries as present (no promotion), and delete
+    frees their slots."""
+    srv = _server()
+    c = _connect(srv)
+    n = 100
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(n):
+        c.write_cache([(f"ch-{i:04d}", i * BLOCK)], BLOCK, src.ctypes.data)
+    # Chain over all keys: early ones are spilled by now, yet the match must
+    # cover the full chain.
+    assert c.get_match_last_index([f"ch-{i:04d}" for i in range(n)]) == n - 1
+    before = c.get_stats()["spill"]["bytes"]
+    assert before > 0
+    assert c.delete_keys([f"ch-{i:04d}" for i in range(n)]) == n
+    assert c.get_stats()["spill"]["bytes"] == 0, "delete must free spill slots"
+    c.close()
+    srv.stop()
+
+
+def test_spill_full_drops_coldest_only():
+    """When the spill file itself fills, only the coldest spilled entries are
+    dropped; the hottest data survives."""
+    srv = _server(spill_bytes=2 << 20)  # RAM 4MB + spill 2MB << data 12MB
+    c = _connect(srv)
+    n = 192
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(n):
+        c.write_cache([(f"fd-{i}", i * BLOCK)], BLOCK, src.ctypes.data)
+    spill = c.get_stats()["spill"]
+    assert spill["dropped"] > 0, "spill file should have overflowed"
+    # The most recent writes are still resident or spilled — readable.
+    dst = np.zeros(BLOCK, dtype=np.uint8)
+    c.register_mr(dst)
+    for i in range(n - 16, n):
+        c.read_cache([(f"fd-{i}", 0)], BLOCK, dst.ctypes.data)
+        assert np.array_equal(dst, src[i * BLOCK : (i + 1) * BLOCK])
+    # The oldest were dropped for real (cache semantics).
+    assert c.check_exist("fd-0") is False
+    c.close()
+    srv.stop()
+
+
+def test_overwrite_of_spilled_key_frees_slot():
+    srv = _server()
+    c = _connect(srv)
+    n = 96
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(n):
+        c.write_cache([(f"ow-{i}", i * BLOCK)], BLOCK, src.ctypes.data)
+    assert c.get_stats()["spill"]["entries"] > 0
+    # Overwrite an old (spilled) key with fresh bytes; read must see them.
+    fresh = np.full(BLOCK, 0xA5, dtype=np.uint8)
+    c.register_mr(fresh)
+    c.write_cache([("ow-0", 0)], BLOCK, fresh.ctypes.data)
+    dst = np.zeros(BLOCK, dtype=np.uint8)
+    c.register_mr(dst)
+    c.read_cache([("ow-0", 0)], BLOCK, dst.ctypes.data)
+    assert (dst == 0xA5).all()
+    c.close()
+    srv.stop()
+
+
+def test_spill_disabled_keeps_reference_behavior():
+    """Without spill_dir, eviction drops — the pre-existing (reference)
+    semantics are untouched."""
+    srv = its.start_local_server(prealloc_bytes=4 << 20, block_bytes=BLOCK)
+    c = _connect(srv)
+    src = np.random.randint(0, 256, size=BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(128):
+        c.write_cache([(f"nd-{i}", 0)], BLOCK, src.ctypes.data)
+    assert c.get_stats()["spill"] == {
+        "entries": 0, "bytes": 0, "capacity": 0, "promotions": 0, "dropped": 0
+    }
+    assert c.check_exist("nd-0") is False  # evicted = gone
+    assert c.check_exist("nd-127") is True
+    c.close()
+    srv.stop()
+
+
+def test_bad_spill_dir_disables_tier_not_server():
+    srv = its.start_local_server(
+        prealloc_bytes=2 << 20, block_bytes=BLOCK,
+        spill_dir="/nonexistent-dir-xyz", spill_bytes=8 << 20,
+    )
+    c = _connect(srv)
+    src = np.zeros(BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    c.write_cache([("ok", 0)], BLOCK, src.ctypes.data)
+    assert c.get_stats()["spill"]["capacity"] == 0  # tier off, server fine
+    c.close()
+    srv.stop()
