@@ -91,6 +91,13 @@ class KVStore {
     // Returns the number of entries demoted or dropped.
     size_t evict(double min_ratio, double max_ratio);
 
+    // Reclaim the single LRU-coldest RAM entry (demote with a spill tier,
+    // drop without). Returns false when no RAM-resident entries remain.
+    // Lets the allocator free exactly what a large batch needs instead of
+    // failing with OOM once the ratio-driven pass runs dry (the reference
+    // 507s in that case even with reclaimable entries present).
+    bool evict_one();
+
     // Promotion RAM allocator override: the server routes this through its
     // configured policy (on-demand evict ratios + auto_increase pool
     // extension), so promotion behaves exactly like any other allocation.

@@ -158,6 +158,18 @@ int32_t KVStore::match_last_index(const std::vector<std::string>& keys) const {
     return static_cast<int32_t>(lo) - 1;
 }
 
+bool KVStore::evict_one() {
+    if (lru_.empty()) return false;
+    const std::string victim = lru_.back();
+    lru_.pop_back();
+    auto it = map_.find(victim);
+    if (it == map_.end()) return true;  // lockstep violation; tolerate
+    if (spill_ != nullptr && demote(victim, it->second)) return true;
+    release_entry(it->second);
+    map_.erase(it);
+    return true;
+}
+
 size_t KVStore::evict(double min_ratio, double max_ratio) {
     if (mm_->usage() < max_ratio) return 0;
     size_t evicted = 0;

@@ -583,6 +583,17 @@ bool Server::alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases) {
     if (!ok && config_.auto_increase && mm_->extend(config_.extend_pool_bytes)) {
         ok = mm_->allocate(size, n, nullptr, leases);
     }
+    if (!ok) {
+        // A batch larger than the ratio slack: reclaim exactly what it
+        // needs (demote with a spill tier, drop without) rather than 507
+        // with reclaimable entries present. In-flight refs may keep some
+        // freed entries' RAM pinned, so re-try as long as progress is
+        // possible; evict_one() draining lru_ bounds the loop.
+        size_t need = size * n;
+        while (mm_->total_bytes() - mm_->used_bytes() < need && kv_->evict_one()) {
+        }
+        ok = mm_->allocate(size, n, nullptr, leases);
+    }
     return ok;
 }
 

@@ -143,6 +143,32 @@ def test_spill_disabled_keeps_reference_behavior():
     srv.stop()
 
 
+def test_large_batch_reclaims_instead_of_507():
+    """A batch larger than the eviction-ratio slack must evict/demote what
+    it needs rather than fail 507 while reclaimable entries exist (the
+    reference 507s here). Both with and without the spill tier."""
+    for spill in (True, False):
+        srv = _server() if spill else its.start_local_server(
+            prealloc_bytes=4 << 20, block_bytes=BLOCK
+        )
+        c = _connect(srv)
+        half = 32  # 2MB batches against a 4MB pool
+        buf = np.random.randint(0, 256, size=half * BLOCK, dtype=np.uint8)
+        c.register_mr(buf)
+        for r in range(6):  # 12MB total: far past the pool, batch by batch
+            pairs = [(f"big{spill}-{r}-{i}", i * BLOCK) for i in range(half)]
+            c.write_cache(pairs, BLOCK, buf.ctypes.data)  # must not raise
+        # Latest batch readable; with spill the earlier ones survive too.
+        dst = np.zeros(BLOCK, dtype=np.uint8)
+        c.register_mr(dst)
+        c.read_cache([(f"big{spill}-5-0", 0)], BLOCK, dst.ctypes.data)
+        assert np.array_equal(dst, buf[:BLOCK])
+        if spill:
+            assert c.check_exist(f"big{spill}-0-0") is True
+        c.close()
+        srv.stop()
+
+
 def test_bad_spill_dir_disables_tier_not_server():
     srv = its.start_local_server(
         prealloc_bytes=2 << 20, block_bytes=BLOCK,
