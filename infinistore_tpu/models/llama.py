@@ -8,8 +8,11 @@ store with LayerwiseKVWriter and decode can resume from fetched blocks — the
 role vLLM plays for the reference store.
 
 Sharding conventions (used by __graft_entry__.dryrun_multichip and the
-train_step): logical axes are ("dp", "tp") — batch over dp, attention heads /
-ffn hidden over tp, with sequence-sharded activations where XLA chooses.
+train_step): logical axes are ("dp", "tp"[, "ep"]) — batch over dp, attention
+heads / ffn hidden over tp, experts over ep (n_experts > 0 switches the FFN
+to a soft mixture-of-experts whose expert-major weight tensors shard over the
+ep axis; XLA computes local experts and inserts the combine collective), with
+sequence-sharded activations where XLA chooses.
 """
 
 import functools
@@ -34,6 +37,11 @@ class LlamaConfig:
     n_heads: int = 8
     n_kv_heads: int = 4
     ffn_dim: int = 256
+    # > 0 switches every FFN to a soft mixture of experts: expert-major
+    # weights [n_experts, ...] shard over an "ep" mesh axis (expert
+    # parallelism); a router picks per-token gates and the combine reduces
+    # across experts (psum over ep under jit).
+    n_experts: int = 0
     block_tokens: int = 8
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.bfloat16
@@ -56,7 +64,7 @@ class LlamaConfig:
 
 def init_params(config: LlamaConfig, key: jax.Array) -> Params:
     """He-scaled dense params as a flat dict (layer-prefixed keys)."""
-    keys = iter(jax.random.split(key, 4 + 7 * config.n_layers))
+    keys = iter(jax.random.split(key, 4 + 8 * config.n_layers))
 
     def dense(k, shape):
         scale = 1.0 / np.sqrt(shape[0])
@@ -78,8 +86,17 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
         p[pre + "wv"] = dense(next(keys), (config.dim, config.n_kv_heads, hd))
         p[pre + "wo"] = dense(next(keys), (config.n_heads, hd, config.dim))
         p[pre + "ffn_norm"] = jnp.ones((config.dim,), dtype=config.dtype)
-        p[pre + "w_gate_up"] = dense(next(keys), (config.dim, 2, config.ffn_dim))
-        p[pre + "w_down"] = dense(next(keys), (config.ffn_dim, config.dim))
+        if config.n_experts > 0:
+            p[pre + "router"] = dense(next(keys), (config.dim, config.n_experts))
+            p[pre + "w_gate_up_moe"] = dense(
+                next(keys), (config.n_experts, config.dim, 2, config.ffn_dim)
+            )
+            p[pre + "w_down_moe"] = dense(
+                next(keys), (config.n_experts, config.ffn_dim, config.dim)
+            )
+        else:
+            p[pre + "w_gate_up"] = dense(next(keys), (config.dim, 2, config.ffn_dim))
+            p[pre + "w_down"] = dense(next(keys), (config.ffn_dim, config.dim))
     return p
 
 
@@ -128,6 +145,21 @@ def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
     attn = _attention(q, k, v, mask)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
     h = _rms_norm(x, params[pre + "ffn_norm"])
+    if config.n_experts > 0:
+        # Soft MoE, expert-major: every einsum keeps the expert axis e
+        # outermost so weights sharded P("ep", ...) compute their local
+        # experts and XLA reduces the combine across the ep axis. Dense
+        # (all tokens x all experts) by design — compiler-friendly static
+        # shapes; top-k routing sparsity is a serving optimization, not
+        # needed to exercise the parallelism.
+        gates = jax.nn.softmax(
+            jnp.einsum("bsd,de->bse", h, params[pre + "router"]).astype(jnp.float32),
+            axis=-1,
+        ).astype(h.dtype)
+        gate_up = jnp.einsum("bsd,edcf->bsecf", h, params[pre + "w_gate_up_moe"])
+        ffn = jax.nn.silu(gate_up[:, :, :, 0]) * gate_up[:, :, :, 1]  # [B,S,E,F]
+        out = jnp.einsum("bse,bsef,efd->bsd", gates, ffn, params[pre + "w_down_moe"])
+        return x + out
     gate_up = jnp.einsum("bsd,dcf->bscf", h, params[pre + "w_gate_up"])
     ffn = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
     return x + jnp.einsum("bsf,fd->bsd", ffn, params[pre + "w_down"])
