@@ -1,0 +1,61 @@
+"""Ring attention (context parallelism) against the dense oracle on the
+virtual 8-device CPU mesh: forward exactness, gradient exactness (long-
+context training shards sequence too), and bf16 behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from infinistore_tpu.models.ring_attention import (
+    dense_attention_reference,
+    ring_attention,
+)
+
+B, S, H, D = 2, 32, 4, 16
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    key = jax.random.PRNGKey(0)
+    return tuple(
+        jax.random.normal(jax.random.fold_in(key, i), (B, S, H, D), dtype=jnp.float32)
+        for i in range(3)
+    )
+
+
+@pytest.mark.parametrize("ring", [2, 4, 8])
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_dense_attention(qkv, ring, causal):
+    mesh = Mesh(np.array(jax.devices()[:ring]), ("sp",))
+    got = ring_attention(*qkv, mesh=mesh, axis="sp", causal=causal)
+    ref = dense_attention_reference(*qkv, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-6, rtol=2e-6)
+
+
+def test_gradients_match_dense(qkv):
+    """Long-context TRAINING shards sequence too: grads through the rotating
+    ppermutes must equal the dense oracle's."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+
+    def ring_loss(q, k, v):
+        return (ring_attention(q, k, v, mesh=mesh, axis="sp", causal=True) ** 2).mean()
+
+    def dense_loss(q, k, v):
+        return (dense_attention_reference(*(q, k, v), causal=True) ** 2).mean()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(*qkv)
+    g_dense = jax.grad(dense_loss, argnums=(0, 1, 2))(*qkv)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=3e-6, rtol=3e-6)
+
+
+def test_bf16_inputs_fp32_accumulation(qkv):
+    """bf16 inputs: the online accumulation runs in fp32, so the result must
+    match the dense oracle computed on the same bf16 inputs."""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    got = np.asarray(ring_attention(q, k, v, mesh=mesh, axis="sp"), dtype=np.float32)
+    ref = np.asarray(dense_attention_reference(q, k, v), dtype=np.float32)
+    np.testing.assert_allclose(got, ref, atol=2e-2, rtol=2e-2)
