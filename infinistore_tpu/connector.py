@@ -140,12 +140,26 @@ class KVConnector:
         except InfiniStoreNoMatch:
             return 0
 
-    async def save(self, token_ids, caches, block_ids: np.ndarray) -> int:
+    async def save(
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0
+    ) -> int:
         """Stream the request's KV blocks to the store. ``block_ids[i]`` is
-        the engine's physical block holding logical block i of this prompt.
-        Returns blocks written (K+V across layers)."""
+        the engine's physical block holding logical block ``first_block + i``
+        of this prompt. Returns blocks written (K+V across layers).
+
+        ``first_block`` serves sharded producers: under sequence-parallel
+        prefill (models/long_context.py) each host holds only its chunk's
+        blocks — it passes the FULL token list (chain hashes commit to the
+        whole prefix) but saves just its logical span. The spans compose:
+        once every shard saved, a consumer's lookup sees the whole prefix."""
         self._require_store("save")
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if first_block < 0 or first_block > len(chains):
+            raise ValueError(
+                f"first_block={first_block} outside the prompt's "
+                f"{len(chains)} complete blocks"
+            )
+        chains = chains[first_block:]
         n = min(len(chains), len(block_ids))
         if n == 0:
             return 0

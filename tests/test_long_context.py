@@ -1,0 +1,138 @@
+"""Sequence-parallel (ring-attention) prefill, end to end with the store.
+
+The long-context flow: a prompt too big for one device prefills under "sp"
+sharding (models/long_context.py), each shard's K/V chunk becomes paged
+token blocks, and each "host" saves ITS OWN chunk through the connector —
+then a decode-side connector loads the full context back and the bytes
+match the dense single-device prefill exactly.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import infinistore_tpu as its
+from infinistore_tpu import KVConnector
+from infinistore_tpu.models import LlamaConfig, init_params
+from infinistore_tpu.models.llama import _block, _kv_proj, _rms_norm
+from infinistore_tpu.models.long_context import prefill_ring
+from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+CFG = LlamaConfig(
+    vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+    block_tokens=8, dtype=jnp.float32,
+)
+B, S, RING = 1, 64, 4  # 64-token prompt over a 4-way ring
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _dense_reference(params, tokens):
+    positions = jnp.arange(S, dtype=jnp.int32)[None].repeat(B, axis=0)
+    x = jnp.take(params["embed"], tokens, axis=0)
+    mask = positions[:, :, None] >= positions[:, None, :]
+    kvs = []
+    for layer in range(CFG.n_layers):
+        k, v = _kv_proj(params, layer, x, positions, CFG)
+        kvs.append((np.asarray(k), np.asarray(v)))
+        x = _block(params, layer, x, k, v, positions, mask, CFG)
+    x = _rms_norm(x, params["final_norm"])
+    return np.asarray(jnp.einsum("bsd,dv->bsv", x, params["lm_head"])), kvs
+
+
+def test_sp_prefill_matches_dense(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, S), 0, CFG.vocab)
+    ref_logits, ref_kvs = _dense_reference(params, tokens)
+    mesh = Mesh(np.array(jax.devices()[:RING]), ("sp",))
+    logits, kvs = prefill_ring(params, tokens, CFG, mesh=mesh, axis="sp")
+    np.testing.assert_allclose(np.asarray(logits), ref_logits, atol=1e-5, rtol=1e-5)
+    for l in range(CFG.n_layers):
+        for side in (0, 1):
+            np.testing.assert_allclose(
+                np.asarray(kvs[l][side]), ref_kvs[l][side], atol=1e-5, rtol=1e-5
+            )
+
+
+def test_sp_prefill_streams_to_store_per_shard(params):
+    """Each ring shard's K/V chunk is saved by its OWN connector (one per
+    host, as in a real multi-host job — same model id, so chain keys line
+    up); a decode connector then loads the full context and the bytes equal
+    the dense prefill's K/V."""
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (B, S), 0, CFG.vocab)
+    _, ref_kvs = _dense_reference(params, tokens)
+    mesh = Mesh(np.array(jax.devices()[:RING]), ("sp",))
+    _, kvs = prefill_ring(params, tokens, CFG, mesh=mesh, axis="sp")
+
+    spec = PagedKVCacheSpec(
+        num_layers=CFG.n_layers, num_blocks=16, block_tokens=CFG.block_tokens,
+        num_kv_heads=CFG.n_kv_heads, head_dim=CFG.head_dim, dtype=CFG.dtype,
+    )
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=16 << 10)
+    token_list = [int(t) for t in np.asarray(tokens)[0]]
+    blocks_per_shard = (S // RING) // CFG.block_tokens
+    s_loc = S // RING
+
+    # Producer side: one connection + connector per "host" (ring shard).
+    # Shard r owns global token blocks [r*bps, (r+1)*bps); save() gets the
+    # full token list (chain hashes need the whole prefix) but only this
+    # shard's cache blocks, placed at their global block positions.
+    for r in range(RING):
+        conn = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=srv.port, log_level="error"))
+        conn.connect()
+        kvc = KVConnector(conn, spec, "longctx", max_blocks=16)
+        caches = []
+        for l in range(CFG.n_layers):
+            k_blocks = np.asarray(kvs[l][0])[0, r * s_loc : (r + 1) * s_loc].reshape(
+                blocks_per_shard, *spec.block_shape
+            )
+            v_blocks = np.asarray(kvs[l][1])[0, r * s_loc : (r + 1) * s_loc].reshape(
+                blocks_per_shard, *spec.block_shape
+            )
+            # Place this shard's blocks into a scratch paged cache at ids
+            # matching their GLOBAL block positions.
+            k_cache = np.zeros(spec.cache_shape, dtype=np.float32)
+            v_cache = np.zeros(spec.cache_shape, dtype=np.float32)
+            ids = np.arange(r * blocks_per_shard, (r + 1) * blocks_per_shard)
+            k_cache[ids] = k_blocks
+            v_cache[ids] = v_blocks
+            caches.append((jnp.asarray(k_cache), jnp.asarray(v_cache)))
+        # save() gets the FULL token list (chain hashes commit to the whole
+        # prefix) but writes only this shard's logical span via first_block.
+        n_written = asyncio.run(kvc.save(
+            token_list, caches,
+            np.arange(r * blocks_per_shard, (r + 1) * blocks_per_shard,
+                      dtype=np.int32),
+            first_block=r * blocks_per_shard,
+        ))
+        assert n_written == 2 * CFG.n_layers * blocks_per_shard
+        conn.close()
+
+    # Consumer side: a fresh connector sees the WHOLE prefix and loads it.
+    conn = its.InfinityConnection(its.ClientConfig(
+        host_addr="127.0.0.1", service_port=srv.port, log_level="error"))
+    conn.connect()
+    kvc = KVConnector(conn, spec, "longctx", max_blocks=16)
+    assert kvc.lookup(token_list) == S // CFG.block_tokens
+    fresh = [
+        (jnp.zeros(spec.cache_shape), jnp.zeros(spec.cache_shape))
+        for _ in range(CFG.n_layers)
+    ]
+    ids = np.arange(S // CFG.block_tokens, dtype=np.int32)
+    out, loaded = asyncio.run(kvc.load(token_list, fresh, ids))
+    assert loaded == S // CFG.block_tokens
+    for l in range(CFG.n_layers):
+        for side in (0, 1):
+            got = np.asarray(out[l][side])[ids].reshape(S, CFG.n_kv_heads, CFG.head_dim)
+            np.testing.assert_allclose(
+                got, ref_kvs[l][side][0], atol=1e-5, rtol=1e-5
+            )
+    conn.close()
+    srv.stop()
