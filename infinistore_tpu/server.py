@@ -104,6 +104,19 @@ def _prometheus_text(stats: dict) -> bytes:
         "# TYPE infinistore_connections gauge",
         f"infinistore_connections {stats['connections']}",
     ]
+    spill = stats.get("spill", {})
+    if spill.get("capacity", 0) > 0:
+        lines += [
+            "# TYPE infinistore_spill_bytes gauge",
+            f'infinistore_spill_bytes{{kind="used"}} {spill["bytes"]}',
+            f'infinistore_spill_bytes{{kind="capacity"}} {spill["capacity"]}',
+            "# TYPE infinistore_spill_entries gauge",
+            f"infinistore_spill_entries {spill['entries']}",
+            "# TYPE infinistore_spill_promotions counter",
+            f"infinistore_spill_promotions {spill['promotions']}",
+            "# TYPE infinistore_spill_dropped counter",
+            f"infinistore_spill_dropped {spill['dropped']}",
+        ]
     # Exposition format requires all samples of a family in one uninterrupted
     # group after its TYPE line — one pass per family, not per op.
     ops = sorted(stats.get("ops", {}).items())

@@ -737,6 +737,14 @@ bool Connection::read_ready() {
                 ITS_LOG_ERROR("protocol error: unexpected response");
                 return false;
             }
+            if (rhdr_.status < 100 || rhdr_.status > 599) {
+                // HTTP-like status range (protocol.h). Anything else is a
+                // desynced or hostile stream — fail the connection rather
+                // than complete ops with a bogus code (a status of 0 would
+                // collide with "success" returns up the stack).
+                ITS_LOG_ERROR("protocol error: invalid status %u", rhdr_.status);
+                return false;
+            }
             rbody_.resize(rhdr_.body_size);
             rbody_got_ = 0;
             resp_in_progress_ = true;

@@ -87,6 +87,84 @@ def test_survives_mutated_real_frames(server):
     assert _healthy(server)
 
 
+def _hostile_server(make_response):
+    """A listener that reads one request and answers with whatever
+    make_response(op, body) returns (bytes), then closes."""
+    import threading
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+
+    def serve():
+        while True:
+            try:
+                s, _ = lst.accept()
+            except OSError:
+                return
+            try:
+                s.settimeout(1)
+                hdr = b""
+                while len(hdr) < 9:
+                    hdr += s.recv(9 - len(hdr))
+                _, op, bs = struct.unpack("<IBI", hdr)
+                body = b""
+                while len(body) < bs:
+                    body += s.recv(bs - len(body))
+                s.sendall(make_response(op, body))
+            except OSError:
+                pass
+            finally:
+                s.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return lst
+
+
+@pytest.mark.parametrize(
+    "response",
+    [
+        b"\x00" * 64,  # garbage where a response header should be
+        wire.pack_resp_header(wire.STATUS_OK, 0xFFFFFFFF, 0),  # absurd body size
+        wire.pack_resp_header(wire.STATUS_OK, 4, 1 << 40),  # absurd payload size
+        wire.pack_resp_header(9999, 0, 0),  # status outside the HTTP range
+        wire.pack_resp_header(0, 0, 0),  # status 0 must not read as success
+    ],
+    ids=["garbage", "huge-body", "huge-payload", "odd-status", "zero-status"],
+)
+def test_client_survives_hostile_server_responses(response):
+    """The client parses server bytes too: a hostile/buggy server must
+    produce a typed error (or a clean connection failure), never a crash, a
+    hang past the op deadline, or a bogus status masquerading as success
+    (the reactor validates the HTTP-like status range)."""
+    lst = _hostile_server(lambda op, body: response)
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=lst.getsockname()[1],
+            log_level="error", enable_shm=False, op_timeout_ms=1000,
+        )
+    )
+    c.connect()
+    import time
+
+    t0 = time.time()
+    with pytest.raises(its.InfiniStoreException):
+        c.check_exist("k")
+    assert time.time() - t0 < 5
+    # The process survived; a fresh connection to a REAL server still works.
+    c.close()
+    lst.close()
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    ok = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    ok.connect()
+    assert ok.check_exist("nope") is False
+    ok.close()
+    srv.stop()
+
+
 def test_survives_truncated_frames_and_slow_trickle(server):
     meta = wire.BatchMeta(block_size=4096, keys=["fz-c"]).encode()
     frame = wire.pack_req_header(wire.OP_PUT_BATCH, len(meta)) + meta + b"C" * 4096
