@@ -227,6 +227,26 @@ def _spill_tier_gbps(its, np) -> dict:
     }
 
 
+def _lookup_latency_us(np, conn, chain_len: int = 256, iters: int = 300) -> float:
+    """BASELINE config 3: get_match_last_index over a 256-key chain with a
+    half-present prefix (the binary search's worst-ish case: log2(256) probes
+    per call). One metric: p50 round-trip latency."""
+    buf = conn.alloc_shm_mr(4 << 10)
+    buf[:] = 1
+    keys = [f"chain-{i:04d}" for i in range(chain_len)]
+    for k in keys[: chain_len // 2]:  # present prefix: first half
+        conn.write_cache([(k, 0)], 4 << 10, buf.ctypes.data)
+    assert conn.get_match_last_index(keys) == chain_len // 2 - 1
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        conn.get_match_last_index(keys)
+        samples.append((time.perf_counter() - t0) * 1e6)
+    samples.sort()
+    conn.delete_keys(keys[: chain_len // 2])
+    return samples[len(samples) // 2]
+
+
 def _fetch_latency_us(np, conn, block: int, iters: int = 500):
     """Single-block fetch latency through the public API.
 
@@ -450,6 +470,7 @@ def main() -> int:
 
     ceiling = _memcpy_ceiling_gbps(np)
     gbps = _loopback_throughput(its, np, conn)
+    lookup_p50 = _lookup_latency_us(np, conn)
     sync_p50_4k, sync_p99_4k, p50_4k, p99_4k = _fetch_latency_us(np, conn, 4 << 10)
     sync_p50_64k, sync_p99_64k, p50_64k, p99_64k = _fetch_latency_us(np, conn, 64 << 10)
     striped_1 = _striped_scaling_gbps(its, np, srv.port, 1)
@@ -483,6 +504,7 @@ def main() -> int:
         "sync_p99_fetch_4k_us": round(sync_p99_4k, 1),
         "sync_p50_fetch_64k_us": round(sync_p50_64k, 1),
         "sync_p99_fetch_64k_us": round(sync_p99_64k, 1),
+        "lookup_256chain_p50_us": round(lookup_p50, 1),
         "striped_1_gbps": round(striped_1, 3),
         "striped_4_gbps": round(striped_4, 3),
         # Striping where it can win: per-connection 50 MB/s pacing emulates a
