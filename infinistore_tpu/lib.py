@@ -647,6 +647,23 @@ class StripedConnection:
             c.close()
 
     @property
+    def is_connected(self) -> bool:
+        """True only when EVERY stripe's reactor is live (batched ops fan
+        out, so one dead stripe fails the batch)."""
+        return all(c.is_connected for c in self.conns)
+
+    def reconnect(self):
+        """Reconnect every stripe (dead ones rebuilt, live ones kept),
+        re-registering plain MRs per stripe. Same caveats as
+        InfinityConnection.reconnect: alloc_shm_mr views do not survive, and
+        a restarted store is a cold cache. With auto_reconnect configured,
+        sync ops (stripe 0) self-heal; batched async callers invoke this
+        after a failure — without it a restart left stripes 1..N dead."""
+        for c in self.conns:
+            if not c.is_connected:
+                c.reconnect()
+
+    @property
     def shm_active(self) -> bool:
         return self.conns[0].shm_active
 

@@ -537,6 +537,54 @@ def test_abandoned_sync_read_never_touches_buffer():
     listener.close()
 
 
+def test_striped_reconnect_after_server_restart():
+    """StripedConnection.reconnect() rebuilds every dead stripe (a restart
+    kills all of them; without this only stripe 0 could self-heal) and
+    batched ops work again with re-registered MRs."""
+    import time
+
+    srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=16 << 10)
+    port = srv.port
+    c = its.StripedConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error",
+                         enable_shm=False),
+        streams=3,
+    )
+    c.connect()
+    n, block = 12, 16 << 10
+    src = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    c.register_mr(src)
+    c.register_mr(dst)
+    pairs = [(f"sr-{i}", i * block) for i in range(n)]
+    asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
+
+    srv.stop()
+    for _ in range(20):
+        try:
+            srv2 = its.start_local_server(
+                host="127.0.0.1", service_port=port,
+                prealloc_bytes=32 << 20, block_bytes=16 << 10,
+            )
+            break
+        except its.InfiniStoreException:
+            time.sleep(0.1)
+    else:
+        pytest.skip("could not rebind the port")
+
+    with pytest.raises(its.InfiniStoreException):
+        for _ in range(10):
+            asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
+    assert not c.is_connected
+    c.reconnect()
+    assert c.is_connected
+    asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
+    asyncio.run(c.read_cache_async(pairs, block, dst.ctypes.data))
+    assert np.array_equal(src, dst)
+    c.close()
+    srv2.stop()
+
+
 def test_striped_connection_roundtrip():
     """StripedConnection splits batched ops across N sockets while keeping
     the single-connection API: data correctness, control ops, shm segment on
