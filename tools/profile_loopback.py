@@ -9,7 +9,12 @@ import time
 
 import numpy as np
 
-import infinistore_tpu as its
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import infinistore_tpu as its  # noqa: E402
 
 
 def run_cell(its, srv_port, *, path: str, n_keys: int, same_buf: bool, iters=5):
