@@ -176,13 +176,14 @@ def _reconnecting(ptr_arg: Optional[int] = None):
                 if not (
                     self.config.auto_reconnect
                     and self._ever_connected
+                    and not self._closed  # close() is final; never resurrect
                     and not self.is_connected
                 ):
                     raise
                 Logger.warn("store connection lost; auto-reconnecting")
                 self.reconnect()
-                if ptr_arg is not None and ptr_arg < len(args):
-                    ptr = args[ptr_arg]
+                if ptr_arg is not None:
+                    ptr = args[ptr_arg] if ptr_arg < len(args) else kwargs.get("ptr")
                     if isinstance(ptr, int) and self._in_dead_shm(ptr):
                         raise InfiniStoreException(
                             "reconnected, but this op's buffer was an "
@@ -210,7 +211,13 @@ class InfinityConnection:
         self._semaphores: dict = {}  # per-loop inflight caps
         self._shm_bufs: list = []  # keeps alloc_shm_mr views (and mappings) alive
         self._plain_mrs: list = []  # (ptr, nbytes) re-registered on reconnect
+        # (ptr, nbytes) of ANOTHER connection's shm segment registered here
+        # as a plain region (StripedConnection stripes 1..N). NOT
+        # re-registered on reconnect — the segment dies with its owner; the
+        # ranges become dead-shm so retries get a typed error.
+        self._segment_aliases: list = []
         self._ever_connected = False  # auto-reconnect only after a first connect
+        self._closed = False  # explicit close() forbids auto-reconnect
         # Old native handles parked by reconnect(): destroying them there
         # could free a Connection another thread is still inside (sync ops
         # run without the GIL) — they are closed immediately (reactor stops,
@@ -246,6 +253,7 @@ class InfinityConnection:
             )
         self._handle = handle
         self._ever_connected = True
+        self._closed = False
         if self.config.connection_type == TYPE_RDMA:
             self.rdma_connected = True
         else:
@@ -264,12 +272,14 @@ class InfinityConnection:
         """Tear down the connection: stops the native reactor, unmaps shm
         segments (invalidating alloc_shm_mr views), releases registrations.
         ``close_connection`` is the reference-compatible alias."""
+        self._closed = True  # a closed connection must stay closed
         if self._handle is not None:
             lib.its_conn_close(self._handle)
             lib.its_conn_destroy(self._handle)
             self._handle = None
             self._shm_bufs.clear()  # views are dead once the segment unmaps
             self._plain_mrs.clear()
+            self._segment_aliases.clear()
             self.rdma_connected = False
             self.tcp_connected = False
         for h in self._dead_handles:  # parked by reconnect(); see __init__
@@ -297,37 +307,56 @@ class InfinityConnection:
         store is a cache, reference kv_map is in-RAM only): after
         reconnect, misses mean recompute, exactly like a cold cache.
 
-        A FAILED reconnect (server still down) leaves the connection
-        retryable: the MR list is preserved and the next call (or
-        auto-reconnect attempt) tries again. Safe to race from several
-        threads — one performs the reconnect, the rest see it done — but a
-        thread still blocked inside a native op keeps the OLD handle: that
-        handle is closed here (its ops fail out) yet destroyed only at
-        close(), so it is never freed under a live call."""
+        A FAILED reconnect (server still down) leaves the OLD handle and
+        all bookkeeping untouched — fully retryable. The new connection is
+        built FIRST and swapped in only on success, so ``_handle`` is never
+        None mid-reconnect: a concurrent thread between its own liveness
+        check and its native call uses either the old handle (its op fails
+        out when that handle closes) or the new one — never NULL. The old
+        handle is closed after the swap (in-flight ops fail out) but
+        destroyed only at close(), so it is never freed under a live call."""
+        if self._closed:
+            raise InfiniStoreException("connection closed; create a new one")
         with self._lock:
             if self.is_connected:
                 return  # another thread already reconnected
+            # Build the replacement FIRST (raises on failure, state intact).
+            ip = _resolve_hostname(self.config.host_addr)
+            new_handle = lib.its_conn_create(
+                ip.encode(),
+                self.config.service_port,
+                self.config.connect_timeout_ms,
+                1 if self.config.enable_shm else 0,
+                self.config.op_timeout_ms,
+                self.config.pacing_rate_mbps,
+            )
+            if lib.its_conn_connect(new_handle) != 0:
+                lib.its_conn_destroy(new_handle)
+                raise InfiniStoreException(
+                    f"reconnect to {ip}:{self.config.service_port} failed"
+                )
             mrs = list(self._plain_mrs)
-            if self._handle is not None:
-                self._dead_shm_ranges += [
-                    (b.ctypes.data, b.nbytes) for b in self._shm_bufs
-                ]
-                lib.its_conn_close(self._handle)
-                self._dead_handles.append(self._handle)
-                self._handle = None
-                self._shm_bufs.clear()
-                self._plain_mrs.clear()
-                self.rdma_connected = False
-                self.tcp_connected = False
-            try:
-                self.connect()
-                for ptr, nbytes in mrs:
-                    self.register_mr(ptr, nbytes)
-            except BaseException:
-                # Keep the MR list so the NEXT attempt re-registers them;
-                # the connection stays in a retryable state.
-                self._plain_mrs = list(mrs)
-                raise
+            for ptr, nbytes in mrs:
+                if lib.its_conn_register_mr(
+                    new_handle, ctypes.c_void_p(ptr), nbytes
+                ) < 0:
+                    lib.its_conn_close(new_handle)
+                    lib.its_conn_destroy(new_handle)
+                    raise InfiniStoreException(
+                        "reconnect: re-registering memory regions failed"
+                    )
+            # Swap: from here every new op uses the fresh connection.
+            old = self._handle
+            self._handle = new_handle
+            self._dead_shm_ranges += [
+                (b.ctypes.data, b.nbytes) for b in self._shm_bufs
+            ] + list(self._segment_aliases)
+            self._shm_bufs.clear()
+            self._segment_aliases.clear()
+            self._plain_mrs = mrs
+            if old is not None:
+                lib.its_conn_close(old)  # in-flight ops fail out
+                self._dead_handles.append(old)
 
     def _require(self):
         if self._handle is None:
@@ -372,6 +401,18 @@ class InfinityConnection:
             if p == ptr:
                 del self._plain_mrs[i]
                 break
+
+    def _register_segment_alias(self, ptr: int, nbytes: int):
+        """Register ANOTHER connection's shm segment as a plain region here
+        (StripedConnection stripes share stripe 0's segment). Tracked
+        separately from _plain_mrs: the memory dies with its owner, so
+        reconnect() must NOT re-register it — the range goes dead instead,
+        and retries with pointers into it get the typed shm error."""
+        self._require()
+        if lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes) < 0:
+            raise InfiniStoreException("register memory region failed")
+        self._segment_aliases.append((ptr, nbytes))
+        self._prune_dead_shm(ptr, nbytes)
 
     def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
         """Allocate a staging buffer the server maps too (one-RTT data plane:
@@ -688,7 +729,9 @@ class StripedConnection:
         if buf is None:
             return None
         for c in self.conns[1:]:
-            c.register_mr(buf.ctypes.data, nbytes)
+            # Alias, not a plain MR: the segment belongs to stripe 0 and
+            # must not be re-registered by these stripes on reconnect.
+            c._register_segment_alias(buf.ctypes.data, nbytes)
         return buf
 
     # -- batched data plane: split across stripes ----------------------------

@@ -181,6 +181,9 @@ class Connection {
     // sync_roundtrip returns the reactor can never again touch the caller's
     // buffers (regions check the flag AFTER going odd — Dekker pairing).
     std::atomic<uint64_t> io_seq_{0};
+    // Abandoned one-RTT segment op: the reactor must fail the connection
+    // (see SyncState::seg_op).
+    std::atomic<bool> poison_{false};
 
     // Reactor-owned state.
     std::deque<std::unique_ptr<Request>> sendq_;

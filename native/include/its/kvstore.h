@@ -66,9 +66,9 @@ class KVStore {
     void commit(const std::string& key, BlockRef block);
 
     // Lookup + LRU touch. Returns nullptr when missing — AND, with a spill
-    // tier, when a spilled entry cannot be promoted back into RAM (the
-    // entry is then dropped; callers must treat present-then-null as a
-    // miss, not an invariant violation).
+    // tier, when a spilled entry cannot be promoted back into RAM right now
+    // (the entry and its bytes SURVIVE, still spilled; callers should
+    // surface resource pressure, not a miss).
     BlockRef get(const std::string& key);
     bool exists(const std::string& key) const;
 

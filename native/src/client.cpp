@@ -32,6 +32,11 @@ namespace its {
 // completion still has a live place to write (no use-after-free).
 struct Connection::SyncState {
     std::promise<void> prom;
+    // Set for one-RTT segment ops (kOpPutFrom/kOpGetInto): the SERVER moves
+    // bytes in the client's mapped segment, so an abandoned op cannot be
+    // made safe by client-side drains — the timeout POISONS the connection
+    // (see sync_roundtrip) and the segment views die with it.
+    bool seg_op = false;
     uint32_t status = kStatusUnavailable;
     std::vector<uint8_t> body;
     uint8_t* payload = nullptr;  // malloc'd; freed here unless the waiter takes it
@@ -505,6 +510,7 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
                                     std::vector<uint8_t>* body_out, uint8_t** payload_out,
                                     size_t* payload_size_out, int timeout_ms) {
     auto state = std::make_shared<SyncState>();
+    state->seg_op = req->op == kOpPutFrom || req->op == kOpGetInto;
     req->sync = state;
     auto fut = state->prom.get_future();
     if (submit(std::move(req)) != 0) return kStatusUnavailable;
@@ -533,6 +539,19 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
                 // Wait for THIS section to exit (any change: a later section
                 // entered after our store and so already sees the flag).
                 while (io_seq_.load() == s) std::this_thread::yield();
+            }
+            if (state->seg_op) {
+                // Segment-path op: the server reads/writes the mapped
+                // segment directly, so an in-flight request cannot be
+                // neutralized client-side. Poison the connection — the
+                // reactor fails everything and the caller must reallocate
+                // its alloc_shm_mr views (they never survive a dead
+                // connection anyway).
+                ITS_LOG_WARN("abandoned segment op; failing connection");
+                poison_.store(true);
+                uint64_t one = 1;
+                ssize_t rc = ::write(wake_fd_, &one, sizeof(one));
+                (void)rc;
             }
             return kStatusUnavailable;
         }
@@ -979,6 +998,7 @@ void Connection::reactor() {
     epoll_event events[kMaxEvents];
     bool ok = true;
     while (ok && !stop_.load(std::memory_order_relaxed)) {
+        if (poison_.load()) break;  // abandoned segment op: fail everything
         int n = epoll_wait(epoll_fd_, events, kMaxEvents, 200);
         if (n < 0) {
             if (errno == EINTR) continue;

@@ -64,8 +64,9 @@ bool KVStore::drop_oldest_spilled() {
 
 // Bring a spilled entry back into a RAM pool. Owns the entry's full
 // lifecycle: on success it is re-linked into the RAM LRU; on failure (RAM
-// unobtainable even after demoting colder entries) it is ERASED and nullptr
-// returned — a miss, cache semantics: recompute beats blocking the reactor.
+// unobtainable even after demoting colder entries) it stays SPILLED and
+// nullptr is returned — the caller surfaces resource pressure, the bytes
+// survive for a smaller or later read.
 BlockRef KVStore::promote(const std::string& key,
                           std::unordered_map<std::string, Entry>::iterator it) {
     Entry& e = it->second;
@@ -90,9 +91,13 @@ BlockRef KVStore::promote(const std::string& key,
         }
     }
     if (!got) {
+        // RAM unobtainable (e.g. a huge batch pinning every promoted block):
+        // KEEP the entry spilled — its bytes are intact and a smaller or
+        // later read can still serve it. Re-link as most-recent so the
+        // failed read does not also make it first in line to be dropped.
         ITS_LOG_WARN("spill: cannot promote %zu bytes (RAM exhausted)", size);
-        release_entry(e);
-        map_.erase(it);
+        spill_lru_.push_front(key);
+        e.lru_it = spill_lru_.begin();
         return nullptr;
     }
     auto block = std::make_shared<Block>(mm_, leases[0].ptr, size);

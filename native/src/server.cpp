@@ -589,7 +589,8 @@ bool Server::alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases) {
         // with reclaimable entries present. In-flight refs may keep some
         // freed entries' RAM pinned, so re-try as long as progress is
         // possible; evict_one() draining lru_ bounds the loop.
-        size_t need = size * n;
+        size_t bs = mm_->block_size();
+        size_t need = ((size + bs - 1) / bs) * bs * n;  // leases are block-granular
         while (mm_->total_bytes() - mm_->used_bytes() < need && kv_->evict_one()) {
         }
         ok = mm_->allocate(size, n, nullptr, leases);
@@ -774,10 +775,11 @@ void Server::handle_shm(Conn* c) {
             for (const auto& key : m.keys) {
                 BlockRef b = kv_->get(key);  // LRU touch
                 if (b == nullptr) {
-                    // exists() passed, but a spilled entry can fail
-                    // promotion (RAM exhausted) — that is a miss now.
+                    // Spilled entry unpromotable right now (RAM pinned by
+                    // this batch): the data survives — resource pressure,
+                    // not a miss.
                     c->reset_read();
-                    send_status(c, kStatusKeyNotFound);
+                    send_status(c, kStatusOutOfMemory);
                     return;
                 }
                 if (b->size() > m.block_size) {
@@ -904,9 +906,9 @@ void Server::handle_shm(Conn* c) {
             blocks.reserve(m.keys.size());
             for (size_t i = 0; i < m.keys.size(); i++) {
                 BlockRef b = kv_->get(m.keys[i]);  // LRU touch
-                if (b == nullptr) {  // spilled + unpromotable = miss
+                if (b == nullptr) {  // spilled + unpromotable: pressure, not a miss
                     c->reset_read();
-                    send_status(c, kStatusKeyNotFound);
+                    send_status(c, kStatusOutOfMemory);
                     return;
                 }
                 uint64_t off = m.offsets[i];
@@ -977,9 +979,9 @@ void Server::handle_get_batch(Conn* c) {
     uint64_t total = 0;
     for (const auto& key : m.keys) {
         BlockRef b = kv_->get(key);  // touches LRU (reference :629-634)
-        if (b == nullptr) {  // spilled + unpromotable = miss
+        if (b == nullptr) {  // spilled + unpromotable: pressure, not a miss
             c->reset_read();
-            send_status(c, kStatusKeyNotFound);
+            send_status(c, kStatusOutOfMemory);
             return;
         }
         // ...and each stored size must fit the client's block stride (:620-624).

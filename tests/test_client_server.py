@@ -622,3 +622,72 @@ def test_striped_connection_roundtrip():
     asyncio.run(c.write_cache_async([("tiny", 0)], block, src.ctypes.data))
     c.close()
     srv.stop()
+
+
+def test_closed_connection_is_not_resurrected():
+    """close() is final: auto_reconnect must never silently reopen a
+    connection the application tore down."""
+    srv = its.start_local_server(prealloc_bytes=16 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                         log_level="error", auto_reconnect=True)
+    )
+    c.connect()
+    assert c.check_exist("x") is False
+    c.close()
+    with pytest.raises(its.InfiniStoreException, match="not connected"):
+        c.check_exist("x")
+    assert c._handle is None  # really not resurrected
+    srv.stop()
+
+
+def test_striped_reconnect_does_not_reregister_foreign_segment():
+    """Stripes 1..N register stripe 0's shm segment as an alias; after a
+    restart + reconnect the alias must NOT come back (the segment is gone) —
+    ops using the stale pointer get a clean error, never a crash."""
+    import time
+
+    srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=16 << 10)
+    port = srv.port
+    c = its.StripedConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error"),
+        streams=3,
+    )
+    c.connect()
+    seg = c.alloc_shm_mr(4 * 16 << 10)
+    if seg is None:
+        pytest.skip("shm unavailable")
+    stale_ptr = seg.ctypes.data
+    seg[:] = 7
+    pairs = [(f"fs-{i}", i * (16 << 10)) for i in range(4)]
+    asyncio.run(c.write_cache_async(pairs, 16 << 10, stale_ptr))
+
+    srv.stop()
+    for _ in range(20):
+        try:
+            srv2 = its.start_local_server(
+                host="127.0.0.1", service_port=port,
+                prealloc_bytes=32 << 20, block_bytes=16 << 10,
+            )
+            break
+        except its.InfiniStoreException:
+            time.sleep(0.1)
+    else:
+        pytest.skip("could not rebind the port")
+    with pytest.raises(its.InfiniStoreException):
+        for _ in range(10):
+            asyncio.run(c.write_cache_async(pairs, 16 << 10, stale_ptr))
+    c.reconnect()
+    # The stale segment pointer is no longer a registered region anywhere —
+    # a clean submit error (or typed shm error), never memory access.
+    with pytest.raises(its.InfiniStoreException):
+        asyncio.run(c.write_cache_async(pairs, 16 << 10, stale_ptr))
+    # Fresh segment works end to end.
+    seg2 = c.alloc_shm_mr(4 * 16 << 10)
+    seg2[:] = 9
+    asyncio.run(c.write_cache_async(pairs, 16 << 10, seg2.ctypes.data))
+    seg2[:] = 0
+    asyncio.run(c.read_cache_async(pairs, 16 << 10, seg2.ctypes.data))
+    assert (seg2 == 9).all()
+    c.close()
+    srv2.stop()

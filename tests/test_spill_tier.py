@@ -181,3 +181,36 @@ def test_bad_spill_dir_disables_tier_not_server():
     assert c.get_stats()["spill"]["capacity"] == 0  # tier off, server fine
     c.close()
     srv.stop()
+
+
+def test_unpromotable_batch_errors_but_data_survives():
+    """A single batch read of more spilled data than RAM can hold must fail
+    with a resource error — and the spilled bytes must SURVIVE, readable by
+    smaller batches afterwards (a failed promotion used to erase entries)."""
+    srv = _server()  # 4MB RAM / 64MB spill
+    c = _connect(srv)
+    n = 128  # 8MB of keys; >=64 spilled
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    for i in range(n):
+        c.write_cache([(f"up-{i}", i * BLOCK)], BLOCK, src.ctypes.data)
+    assert c.get_stats()["spill"]["entries"] > 0
+
+    # One batch spanning everything: promoted blocks get pinned by the batch
+    # refs until RAM runs out -> typed error, NOT a silent miss or crash.
+    dst = np.zeros(n * BLOCK, dtype=np.uint8)
+    c.register_mr(dst)
+    pairs = [(f"up-{i}", i * BLOCK) for i in range(n)]
+    with pytest.raises(its.InfiniStoreException) as ei:
+        c.read_cache(pairs, BLOCK, dst.ctypes.data)
+    assert "404" not in str(ei.value), "resource pressure must not read as a miss"
+
+    # Every key is still present and readable in small batches.
+    small = np.zeros(BLOCK, dtype=np.uint8)
+    c.register_mr(small)
+    for i in range(n):
+        assert c.check_exist(f"up-{i}"), f"up-{i} destroyed by failed promotion"
+        c.read_cache([(f"up-{i}", 0)], BLOCK, small.ctypes.data)
+        assert np.array_equal(small, src[i * BLOCK : (i + 1) * BLOCK])
+    c.close()
+    srv.stop()
