@@ -1,0 +1,62 @@
+"""The reference-shaped module surface, exercised exactly as reference code
+uses it: register_server(loop, ServerConfig) -> client traffic ->
+get_kvmap_len / evict_cache / purge_kv_map -> unregister_server
+(reference lib.py:177-249, server.py flow). A reference user's server script
+should run against this package with only the import changed.
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_reference_module_surface_end_to_end():
+    port = _free_port()
+    cfg = its.ServerConfig(
+        host="127.0.0.1",
+        service_port=port,
+        manage_port=_free_port(),
+        prealloc_size=1,  # GB-granular, like the reference
+        minimal_allocate_size=64,
+        pin_memory=False,
+        log_level="error",
+    )
+    loop = asyncio.new_event_loop()  # accepted for drop-in compat, unused
+    its.register_server(loop, cfg)
+    try:
+        # Double-registration is an error (one server per process, like the
+        # reference's module-global kv_map).
+        with pytest.raises(its.InfiniStoreException):
+            its.register_server(loop, cfg)
+
+        conn = its.InfinityConnection(
+            its.ClientConfig(host_addr="127.0.0.1", service_port=port, log_level="error")
+        )
+        conn.connect()
+        data = np.random.randint(0, 256, size=64 << 10, dtype=np.uint8)
+        for i in range(5):
+            conn.tcp_write_cache(f"ref-{i}", data.ctypes.data, data.nbytes)
+        assert its.get_kvmap_len() == 5
+        # Thresholds far above usage: nothing to evict.
+        assert its.evict_cache(0.8, 0.95) == 0
+        assert its.get_server_stats()["kvmap_len"] == 5
+        assert its.purge_kv_map() == 5
+        assert its.get_kvmap_len() == 0
+        conn.close()
+    finally:
+        its.unregister_server()
+        loop.close()
+    with pytest.raises(its.InfiniStoreException):
+        its.get_kvmap_len()  # no server registered anymore
