@@ -20,6 +20,7 @@ from .lib import (
     InfiniStoreException,
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
+    InfiniStoreResourcePressure,
     InfinityConnection,
     StripedConnection,
     Logger,

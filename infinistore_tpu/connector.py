@@ -21,7 +21,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .lib import InfiniStoreKeyNotFound, InfiniStoreNoMatch
+from .lib import (
+    InfiniStoreKeyNotFound,
+    InfiniStoreNoMatch,
+    InfiniStoreResourcePressure,
+)
 from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter
 from .tpu.paged import PagedKVCacheSpec
 from .tpu.staging import HostStagingPool
@@ -191,6 +195,11 @@ class KVConnector:
         except InfiniStoreKeyNotFound:
             # Blocks raced away (eviction/delete between lookup and read):
             # cache semantics — the engine just recomputes.
+            return list(caches), 0
+        except InfiniStoreResourcePressure:
+            # Store RAM too pressured to promote/serve right now (507; the
+            # spilled data survives). Recompute beats stalling the engine;
+            # transport errors still propagate (lookup()'s contract).
             return list(caches), 0
         return out, n
 

@@ -50,6 +50,13 @@ class InfiniStoreKeyNotFound(InfiniStoreException):
     """Typed miss for read paths (reference lib.py:33)."""
 
 
+class InfiniStoreResourcePressure(InfiniStoreException):
+    """The store could not serve the op RIGHT NOW (507): e.g. a batch read
+    whose promoted spill blocks exceed RAM. The data survives — retry
+    smaller/later, or recompute; distinct from InfiniStoreKeyNotFound
+    (data absent) and from transport failure (base class)."""
+
+
 class InfiniStoreNoMatch(InfiniStoreException):
     """get_match_last_index found no matching prefix — a semantic miss,
     distinct from a transport/timeout failure (which raises the base
@@ -233,9 +240,9 @@ class InfinityConnection:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def connect(self):
-        """Connect to the store (blocking; bounded by connect_timeout_ms).
-        Attempts the same-host shm handshake when enable_shm is set."""
+    def _new_native_handle(self):
+        """Create + connect a native handle from self.config (shared by
+        connect() and reconnect(); one place to grow the C signature)."""
         ip = _resolve_hostname(self.config.host_addr)
         handle = lib.its_conn_create(
             ip.encode(),
@@ -251,13 +258,21 @@ class InfinityConnection:
             raise InfiniStoreException(
                 f"failed to connect to {ip}:{self.config.service_port} (rc={rc})"
             )
-        self._handle = handle
+        return handle
+
+    def _mark_connected(self):
         self._ever_connected = True
         self._closed = False
         if self.config.connection_type == TYPE_RDMA:
             self.rdma_connected = True
         else:
             self.tcp_connected = True
+
+    def connect(self):
+        """Connect to the store (blocking; bounded by connect_timeout_ms).
+        Attempts the same-host shm handshake when enable_shm is set."""
+        self._handle = self._new_native_handle()
+        self._mark_connected()
 
     @property
     def shm_active(self) -> bool:
@@ -272,20 +287,21 @@ class InfinityConnection:
         """Tear down the connection: stops the native reactor, unmaps shm
         segments (invalidating alloc_shm_mr views), releases registrations.
         ``close_connection`` is the reference-compatible alias."""
-        self._closed = True  # a closed connection must stay closed
-        if self._handle is not None:
-            lib.its_conn_close(self._handle)
-            lib.its_conn_destroy(self._handle)
-            self._handle = None
-            self._shm_bufs.clear()  # views are dead once the segment unmaps
-            self._plain_mrs.clear()
-            self._segment_aliases.clear()
-            self.rdma_connected = False
-            self.tcp_connected = False
-        for h in self._dead_handles:  # parked by reconnect(); see __init__
-            lib.its_conn_destroy(h)
-        self._dead_handles.clear()
-        self._dead_shm_ranges.clear()
+        with self._lock:  # serialized against reconnect()/register_mr()
+            self._closed = True  # a closed connection must stay closed
+            if self._handle is not None:
+                lib.its_conn_close(self._handle)
+                lib.its_conn_destroy(self._handle)
+                self._handle = None
+                self._shm_bufs.clear()  # views die once the segment unmaps
+                self._plain_mrs.clear()
+                self._segment_aliases.clear()
+                self.rdma_connected = False
+                self.tcp_connected = False
+            for h in self._dead_handles:  # parked by reconnect(); see __init__
+                lib.its_conn_destroy(h)
+            self._dead_handles.clear()
+            self._dead_shm_ranges.clear()
 
     # reference name (lib.py:380)
     close_connection = close
@@ -315,26 +331,13 @@ class InfinityConnection:
         out when that handle closes) or the new one — never NULL. The old
         handle is closed after the swap (in-flight ops fail out) but
         destroyed only at close(), so it is never freed under a live call."""
-        if self._closed:
-            raise InfiniStoreException("connection closed; create a new one")
         with self._lock:
+            if self._closed:  # checked under the lock: close() is final
+                raise InfiniStoreException("connection closed; create a new one")
             if self.is_connected:
                 return  # another thread already reconnected
             # Build the replacement FIRST (raises on failure, state intact).
-            ip = _resolve_hostname(self.config.host_addr)
-            new_handle = lib.its_conn_create(
-                ip.encode(),
-                self.config.service_port,
-                self.config.connect_timeout_ms,
-                1 if self.config.enable_shm else 0,
-                self.config.op_timeout_ms,
-                self.config.pacing_rate_mbps,
-            )
-            if lib.its_conn_connect(new_handle) != 0:
-                lib.its_conn_destroy(new_handle)
-                raise InfiniStoreException(
-                    f"reconnect to {ip}:{self.config.service_port} failed"
-                )
+            new_handle = self._new_native_handle()
             mrs = list(self._plain_mrs)
             for ptr, nbytes in mrs:
                 if lib.its_conn_register_mr(
@@ -357,6 +360,7 @@ class InfinityConnection:
             if old is not None:
                 lib.its_conn_close(old)  # in-flight ops fail out
                 self._dead_handles.append(old)
+            self._mark_connected()
 
     def _require(self):
         if self._handle is None:
@@ -378,20 +382,25 @@ class InfinityConnection:
     def register_mr(self, arg: Union[int, np.ndarray], size: Optional[int] = None):
         """Pin + register a local staging region for batched zero-copy I/O
         (reference register_mr, lib.py:581-616)."""
-        self._require()
         ptr, nbytes = _extract_ptr_size(arg, size)
-        ret = lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes)
-        if ret < 0:
-            raise InfiniStoreException("register memory region failed")
-        self._plain_mrs.append((ptr, nbytes))
-        self._prune_dead_shm(ptr, nbytes)
-        return ret
+        with self._lock:  # a registration racing reconnect() must not be lost
+            self._require()
+            ret = lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes)
+            if ret < 0:
+                raise InfiniStoreException("register memory region failed")
+            self._plain_mrs.append((ptr, nbytes))
+            self._prune_dead_shm(ptr, nbytes)
+            return ret
 
     def unregister_mr(self, arg: Union[int, np.ndarray]):
         """Drop a transfer-scoped registration (pair with register_mr for
         short-lived staging buffers; in-flight ops are unaffected)."""
-        self._require()
         ptr, _ = _extract_ptr_size(arg, 0 if isinstance(arg, int) else None)
+        with self._lock:
+            self._require()
+            return self._unregister_locked(ptr)
+
+    def _unregister_locked(self, ptr: int):
         if lib.its_conn_unregister_mr(self._handle, ctypes.c_void_p(ptr)) != 0:
             # A silent miss would leak the region (and its mlock) forever.
             raise InfiniStoreException(
@@ -401,6 +410,7 @@ class InfinityConnection:
             if p == ptr:
                 del self._plain_mrs[i]
                 break
+        self._segment_aliases = [(p, n) for p, n in self._segment_aliases if p != ptr]
 
     def _register_segment_alias(self, ptr: int, nbytes: int):
         """Register ANOTHER connection's shm segment as a plain region here
@@ -408,11 +418,26 @@ class InfinityConnection:
         separately from _plain_mrs: the memory dies with its owner, so
         reconnect() must NOT re-register it — the range goes dead instead,
         and retries with pointers into it get the typed shm error."""
-        self._require()
-        if lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes) < 0:
-            raise InfiniStoreException("register memory region failed")
-        self._segment_aliases.append((ptr, nbytes))
-        self._prune_dead_shm(ptr, nbytes)
+        with self._lock:
+            self._require()
+            if lib.its_conn_register_mr(self._handle, ctypes.c_void_p(ptr), nbytes) < 0:
+                raise InfiniStoreException("register memory region failed")
+            self._segment_aliases.append((ptr, nbytes))
+            self._prune_dead_shm(ptr, nbytes)
+
+    def _invalidate_segment_aliases(self):
+        """The owner of the aliased segment reconnected (its mapping is
+        gone): drop this connection's alias registrations and mark the
+        ranges dead so stale-pointer retries get the typed shm error."""
+        with self._lock:
+            for ptr, nbytes in self._segment_aliases:
+                try:
+                    if self._handle is not None:
+                        self._unregister_locked(ptr)
+                except InfiniStoreException:
+                    pass  # already gone natively; the dead range still guards
+                self._dead_shm_ranges.append((ptr, nbytes))
+            self._segment_aliases = []
 
     def alloc_shm_mr(self, nbytes: int) -> Optional[np.ndarray]:
         """Allocate a staging buffer the server maps too (one-RTT data plane:
@@ -465,6 +490,10 @@ class InfinityConnection:
                 fut.set_result(code)
             elif code == wire.STATUS_KEY_NOT_FOUND:
                 fut.set_exception(InfiniStoreKeyNotFound(f"{op_name}: key not found"))
+            elif code == wire.STATUS_OOM:
+                fut.set_exception(InfiniStoreResourcePressure(
+                    f"{op_name}: store out of memory (data may survive spilled)"
+                ))
             else:
                 fut.set_exception(InfiniStoreException(f"{op_name} failed: status={code}"))
 
@@ -535,6 +564,10 @@ class InfinityConnection:
             return wire.STATUS_OK
         if rc == -wire.STATUS_KEY_NOT_FOUND:
             raise InfiniStoreKeyNotFound(f"{op_name}: key not found")
+        if rc == -wire.STATUS_OOM:
+            raise InfiniStoreResourcePressure(
+                f"{op_name}: store out of memory (data may survive spilled)"
+            )
         raise InfiniStoreException(f"{op_name} failed: status={-rc}")
 
     @_reconnecting(ptr_arg=2)
@@ -546,12 +579,16 @@ class InfinityConnection:
         (pipelining many ops). The ctypes call releases the GIL.
 
         Timeout (``op_timeout_ms``, default 30s): raises status 503 and
-        abandons the wait. The native layer guarantees the abandoned op never
-        touches the buffer again — an unsent request is dropped, a late
-        response is drained into scratch (never scattered into ``ptr``), and
-        a request half-streamed from the buffer fails the connection rather
-        than read it — so the buffer may be freed after the exception
-        (unregister_mr first if it was explicitly registered)."""
+        abandons the wait. For plain registered buffers the native layer
+        guarantees the abandoned op never touches the buffer again — an
+        unsent request is dropped, a late response is drained into scratch
+        (never scattered into ``ptr``), and a request half-streamed from the
+        buffer fails the connection rather than read it — so the buffer may
+        be freed after the exception (unregister_mr first if it was
+        explicitly registered). For ``alloc_shm_mr`` SEGMENT buffers that
+        guarantee is impossible (the server moves the bytes in the shared
+        mapping), so a timed-out segment op FAILS THE CONNECTION
+        deterministically; reallocate segment views after reconnecting."""
         return self._batch_op_sync(
             lib.its_conn_put_batch_sync, blocks, block_size, ptr, "write_cache"
         )
@@ -700,9 +737,17 @@ class StripedConnection:
         a restarted store is a cold cache. With auto_reconnect configured,
         sync ops (stripe 0) self-heal; batched async callers invoke this
         after a failure — without it a restart left stripes 1..N dead."""
+        owner_died = not self.conns[0].is_connected
         for c in self.conns:
             if not c.is_connected:
                 c.reconnect()
+        if owner_died:
+            # Stripe 0 owned the shm segments; its reconnect unmapped them.
+            # Sibling stripes may still be alive with live registrations
+            # over the dead mapping — drop those so stale-pointer ops get a
+            # clean error instead of touching unmapped memory.
+            for c in self.conns[1:]:
+                c._invalidate_segment_aliases()
 
     @property
     def shm_active(self) -> bool:

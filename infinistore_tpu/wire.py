@@ -44,6 +44,7 @@ STATUS_RETRY = 408
 STATUS_INTERNAL = 500
 STATUS_UNAVAILABLE = 503
 STATUS_OUT_OF_MEMORY = 507
+STATUS_OOM = STATUS_OUT_OF_MEMORY
 
 _REQ_HEADER = struct.Struct("<IBI")  # magic, op, body_size (9 bytes)
 _RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 bytes)

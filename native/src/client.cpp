@@ -552,6 +552,13 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
                 uint64_t one = 1;
                 ssize_t rc = ::write(wake_fd_, &one, sizeof(one));
                 (void)rc;
+                // Wait for the reactor to actually fail the connection so
+                // the caller observes a DETERMINISTIC state (is_connected
+                // false -> recovery paths take the reconnect branch, never
+                // a racy retry of the poisoned op). Bounded: the reactor
+                // checks poison_ every loop tick.
+                for (int spin = 0; connected_.load() && spin < 4000; spin++)
+                    std::this_thread::sleep_for(std::chrono::milliseconds(1));
             }
             return kStatusUnavailable;
         }
@@ -677,6 +684,7 @@ void Connection::fail_all(int code) {
 }
 
 bool Connection::flush_send() {
+    if (poison_.load()) return false;  // abandoned segment op: stop sending
     static const std::vector<iovec> kNoPayload;
     while (!sendq_.empty()) {
         Request* req = sendq_.front().get();
@@ -744,6 +752,7 @@ bool Connection::flush_send() {
 }
 
 bool Connection::read_ready() {
+    if (poison_.load()) return false;
     while (true) {
         if (!resp_in_progress_) {
             ssize_t r = read(fd_, reinterpret_cast<char*>(&rhdr_) + rhdr_got_,

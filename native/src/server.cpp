@@ -1012,9 +1012,13 @@ void Server::handle_simple(Conn* c) {
     switch (c->hdr.op) {
         case kOpTcpGet: {
             KeyMeta m = KeyMeta::decode(c->body.data(), c->body.size());
+            bool present = kv_->exists(m.key);
             BlockRef b = kv_->get(m.key);
             if (b == nullptr) {
-                status = kStatusKeyNotFound;
+                // Present-but-unpromotable (spill tier, RAM pressure) is
+                // 507 like the batch paths — the data survives; only a
+                // truly absent key is 404.
+                status = present ? kStatusOutOfMemory : kStatusKeyNotFound;
             } else {
                 payload.push_back(iovec{b->data(), b->size()});
                 refs.push_back(std::move(b));
