@@ -35,9 +35,12 @@ under the driver) with device-transfer ceilings measured as a STRICT SUBSET
 of the pipeline's own work (same gather, same bytes, same window depth, no
 network) — so achieved <= ceiling by construction and achieved/ceiling is
 the figure of merit. Also p50/p99 single-block fetch latency at 4KB / 64KB
-(BASELINE.json's headline latency metric) on the sync path (read_cache —
-the latency API; the async path pays ~2 extra context switches for
-pipelining, reported alongside).
+(BASELINE.json's headline latency metric): the p50/p99_fetch_* keys keep
+their r1/r2 meaning (the asyncio path) for round-over-round comparability;
+the sync_* keys are the r3 low-latency API (read_cache — the calling thread
+blocks on the native completion, skipping the asyncio bridge's ~2 context
+switches per op). Plus the 256-key prefix-match p50 (BASELINE config 3),
+shaped striping (where stripes win), and the spill tier's cold/hot rates.
 """
 
 import json
