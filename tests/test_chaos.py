@@ -19,13 +19,14 @@ ROUNDS = 4
 OPS_PER_ROUND = 60
 
 
-def test_ops_stay_correct_across_repeated_restarts():
+@pytest.mark.parametrize("enable_shm", [False, True], ids=["socket", "shm"])
+def test_ops_stay_correct_across_repeated_restarts(enable_shm):
     srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=BLOCK)
     port = srv.port
     c = its.InfinityConnection(
         its.ClientConfig(
             host_addr="127.0.0.1", service_port=port, log_level="error",
-            enable_shm=False, auto_reconnect=True, op_timeout_ms=2000,
+            enable_shm=enable_shm, auto_reconnect=True, op_timeout_ms=2000,
             connect_timeout_ms=1000,
         )
     )
