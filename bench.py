@@ -314,6 +314,7 @@ def _tpu_connector_gbps(its, np, conn):
     import jax.numpy as jnp
 
     from infinistore_tpu.connector import KVConnector
+    from infinistore_tpu.tpu.layerwise import _device_put_copies
     from infinistore_tpu.tpu.paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
     from infinistore_tpu.tpu.staging import StagedTransfer
 
@@ -366,67 +367,84 @@ def _tpu_connector_gbps(its, np, conn):
             staged.popleft().wait()
         return time.perf_counter() - t0
 
+    # Mirror the reader's pipeline shape exactly (layerwise.py read): R
+    # staging regions, one combined K+V device_put per layer, region reuse
+    # gated on the occupant's UPLOAD having landed (never its scatters).
+    R_regions = kvc._reader.regions.count
+
     def h2d_stage_once(hosts) -> float:
-        """The reader's device stage, verbatim (layerwise.py read):
-        device_put each layer's K/V host blocks + scatter into the paged
-        cache, blocking only at the end (uploads overlap). Scatter donates
+        """The reader's device stage, verbatim (layerwise.py read): ONE
+        device_put of the layer's packed K+V blocks + two scatters into the
+        paged cache, with the reader's region-reuse barrier structure
+        (block on the upload dispatched R layers earlier). Scatter donates
         its cache argument, so fresh targets are allocated untimed — exactly
         as the load benchmark scatters into fresh zero caches."""
         targets = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
         jax.block_until_ready(targets)
         out = []
+        uploads = {}
         t0 = time.perf_counter()
         for l in range(spec.num_layers):
-            k_host, v_host = hosts[l]
-            k_blocks = jax.device_put(k_host)
-            v_blocks = jax.device_put(v_host)
+            occupant = l - R_regions
+            if occupant >= 0:
+                jax.block_until_ready(uploads.pop(occupant))
+                if not _device_put_copies():
+                    jax.block_until_ready(out[occupant])
+            kv_dev = jax.device_put(hosts[l])
+            uploads[l] = kv_dev
             k_cache, v_cache = targets[l]
             out.append((
-                scatter_blocks(k_cache, ids_dev, k_blocks),
-                scatter_blocks(v_cache, ids_dev, v_blocks),
+                scatter_blocks(k_cache, ids_dev, kv_dev[:n_blocks]),
+                scatter_blocks(v_cache, ids_dev, kv_dev[n_blocks:]),
             ))
+        jax.block_until_ready(list(uploads.values()))
         jax.block_until_ready(out)
         return time.perf_counter() - t0
 
     # Warmup compiles gather/scatter; host arrays for the H2D stage come from
-    # one untimed D2H pass (matching the byte layout the reader uploads).
+    # one untimed D2H pass, packed K-then-V per layer — the exact byte layout
+    # the reader's single per-layer upload uses.
     d2h_stage_once()
     shape = (n_blocks, *spec.block_shape)
     hosts = [
-        (
+        np.concatenate([
             np.asarray(gather_blocks(caches[l][0], ids_dev)).reshape(shape),
             np.asarray(gather_blocks(caches[l][1], ids_dev)).reshape(shape),
-        )
+        ])
         for l in range(spec.num_layers)
     ]
     h2d_stage_once(hosts)
 
-    def best_of(fn, reps=5):
-        best = float("inf")
-        for _ in range(reps):
-            best = min(best, fn())
-        return best
-
-    d2h_dt = best_of(d2h_stage_once)
-    h2d_dt = best_of(lambda: h2d_stage_once(hosts))
-
-    asyncio.run(kvc.save(tokens, caches, ids))  # warmup (jit compile)
-    best_save = float("inf")
-    for _ in range(3):
+    def save_once() -> float:
         t0 = time.perf_counter()
         asyncio.run(kvc.save(tokens, caches, ids))
-        best_save = min(best_save, time.perf_counter() - t0)
+        return time.perf_counter() - t0
 
-    fresh = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
-    out, loaded = asyncio.run(kvc.load(tokens, fresh, ids))  # warmup
-    assert loaded == n_blocks, f"load hit {loaded}/{n_blocks}"
-    best_load = float("inf")
-    for _ in range(3):
+    def load_once() -> float:
         fresh = [(jnp.zeros_like(k), jnp.zeros_like(v)) for k, v in caches]
+        jax.block_until_ready(fresh)
         t0 = time.perf_counter()
         out, loaded = asyncio.run(kvc.load(tokens, fresh, ids))
         jax.block_until_ready(out)
-        best_load = min(best_load, time.perf_counter() - t0)
+        load_once.out, load_once.loaded = out, loaded
+        return time.perf_counter() - t0
+
+    asyncio.run(kvc.save(tokens, caches, ids))  # warmup (jit compile)
+    load_once()  # warmup
+    assert load_once.loaded == n_blocks, f"load hit {load_once.loaded}/{n_blocks}"
+
+    # Interleaved sampling: this host swings ~2x between runs, so ceiling and
+    # pipeline must be sampled round-robin with EQUAL counts — separate
+    # min-of-N blocks would let one side harvest a fast period the other
+    # never saw, and the ratio (the figure of merit) would be noise, not
+    # pipeline quality.
+    d2h_dt = h2d_dt = best_save = best_load = float("inf")
+    for _ in range(4):
+        d2h_dt = min(d2h_dt, d2h_stage_once())
+        best_save = min(best_save, save_once())
+        h2d_dt = min(h2d_dt, h2d_stage_once(hosts))
+        best_load = min(best_load, load_once())
+    out = load_once.out
     # Spot-verify one layer's blocks made the round trip.
     k_ref = np.asarray(caches[3][0][ids[5]], np.float32)
     k_got = np.asarray(out[3][0][ids[5]], np.float32)
@@ -435,14 +453,14 @@ def _tpu_connector_gbps(its, np, conn):
     # Noise guard: the ceiling does a strict subset of the pipeline's work,
     # so achieved > ceiling can only be timing noise — take more ceiling
     # samples until the invariant holds (min-time estimator converges).
-    for _ in range(3):
-        if nbytes / best_save / (1 << 30) <= nbytes / d2h_dt / (1 << 30):
+    for _ in range(5):
+        if best_save >= d2h_dt:
             break
-        d2h_dt = min(d2h_dt, best_of(d2h_stage_once))
-    for _ in range(3):
-        if nbytes / best_load / (1 << 30) <= nbytes / h2d_dt / (1 << 30):
+        d2h_dt = min(d2h_dt, d2h_stage_once())
+    for _ in range(5):
+        if best_load >= h2d_dt:
             break
-        h2d_dt = min(h2d_dt, best_of(lambda: h2d_stage_once(hosts)))
+        h2d_dt = min(h2d_dt, h2d_stage_once(hosts))
 
     per_layer_d2h_ms = d2h_dt / spec.num_layers * 1e3
     per_layer_h2d_ms = h2d_dt / spec.num_layers * 1e3

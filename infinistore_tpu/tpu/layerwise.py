@@ -26,6 +26,14 @@ from .staging import HostStagingPool
 
 KeyFn = Callable[[int, str, int], str]  # (layer, "k"|"v", block_index) -> key
 
+# On TPU, device_put always copies host bytes into HBM, so "upload ready"
+# means the staging region is free. On CPU (the test backend), device_put of
+# an aligned numpy view is ZERO-COPY — the device array aliases the staging
+# memory, and scatters read through the alias until they execute — so region
+# reuse must additionally wait for the occupant's scatters.
+def _device_put_copies() -> bool:
+    return jax.default_backend() != "cpu"
+
 
 def kv_block_key(model: str, chain_hash: str, layer: int, kind: str, block: int) -> str:
     """Default key scheme: model/chain-hash/layer/k|v/block."""
@@ -33,10 +41,12 @@ def kv_block_key(model: str, chain_hash: str, layer: int, kind: str, block: int)
 
 
 class _LayerRegions:
-    """Read-staging layout: region r holds a layer's K blocks then V blocks,
-    each block in its own slot. The region count adapts to the pool size
-    (>= 2 — double buffering — up to 8), deepening the fetch/H2D pipeline
-    when the pool affords it."""
+    """Read-staging layout: region r holds one layer's K blocks immediately
+    followed by its V blocks — a single contiguous span, so the whole layer
+    uploads to the device as ONE transfer (per-transfer fixed cost is the
+    dominant H2D cost on tunneled/remote TPU hosts). The region count adapts
+    to the pool size (>= 2 — double buffering — up to 8), deepening the
+    fetch/H2D pipeline when the pool affords it."""
 
     def __init__(self, pool: HostStagingPool, spec: PagedKVCacheSpec, max_blocks: int):
         if spec.block_nbytes > pool.block_size:
@@ -55,12 +65,14 @@ class _LayerRegions:
                 f"{pool.block_size}B, have {pool.num_slots}"
             )
 
-    def slots(self, region: int, kind: str, n: int) -> List[int]:
-        base = region * 2 * self.max_blocks + (0 if kind == "k" else self.max_blocks)
-        return list(range(base, base + n))
+    def base_offset(self, region: int) -> int:
+        """Byte offset of a region's contiguous K+V span."""
+        return self.pool.slot_offset(region * 2 * self.max_blocks)
 
-    def offsets(self, region: int, kind: str, n: int) -> List[int]:
-        return [self.pool.slot_offset(s) for s in self.slots(region, kind, n)]
+    def kv_view(self, region: int, n: int, nbytes_per_block: int):
+        """Zero-copy view of the region's packed K+V span (2*n blocks)."""
+        off = self.base_offset(region)
+        return self.pool.buf[off : off + 2 * n * nbytes_per_block]
 
 
 class LayerwiseKVWriter:
@@ -209,35 +221,46 @@ class LayerwiseKVReader:
         ids_dev = jax.numpy.asarray(block_ids, dtype=jax.numpy.int32)
         pool = self.regions.pool
         bn = self.spec.block_nbytes
+        dt = np.dtype(jax.numpy.dtype(self.spec.dtype))
 
         def fetch(layer: int):
-            region = layer % self.regions.count
-            k_off = self.regions.offsets(region, "k", 1)[0]
-            v_off = self.regions.offsets(region, "v", 1)[0]
+            # K blocks then V blocks packed into one contiguous region span,
+            # so the layer later uploads as a single device transfer.
+            base = self.regions.base_offset(layer % self.regions.count)
             blocks = [
-                (key_fn(layer, "k", i), k_off + i * bn) for i in range(n)
+                (key_fn(layer, "k", i), base + i * bn) for i in range(n)
             ] + [
-                (key_fn(layer, "v", i), v_off + i * bn) for i in range(n)
+                (key_fn(layer, "v", i), base + (n + i) * bn) for i in range(n)
             ]
             return asyncio.ensure_future(
                 self.conn.read_cache_async(blocks, bn, pool.base_ptr)
             )
 
-        # Pipeline: with R regions, keep W = R//2 network fetches in flight
-        # ahead of device consumption; a region is reused only after its
-        # previous occupant's H2D + scatter completed (checked R-W layers
-        # later, so several H2D uploads overlap instead of serializing —
-        # a large win when device transfers ride a tunnel or PCIe queue).
+        # Pipeline: with R regions, keep W = R-2 network fetches in flight
+        # ahead of device consumption. A region is reused only once its
+        # previous occupant's UPLOAD (the single K+V device_put) has landed —
+        # never its scatters, which queue on the device and must not gate the
+        # host loop. The barrier targets a transfer dispatched W layers ago,
+        # so several H2D uploads stay in flight instead of serializing — the
+        # decisive factor when device transfers ride a tunnel or PCIe queue.
         R = self.regions.count
-        W = max(1, R // 2)
+        W = max(1, R - 2)
         out: List[Tuple[jax.Array, jax.Array]] = list(caches)
         fetches = {}
+        uploads = {}
+
+        copies = _device_put_copies()
 
         def start(f: int):
             if f < num_layers and f not in fetches:
                 occupant = f - R
                 if occupant >= 0:
-                    jax.block_until_ready(out[occupant])  # region now free
+                    # Region free once the device consumed its bytes.
+                    jax.block_until_ready(uploads.pop(occupant))
+                    if not copies:
+                        # Zero-copy backend: the upload aliases the region;
+                        # only the scatters' completion frees it.
+                        jax.block_until_ready(out[occupant])
                 fetches[f] = fetch(f)
 
         try:
@@ -246,19 +269,18 @@ class LayerwiseKVReader:
             for layer in range(num_layers):
                 await fetches.pop(layer)
                 region = layer % R
-                shape = (n, *self.spec.block_shape)
-                k_host = pool.slot_view(self.regions.slots(region, "k", 1)[0], n * bn)
-                v_host = pool.slot_view(self.regions.slots(region, "v", 1)[0], n * bn)
-                k_blocks = jax.device_put(
-                    k_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
+                kv_host = (
+                    self.regions.kv_view(region, n, bn)
+                    .view(dt)
+                    .reshape((2 * n, *self.spec.block_shape))
                 )
-                v_blocks = jax.device_put(
-                    v_host.view(np.dtype(jax.numpy.dtype(self.spec.dtype))).reshape(shape)
-                )
+                # ONE H2D per layer (K and V ride together); split on device.
+                kv_dev = jax.device_put(kv_host)
+                uploads[layer] = kv_dev
                 k_cache, v_cache = out[layer]
                 out[layer] = (
-                    scatter_blocks(k_cache, ids_dev, k_blocks),
-                    scatter_blocks(v_cache, ids_dev, v_blocks),
+                    scatter_blocks(k_cache, ids_dev, kv_dev[:n]),
+                    scatter_blocks(v_cache, ids_dev, kv_dev[n:]),
                 )
                 start(layer + W)
         finally:
@@ -268,5 +290,6 @@ class LayerwiseKVReader:
             # return, so every staged byte must be consumed by the device.
             if fetches:
                 await asyncio.gather(*fetches.values(), return_exceptions=True)
+            jax.block_until_ready(list(uploads.values()))
             jax.block_until_ready(out)
         return out

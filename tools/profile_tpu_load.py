@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Profile the H2D (load-path) stage shapes on the real chip.
+
+Question from the r3 bench: the load pipeline reaches 49% of its own H2D
+ceiling while the save side reaches 88%. The ceiling dispatches all
+device_puts back-to-back and blocks once; the reader interleaves fetches,
+device_puts from shm-segment views, scatters, and region-reuse barriers.
+This script isolates each axis:
+
+  a. all-dispatch-then-block from standalone contiguous arrays (= r3 ceiling)
+  b. same but source views into one big host buffer (= reader's slot views)
+  c. serial: device_put + block per layer (no overlap at all)
+  d. one batched device_put of the stacked [2L,n,...] array (single transfer)
+  e. reader-shaped: dispatch k,v + scatter per layer, barrier on out[l-R]
+
+Run on the real chip (no JAX_PLATFORMS override), from the repo root:
+    python tools/profile_tpu_load.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+L, N, BLK = 8, 32, 64 << 10  # layers, blocks, bytes/block
+BF16 = np.dtype(ml_dtypes.bfloat16)
+
+
+def bench(fn, reps=5, warm=1):
+    for _ in range(warm):
+        fn()
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec, scatter_blocks
+
+    spec = PagedKVCacheSpec(
+        num_layers=L, num_kv_heads=8, head_dim=64, block_tokens=64,
+        dtype=jnp.bfloat16, num_blocks=64,
+    )
+    bshape = (N, *spec.block_shape)
+    total = L * 2 * N * BLK
+    print(f"device: {jax.devices()[0]}, total bytes {total >> 20} MB")
+
+    rng = np.random.default_rng(0)
+    big = rng.integers(0, 255, size=(2 * L, N * BLK), dtype=np.uint8)
+    views = [big[i].view(BF16).reshape(bshape) for i in range(2 * L)]
+    standalone = [np.ascontiguousarray(v) for v in views]
+    stacked = big.view(BF16).reshape((2 * L, *bshape))
+
+    def put_all(srcs):
+        out = [jax.device_put(s) for s in srcs]
+        jax.block_until_ready(out)
+
+    def put_serial(srcs):
+        for s in srcs:
+            jax.block_until_ready(jax.device_put(s))
+
+    ids = jnp.arange(N, dtype=jnp.int32)
+
+    def fresh_targets():
+        t = [
+            (jnp.zeros((spec.num_blocks, *spec.block_shape), jnp.bfloat16),
+             jnp.zeros((spec.num_blocks, *spec.block_shape), jnp.bfloat16))
+            for _ in range(L)
+        ]
+        jax.block_until_ready(t)
+        return t
+
+    def reader_shaped(R):
+        out = fresh_targets()
+        t0 = time.perf_counter()
+        for l in range(L):
+            occ = l - R
+            if occ >= 0:
+                jax.block_until_ready(out[occ])
+            kb = jax.device_put(views[2 * l])
+            vb = jax.device_put(views[2 * l + 1])
+            kc, vc = out[l]
+            out[l] = (scatter_blocks(kc, ids, kb), scatter_blocks(vc, ids, vb))
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # g. upload-then-scatter split: dispatch ALL device_puts, then scatters
+    def upload_then_scatter():
+        out = fresh_targets()
+        t0 = time.perf_counter()
+        ups = [jax.device_put(v) for v in views]
+        for l in range(L):
+            kc, vc = out[l]
+            out[l] = (scatter_blocks(kc, ids, ups[2 * l]),
+                      scatter_blocks(vc, ids, ups[2 * l + 1]))
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    # h. windowed upload+scatter: device_put window of 2 layers ahead,
+    # scatter as uploads land (barrier on the uploaded blocks, not the out)
+    def windowed(window=4):
+        out = fresh_targets()
+        t0 = time.perf_counter()
+        ups = {}
+        for l in range(min(window, L)):
+            ups[l] = (jax.device_put(views[2 * l]), jax.device_put(views[2 * l + 1]))
+        for l in range(L):
+            kb, vb = ups.pop(l)
+            kc, vc = out[l]
+            out[l] = (scatter_blocks(kc, ids, kb), scatter_blocks(vc, ids, vb))
+            nxt = l + window
+            if nxt < L:
+                ups[nxt] = (jax.device_put(views[2 * nxt]),
+                            jax.device_put(views[2 * nxt + 1]))
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    configs = {
+        "a. all-dispatch standalone": lambda: bench(lambda: put_all(standalone), reps=1, warm=0),
+        "b. all-dispatch views     ": lambda: bench(lambda: put_all(views), reps=1, warm=0),
+        "c. serial standalone      ": lambda: bench(lambda: put_serial(standalone), reps=1, warm=0),
+        "d. one 32MB device_put    ": lambda: bench(lambda: jax.block_until_ready(jax.device_put(stacked)), reps=1, warm=0),
+        "e. reader-shaped R=6      ": lambda: reader_shaped(6),
+        "f. reader-shaped no-barr  ": lambda: reader_shaped(99),
+        "g. upload-all-then-scatter": upload_then_scatter,
+        "h. windowed(4) up+scatter ": windowed,
+    }
+    best = {k: float("inf") for k in configs}
+    for k, fn in configs.items():
+        fn()  # warm/compile
+    rounds = 5
+    for r in range(rounds):
+        for k, fn in configs.items():
+            best[k] = min(best[k], fn())
+        print(f"-- round {r}")
+        for k in configs:
+            print(f"  {k}: {best[k]*1e3:8.1f} ms  {total/best[k]/2**30:.4f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
