@@ -37,18 +37,25 @@ __version__ = "0.1.0"
 
 
 def __getattr__(name):
-    # Lazy: the connector pulls in jax (via the TPU data plane); the core
-    # client/server API must stay importable without it.
+    # Lazy: the connector/engine layers pull in jax (via the TPU data
+    # plane); the core client/server API must stay importable without it.
     if name in ("KVConnector", "token_chain_hashes"):
         from . import connector
 
         return getattr(connector, name)
+    if name in ("EngineKVAdapter", "ContinuousBatchingHarness", "BlockPool"):
+        from . import engine
+
+        return getattr(engine, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = [
     "KVConnector",
     "token_chain_hashes",
+    "EngineKVAdapter",
+    "ContinuousBatchingHarness",
+    "BlockPool",
     "InfinityConnection",
     "StripedConnection",
     "register_server",

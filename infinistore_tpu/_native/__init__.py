@@ -135,6 +135,12 @@ lib.its_conn_delete_keys.argtypes = [c_void_p, c_char_p, c_uint64, c_uint32]
 lib.its_conn_delete_keys.restype = c_int64
 lib.its_conn_stat_json.argtypes = [c_void_p, c_char_p, c_int]
 lib.its_conn_stat_json.restype = c_int
+# Event-fd completion ring (fd owned by the Python side; never closed natively).
+lib.its_conn_set_completion_fd.argtypes = [c_void_p, c_int]
+lib.its_conn_drain_completions.argtypes = [
+    c_void_p, POINTER(c_uint64), POINTER(c_int32), c_int,
+]
+lib.its_conn_drain_completions.restype = c_int
 
 # ---- mempool (unit-test surface) ----
 lib.its_mm_create.argtypes = [c_uint64, c_uint64, c_int]

@@ -3,13 +3,15 @@
 TPU-native rebuild of the reference's infinistore/lib.py (surface parity:
 InfinityConnection :288, register_server :203, evict_cache :232,
 purge_kv_map/get_kvmap_len :177-201, Logger :155, exceptions :30-35). The
-asyncio bridging is the same architecture as the reference — a native
-background thread completes operations and callbacks are marshalled onto the
-event loop with call_soon_threadsafe (lib.py:462-470), with a
-BoundedSemaphore(128) inflight cap (lib.py:307) — but the native side is the
-epoll/DCN reactor in native/src/client.cpp instead of an ibverbs CQ thread,
-and the server runs its own reactor thread instead of being grafted onto
-uvloop (no uvloop/PyCapsule dance needed).
+asyncio bridging keeps the reference's architecture — a native background
+thread completes operations, with a BoundedSemaphore(128) inflight cap
+(reference lib.py:307) — but replaces its per-op call_soon_threadsafe hop
+(reference lib.py:462-470) with an eventfd completion ring the event loop
+drains through its own epoll (one wake can complete a whole batch, and the
+native reactor never acquires the GIL). The native side is the epoll/DCN
+reactor in native/src/client.cpp instead of an ibverbs CQ thread, and the
+server runs its own reactor thread instead of being grafted onto uvloop (no
+uvloop/PyCapsule dance needed).
 """
 
 import asyncio
@@ -20,6 +22,7 @@ import json
 import os
 import socket
 import threading
+import weakref
 from dataclasses import dataclass
 from typing import List, Optional, Tuple, Union
 
@@ -111,13 +114,21 @@ def _resolve_hostname(hostname: str) -> str:
 
 
 # ---------------------------------------------------------------------------
-# Async completion plumbing: one shared ctypes callback + a registry keyed by
-# an integer token. The callback fires on the native reactor thread; ctypes
-# re-acquires the GIL, and we hop onto the owning asyncio loop.
+# Async completion plumbing. Primary path (Linux): the native reactor pushes
+# (token, status) into a per-connection completion ring and signals an
+# eventfd; the asyncio loop wakes through its own epoll (add_reader) and
+# drains the WHOLE ring in one pass — no per-op GIL acquisition on the
+# reactor thread and no per-op call_soon_threadsafe hop (measured ~28us
+# round-trip on a single-core host vs ~21us for an eventfd wake). Fallback
+# (no os.eventfd): one shared ctypes callback + call_soon_threadsafe per op.
+# Both paths resolve tokens through the same registry.
 # ---------------------------------------------------------------------------
 
 _completions: dict = {}
 _completion_token = itertools.count(1)
+_HAS_EVENTFD = hasattr(os, "eventfd")
+_DRAIN_CAP = 256
+_NULL_CB = ctypes.cast(None, COMPLETION_CB)  # ring-mode submits pass no callback
 
 
 @COMPLETION_CB
@@ -215,7 +226,28 @@ class InfinityConnection:
         config.verify()
         self.config = config
         self._handle = None
-        self._semaphores: dict = {}  # per-loop inflight caps
+        # Per-loop inflight caps, pruned on access: every asyncio.run()
+        # creates a fresh loop, and an unpruned registry would accumulate
+        # dead-loop entries forever. (Weak keys alone don't work: a
+        # BoundedSemaphore that ever blocked caches its loop, so the value
+        # would pin its own key alive.)
+        self._semaphores: dict = {}
+        # Event-fd completion bridge (see module comment above).
+        if _HAS_EVENTFD:
+            self._efd = os.eventfd(0, os.EFD_NONBLOCK)
+            self._efd_finalizer = weakref.finalize(self, os.close, self._efd)
+        else:
+            self._efd = None
+        self._reader_loops = weakref.WeakSet()  # loops with add_reader(_efd)
+        self._drain_tokens = (ctypes.c_uint64 * _DRAIN_CAP)()
+        self._drain_codes = (ctypes.c_int32 * _DRAIN_CAP)()
+        # Called after a successful reconnect() — e.g. a StripedConnection
+        # invalidating sibling stripes' aliases of this connection's shm
+        # segments (which the reconnect just unmapped).
+        self._reconnect_listeners: list = []
+        # get_match_last_index encode cache (chains are append-only). One
+        # tuple, swapped atomically — sync ops run from concurrent threads.
+        self._match_cache: Tuple[list, bytes] = ([], b"")
         self._shm_bufs: list = []  # keeps alloc_shm_mr views (and mappings) alive
         self._plain_mrs: list = []  # (ptr, nbytes) re-registered on reconnect
         # (ptr, nbytes) of ANOTHER connection's shm segment registered here
@@ -258,6 +290,8 @@ class InfinityConnection:
             raise InfiniStoreException(
                 f"failed to connect to {ip}:{self.config.service_port} (rc={rc})"
             )
+        if self._efd is not None:
+            lib.its_conn_set_completion_fd(handle, self._efd)
         return handle
 
     def _mark_connected(self):
@@ -287,10 +321,14 @@ class InfinityConnection:
         """Tear down the connection: stops the native reactor, unmaps shm
         segments (invalidating alloc_shm_mr views), releases registrations.
         ``close_connection`` is the reference-compatible alias."""
+        leftovers: list = []
         with self._lock:  # serialized against reconnect()/register_mr()
             self._closed = True  # a closed connection must stay closed
             if self._handle is not None:
                 lib.its_conn_close(self._handle)
+                # its_conn_close failed every in-flight op into the ring;
+                # collect them before the handle (and its ring) is freed.
+                leftovers += self._drain_ring_locked(self._handle)
                 lib.its_conn_destroy(self._handle)
                 self._handle = None
                 self._shm_bufs.clear()  # views die once the segment unmaps
@@ -299,9 +337,24 @@ class InfinityConnection:
                 self.rdma_connected = False
                 self.tcp_connected = False
             for h in self._dead_handles:  # parked by reconnect(); see __init__
+                leftovers += self._drain_ring_locked(h)
                 lib.its_conn_destroy(h)
             self._dead_handles.clear()
             self._dead_shm_ranges.clear()
+            readers = list(self._reader_loops)
+            self._reader_loops = weakref.WeakSet()
+        self._dispatch_completions(leftovers)
+        for loop in readers:
+            try:
+                loop.call_soon_threadsafe(self._remove_reader, loop)
+            except RuntimeError:
+                pass  # loop already closed; its selector died with it
+
+    def _remove_reader(self, loop):
+        try:
+            loop.remove_reader(self._efd)
+        except (OSError, ValueError):
+            pass
 
     # reference name (lib.py:380)
     close_connection = close
@@ -331,6 +384,7 @@ class InfinityConnection:
         out when that handle closes) or the new one — never NULL. The old
         handle is closed after the swap (in-flight ops fail out) but
         destroyed only at close(), so it is never freed under a live call."""
+        leftovers: list = []
         with self._lock:
             if self._closed:  # checked under the lock: close() is final
                 raise InfiniStoreException("connection closed; create a new one")
@@ -359,8 +413,17 @@ class InfinityConnection:
             self._plain_mrs = mrs
             if old is not None:
                 lib.its_conn_close(old)  # in-flight ops fail out
+                leftovers += self._drain_ring_locked(old)
                 self._dead_handles.append(old)
             self._mark_connected()
+        self._dispatch_completions(leftovers)
+        # Outside the lock: listeners touch OTHER connections' locks (e.g. a
+        # StripedConnection invalidating sibling stripes' aliases of the shm
+        # segments this reconnect just unmapped — without this, a stripe-0
+        # self-heal via the auto_reconnect decorator would leave live sibling
+        # registrations over unmapped memory).
+        for listener in list(self._reconnect_listeners):
+            listener()
 
     def _require(self):
         if self._handle is None:
@@ -465,9 +528,81 @@ class InfinityConnection:
         with self._lock:  # loops in different threads may race the registry
             sem = self._semaphores.get(loop)
             if sem is None:
+                # Prune dead loops BEFORE inserting (the registry is tiny,
+                # so the scan is cheaper than the leak it prevents).
+                for dead in [lp for lp in self._semaphores if lp.is_closed()]:
+                    del self._semaphores[dead]
                 sem = asyncio.BoundedSemaphore(self.MAX_INFLIGHT)
                 self._semaphores[loop] = sem
             return sem
+
+    def _ensure_reader(self, loop):
+        """Register the completion-eventfd with this loop's selector (once
+        per loop). Must be called ON the loop."""
+        if loop not in self._reader_loops:
+            loop.add_reader(self._efd, self._drain_ready)
+            self._reader_loops.add(loop)
+
+    def _drain_ring_locked(self, handle) -> list:
+        """Pop all ring completions from a handle (caller holds _lock).
+        Returns (token, code) pairs for _dispatch_completions."""
+        pairs = []
+        if self._efd is None:
+            return pairs
+        while True:
+            n = lib.its_conn_drain_completions(
+                handle, self._drain_tokens, self._drain_codes, _DRAIN_CAP
+            )
+            pairs += [
+                (self._drain_tokens[i], self._drain_codes[i]) for i in range(n)
+            ]
+            if n < _DRAIN_CAP:
+                return pairs
+
+    def _dispatch_completions(self, pairs):
+        """Resolve drained (token, code) pairs. Futures owned by the loop we
+        are currently running on complete inline; foreign loops get one
+        call_soon_threadsafe each (rare: cross-loop/teardown cases only)."""
+        if not pairs:
+            return
+        try:
+            current = asyncio.get_running_loop()
+        except RuntimeError:
+            current = None
+        for token, code in pairs:
+            entry = _completions.pop(token, None)
+            if entry is None:
+                continue
+            loop, future, on_done = entry
+            if loop is current:
+                on_done(future, code)
+            else:
+                try:
+                    loop.call_soon_threadsafe(on_done, future, code)
+                except RuntimeError:
+                    pass  # loop closed before its op completed
+
+    def _drain_ready(self):
+        """add_reader callback: clear the eventfd, then drain + dispatch.
+        The native side pushes to the ring BEFORE signalling, and we clear
+        BEFORE draining, so any push racing this drain re-arms the fd."""
+        try:
+            os.eventfd_read(self._efd)
+        except (BlockingIOError, OSError):
+            pass  # another loop's drain got here first, or fd is closing
+        while True:
+            with self._lock:  # two loops may share this efd; serialize
+                if self._handle is None:
+                    return
+                n = lib.its_conn_drain_completions(
+                    self._handle, self._drain_tokens, self._drain_codes, _DRAIN_CAP
+                )
+                pairs = [
+                    (self._drain_tokens[i], self._drain_codes[i]) for i in range(n)
+                ]
+            self._dispatch_completions(pairs)
+            if n < _DRAIN_CAP:
+                return
 
     async def _batch_op(self, native_fn, blocks, block_size: int, ptr: int, op_name: str):
         self._require()
@@ -497,6 +632,9 @@ class InfinityConnection:
             else:
                 fut.set_exception(InfiniStoreException(f"{op_name} failed: status={code}"))
 
+        use_ring = self._efd is not None
+        if use_ring:
+            self._ensure_reader(loop)
         _completions[token] = (loop, future, on_done)
         rc = native_fn(
             self._handle,
@@ -506,7 +644,7 @@ class InfinityConnection:
             offs,
             block_size,
             ctypes.c_void_p(ptr),
-            _on_complete,
+            _NULL_CB if use_ring else _on_complete,
             ctypes.c_void_p(token),
         )
         if rc != 0:
@@ -610,6 +748,12 @@ class InfinityConnection:
         """Blocking single-key put from a raw pointer (reference lib.py:399)."""
         self._require()
         rc = lib.its_conn_tcp_put(self._handle, key.encode(), ctypes.c_void_p(ptr), size)
+        if rc == -wire.STATUS_OOM:
+            # Same split as the batched paths: pressure (retry/recompute;
+            # data may survive spilled) is not a transport failure.
+            raise InfiniStoreResourcePressure(
+                "tcp_write_cache: store out of memory"
+            )
         if rc != 0:
             raise InfiniStoreException(f"tcp_write_cache failed: status={-rc}")
         return wire.STATUS_OK
@@ -627,14 +771,19 @@ class InfinityConnection:
         )
         if rc == -wire.STATUS_KEY_NOT_FOUND:
             raise InfiniStoreKeyNotFound(f"key not found: {key}")
+        if rc == -wire.STATUS_OOM:
+            # Present but unpromotable spilled key (server.cpp single-key GET
+            # 507): the data survives — recompute or retry later, distinct
+            # from transport failure.
+            raise InfiniStoreResourcePressure(
+                f"tcp_read_cache: store too pressured to serve {key!r} now"
+            )
         if rc != 0:
             raise InfiniStoreException(f"tcp_read_cache failed: status={-rc}")
         n = out_size.value
         arr = np.ctypeslib.as_array(out, shape=(n,))
         # Free the native buffer when the array (base) is collected.
         ptr_val = ctypes.cast(out, ctypes.c_void_p).value
-        import weakref
-
         weakref.finalize(arr, lib.its_free, ptr_val)
         return arr
 
@@ -649,12 +798,30 @@ class InfinityConnection:
             raise InfiniStoreException(f"check_exist failed: status={-rc}")
         return rc == 1
 
+    def _encode_match_keys(self, keys: List[str]) -> bytes:
+        """Encode the key chain, reusing the previous call's encoding for the
+        shared prefix. Chains are append-only (each key hashes the whole
+        prefix), so admission-time lookups re-encode hundreds of unchanged
+        keys per request; the list compares run at C speed and the encode —
+        ~67us for 256 keys, 3x the transport cost of the lookup itself —
+        happens only for the new tail."""
+        cached, cached_blob = self._match_cache  # one read: threads race this
+        if keys == cached:
+            return cached_blob
+        lc = len(cached)
+        if lc and len(keys) > lc and keys[:lc] == cached:
+            blob = cached_blob + wire.encode_keys_blob(keys[lc:])
+        else:
+            blob = wire.encode_keys_blob(keys)
+        self._match_cache = (list(keys), blob)  # atomic swap (GIL)
+        return blob
+
     @_reconnecting()
     def get_match_last_index(self, keys: List[str]) -> int:
         """Longest-prefix match index over a key chain (reference lib.py:562;
         server does binary search under the prefix property, SURVEY.md §3.6)."""
         self._require()
-        blob = wire.encode_keys_blob(keys)
+        blob = self._encode_match_keys(keys)
         idx = lib.its_conn_match_last_index(self._handle, blob, len(blob), len(keys))
         if idx == -(2**31):
             raise InfiniStoreException("get_match_last_index transport error")
@@ -707,6 +874,17 @@ class StripedConnection:
             raise ValueError("streams must be >= 1")
         self.config = config
         self.conns = [InfinityConnection(config) for _ in range(streams)]
+        # Stripe 0 owns the shm segments the other stripes alias. WHENEVER it
+        # reconnects — including a self-heal inside the auto_reconnect
+        # decorator that this object never sees — the segments are unmapped
+        # and sibling aliases must die with them, or a retried batched op
+        # scatter/gathers into unmapped memory (crash) instead of raising the
+        # typed dead-shm error.
+        self.conns[0]._reconnect_listeners.append(self._on_owner_reconnect)
+
+    def _on_owner_reconnect(self):
+        for c in self.conns[1:]:
+            c._invalidate_segment_aliases()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -736,18 +914,14 @@ class StripedConnection:
         InfinityConnection.reconnect: alloc_shm_mr views do not survive, and
         a restarted store is a cold cache. With auto_reconnect configured,
         sync ops (stripe 0) self-heal; batched async callers invoke this
-        after a failure — without it a restart left stripes 1..N dead."""
-        owner_died = not self.conns[0].is_connected
+        after a failure — without it a restart left stripes 1..N dead.
+
+        Sibling alias invalidation is NOT handled here: stripe 0's own
+        reconnect() notifies _on_owner_reconnect every time it runs, whether
+        invoked from this loop or from a sync-op self-heal."""
         for c in self.conns:
             if not c.is_connected:
                 c.reconnect()
-        if owner_died:
-            # Stripe 0 owned the shm segments; its reconnect unmapped them.
-            # Sibling stripes may still be alive with live registrations
-            # over the dead mapping — drop those so stale-pointer ops get a
-            # clean error instead of touching unmapped memory.
-            for c in self.conns[1:]:
-                c._invalidate_segment_aliases()
 
     @property
     def shm_active(self) -> bool:

@@ -125,6 +125,18 @@ class Connection {
     // True when the same-host shm fast path is active for batched ops.
     bool shm_active() const { return shm_ok_.load(); }
 
+    // Event-fd completion ring (the low-fixed-cost asyncio bridge). When a
+    // completion fd is set, async batched ops submitted with cb == nullptr
+    // and ctx != nullptr complete by pushing (ctx-as-token, code) into a
+    // ring and signalling the fd — the Python event loop wakes via its own
+    // epoll (add_reader) and drains the whole ring in one pass, instead of
+    // paying one GIL acquisition + call_soon_threadsafe hop PER op. The fd
+    // is owned by the caller (typically an os.eventfd); it is never closed
+    // here.
+    void set_completion_fd(int fd);
+    // Pop up to cap completions into tokens/codes; returns the count.
+    int drain_completions(uint64_t* tokens, int32_t* codes, int cap);
+
   private:
     struct Request;
     struct SyncState;
@@ -203,6 +215,13 @@ class Connection {
 
     mutable std::mutex mr_mu_;
     std::vector<std::pair<const char*, size_t>> regions_;
+
+    // Completion ring (see set_completion_fd). Pushed by the reactor (and by
+    // fail_all on close), drained by the owning event loop — and, at
+    // teardown, by the closing thread.
+    std::atomic<int> comp_fd_{-1};
+    std::mutex ring_mu_;
+    std::vector<std::pair<uint64_t, int32_t>> ring_;
 
     // Client-owned shm staging segments (one-RTT path).
     struct ClientSeg {

@@ -116,7 +116,10 @@ class KVStore {
     struct Entry {
         BlockRef block;                  // set when resident in RAM
         int64_t spill_off = -1;          // set when demoted to the file
-        uint32_t spill_size = 0;
+        // size_t, not u32: block sizes are u64 on the wire (tcp_put payload),
+        // and a truncated size here would desync free_slot/promote for
+        // >=4GiB values — silent corruption, not an error.
+        size_t spill_size = 0;
         std::list<std::string>::iterator lru_it;  // in lru_ or spill_lru_
         bool spilled() const { return block == nullptr && spill_off >= 0; }
     };

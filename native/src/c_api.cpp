@@ -141,6 +141,15 @@ void* its_conn_alloc_shm_mr(void* c, uint64_t size) {
     return static_cast<Connection*>(c)->alloc_shm_mr(size);
 }
 
+// Event-fd completion ring: the caller owns fd (never closed here); async
+// batched ops submitted with cb=NULL, ctx=token complete into the ring.
+void its_conn_set_completion_fd(void* c, int fd) {
+    static_cast<Connection*>(c)->set_completion_fd(fd);
+}
+int its_conn_drain_completions(void* c, uint64_t* tokens, int32_t* codes, int cap) {
+    return static_cast<Connection*>(c)->drain_completions(tokens, codes, cap);
+}
+
 int its_conn_put_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,
                        its::CompletionCb cb, void* ctx) {

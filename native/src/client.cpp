@@ -646,6 +646,19 @@ std::string Connection::stat_json() {
     return std::string(body.begin(), body.end());
 }
 
+void Connection::set_completion_fd(int fd) { comp_fd_.store(fd); }
+
+int Connection::drain_completions(uint64_t* tokens, int32_t* codes, int cap) {
+    std::lock_guard<std::mutex> lock(ring_mu_);
+    int n = static_cast<int>(std::min<size_t>(cap, ring_.size()));
+    for (int i = 0; i < n; i++) {
+        tokens[i] = ring_[i].first;
+        codes[i] = ring_[i].second;
+    }
+    ring_.erase(ring_.begin(), ring_.begin() + n);
+    return n;
+}
+
 void Connection::complete(std::unique_ptr<Request> req, int code, bool take_body) {
     if (req->sync != nullptr) {
         req->sync->status = static_cast<uint32_t>(code);
@@ -660,6 +673,17 @@ void Connection::complete(std::unique_ptr<Request> req, int code, bool take_body
         req->sync->prom.set_value();
     } else if (req->cb != nullptr) {
         req->cb(req->ctx, code);
+    } else if (comp_fd_.load() >= 0 && req->ctx != nullptr) {
+        // Ring mode: push, then signal — the drainer reads the fd BEFORE
+        // popping, so a push after its pop re-arms the fd and no completion
+        // is ever stranded.
+        {
+            std::lock_guard<std::mutex> lock(ring_mu_);
+            ring_.emplace_back(reinterpret_cast<uint64_t>(req->ctx), code);
+        }
+        uint64_t one = 1;
+        ssize_t rc = ::write(comp_fd_.load(), &one, sizeof(one));
+        (void)rc;
     }
     if (req->rx_buf != nullptr) free(req->rx_buf);
 }

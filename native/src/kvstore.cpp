@@ -42,7 +42,7 @@ bool KVStore::demote(const std::string& key, Entry& e) {
     memcpy(spill_->data(off), e.block->data(), size);
     e.block.reset();
     e.spill_off = off;
-    e.spill_size = static_cast<uint32_t>(size);
+    e.spill_size = size;
     spill_lru_.push_front(key);
     e.lru_it = spill_lru_.begin();
     return true;
