@@ -133,10 +133,16 @@ class EngineKVAdapter:
         out, blocks = await self.connector.load(token_ids, caches, block_table)
         return out, blocks * self.block_tokens
 
-    async def save_kv(self, token_ids, caches, block_table: np.ndarray) -> int:
+    async def save_kv(
+        self, token_ids, caches, block_table: np.ndarray, first_block: int = 0
+    ) -> int:
         """Stream this request's computed KV blocks to the store (layer by
-        layer, D2H overlapping the network)."""
-        return await self.connector.save(token_ids, caches, block_table)
+        layer, D2H overlapping the network). ``first_block``: logical index
+        of block_table[0] within the prompt — pass the prefix-hit count to
+        save only the computed suffix (the loaded prefix is already stored)."""
+        return await self.connector.save(
+            token_ids, caches, block_table, first_block=first_block
+        )
 
     def evict_request(self, token_ids) -> int:
         """Drop a request's blocks from the store (engine-initiated)."""
@@ -291,29 +297,35 @@ class ContinuousBatchingHarness:
             if self.verify:
                 async with self.gate.shared():
                     verified = self._verify_request(token_ids, table)
-            # Snapshot this request's blocks into private arrays under the
+            # Save ONLY the computed suffix — the loaded prefix came from the
+            # store and re-writing it would double write traffic for every
+            # prefix hit. Snapshot those blocks into private arrays under the
             # shared gate (device-side gathers, microseconds), then stream
             # them out with NO gate held: the save — the long store-I/O
             # phase — overlaps other requests' loads, computes, and saves.
             # Holding the gate across the save would serialize the whole
             # pipeline (the next request's exclusive load waits on it).
-            ids_dev = jnp.asarray(table)
-            async with self.gate.shared():
-                snapshot = [
-                    (gather_blocks(k, ids_dev), gather_blocks(v, ids_dev))
-                    for k, v in self.caches
-                ]
-                jax.block_until_ready(snapshot)
-            self._saving += 1
-            self.max_concurrent_saves = max(
-                self.max_concurrent_saves, self._saving
-            )
-            try:
-                await self.adapter.save_kv(
-                    token_ids, snapshot, np.arange(n_blocks, dtype=np.int32)
+            if loaded_blocks < n_blocks:
+                suffix_dev = jnp.asarray(table[loaded_blocks:])
+                async with self.gate.shared():
+                    snapshot = [
+                        (gather_blocks(k, suffix_dev), gather_blocks(v, suffix_dev))
+                        for k, v in self.caches
+                    ]
+                    jax.block_until_ready(snapshot)
+                self._saving += 1
+                self.max_concurrent_saves = max(
+                    self.max_concurrent_saves, self._saving
                 )
-            finally:
-                self._saving -= 1
+                try:
+                    await self.adapter.save_kv(
+                        token_ids,
+                        snapshot,
+                        np.arange(n_blocks - loaded_blocks, dtype=np.int32),
+                        first_block=loaded_blocks,
+                    )
+                finally:
+                    self._saving -= 1
             stats = RequestStats(
                 tokens=len(token_ids),
                 hit_blocks=hit_tokens // bt,

@@ -62,6 +62,13 @@ struct ServerConfig {
     // drops, the reference's behavior).
     std::string spill_dir;
     size_t spill_bytes = 0;
+    // Reactor fairness: one-RTT segment ops (PutFrom/GetInto) run at most
+    // ~this many bytes of pool/spill memcpy work per event-loop tick, then
+    // yield so other connections are served between slices. Keeps an
+    // innocent hot-path read's p99 within ~2x its uncontended value while a
+    // spill-heavy batch churns (bench.py contended_* keys). Internal tuning
+    // knob (C++-level; not surfaced through the CLI).
+    size_t slice_bytes = 128ull << 10;
 };
 
 // Per-op service counters (SURVEY.md §5.1: the reference has no tracing at
@@ -122,6 +129,11 @@ class Server {
     void handle_shm(Conn* c);
     void handle_simple(Conn* c);
     bool alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases);
+    // Budget-sliced segment ops (see ServerConfig::slice_bytes).
+    void suspend_for_cont(Conn* c);
+    void run_cont_slice(Conn* c);
+    void finish_cont(Conn* c, uint32_t status);
+    void arm_read(Conn* c, bool want_read);
     void finish_payload(Conn* c);
     void send_status(Conn* c, uint32_t status);
     void send_resp(Conn* c, uint32_t status, std::vector<uint8_t> body,
@@ -147,6 +159,16 @@ class Server {
     std::vector<std::function<void()>> posted_;
 
     std::unordered_map<int, std::unique_ptr<Conn>> conns_;
+    // Connections with a suspended sliced segment op; round-robined one
+    // slice each per loop tick (epoll timeout drops to 0 while non-empty).
+    std::deque<Conn*> cont_queue_;
+    // Reclaim budgeting for sliced allocations: when slice_mode_ is set,
+    // alloc_blocks skips the ratio sweep, caps demote iterations at
+    // slice_reclaim_left_, and reports a cap-hit via slice_capped_ (the
+    // caller retries next slice instead of failing the op with 507).
+    bool slice_mode_ = false;
+    bool slice_capped_ = false;
+    size_t slice_reclaim_left_ = 0;
     // close_conn() defers destruction here so callers holding a Conn* across
     // a close (e.g. readable -> dispatch -> flush -> error) never dangle; the
     // reactor clears it between epoll batches.

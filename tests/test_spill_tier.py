@@ -214,3 +214,71 @@ def test_unpromotable_batch_errors_but_data_survives():
         assert np.array_equal(small, src[i * BLOCK : (i + 1) * BLOCK])
     c.close()
     srv.stop()
+
+
+def test_delete_racing_sliced_read_is_typed_never_hung():
+    """A batched read of spilled keys runs budget-sliced across reactor
+    ticks (ServerConfig::slice_bytes); a delete from another connection can
+    land BETWEEN slices. The read must finish with either correct bytes or
+    the typed KeyNotFound — never a hang (the stale slice_capped_ retry
+    loop this test pins down) and never a 507 for a key that is simply
+    gone (507 stays reserved for batches whose pins genuinely exceed RAM).
+    The connection stays usable afterwards."""
+    import asyncio
+    import threading
+
+    srv = _server()
+    reader = _connect(srv)
+    deleter = _connect(srv)
+    try:
+        n = 128  # 8MB working set over a 4MB pool -> most blocks spilled
+        buf = reader.alloc_shm_mr(n * BLOCK)
+        assert buf is not None
+        buf[:] = 3
+        pairs = [(f"race-{i}", i * BLOCK) for i in range(n)]
+
+        def read_in_thread(span, deleted_span):
+            outcome = {}
+
+            def run_read():
+                try:
+                    asyncio.run(reader.read_cache_async(span, BLOCK, buf.ctypes.data))
+                    outcome["r"] = "ok"
+                except its.InfiniStoreKeyNotFound:
+                    outcome["r"] = "miss"
+                except its.InfiniStoreResourcePressure:
+                    outcome["r"] = "pressure"
+                except its.InfiniStoreException as e:
+                    outcome["r"] = f"err:{e}"
+
+            th = threading.Thread(target=run_read)
+            th.start()
+            deleter.delete_keys([k for k, _ in deleted_span])
+            th.join(timeout=30)
+            assert not th.is_alive(), "sliced read hung after racing delete"
+            return outcome["r"]
+
+        for attempt in range(6):
+            # Rewrite everything so each round starts complete (and mostly
+            # spilled: the writes evict/demote the earlier promoted blocks).
+            for s in range(0, n, 32):
+                reader.write_cache(pairs[s : s + 32], BLOCK, buf.ctypes.data)
+            # RAM-fitting batch (48 blocks = 3MB < 4MB pool): pins cannot
+            # exceed RAM, so the only legal outcomes are correct bytes or
+            # the typed miss — a 507 would be the deleted-key-as-pressure
+            # bug; a hang would be the stale slice_capped_ loop.
+            got = read_in_thread(pairs[:48], pairs[32:48])
+            assert got in ("ok", "miss"), got
+        # Oversized batch (all 128 = 8MB of pins > 4MB RAM) racing the same
+        # delete: typed pressure is now legitimate; hangs/crashes are not.
+        for s in range(0, n, 32):
+            reader.write_cache(pairs[s : s + 32], BLOCK, buf.ctypes.data)
+        got = read_in_thread(pairs, pairs[96:])
+        assert got in ("ok", "miss", "pressure"), got
+        # Connection still serves ops.
+        reader.write_cache([pairs[0]], BLOCK, buf.ctypes.data)
+        reader.read_cache([pairs[0]], BLOCK, buf.ctypes.data)
+    finally:
+        reader.close()
+        deleter.close()
+        srv.stop()

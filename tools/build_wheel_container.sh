@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Hermetic wheel build: run tools/build_wheel.sh inside the pinned container
+# (Dockerfile.build) and extract the wheel + provenance into dist/.
+#
+# Usage: tools/build_wheel_container.sh [image-digest-or-tag]
+#   e.g. tools/build_wheel_container.sh \
+#        quay.io/pypa/manylinux_2_28_x86_64@sha256:<digest>
+#
+# The reference's equivalent: build_manylinux_wheels.sh driving
+# Dockerfile.build. CI runs this in the wheel-hermetic job.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BASE="${1:-quay.io/pypa/manylinux_2_28_x86_64}"
+TAG=infinistore-tpu-wheel:build
+
+docker build -f Dockerfile.build --build-arg "BASE=$BASE" -t "$TAG" .
+# Record the EXACT image the build ran on (digest of the resolved base is in
+# the image history; the built image id pins the whole toolchain state).
+mkdir -p dist
+CID=$(docker create "$TAG")
+trap 'docker rm -f "$CID" >/dev/null' EXIT
+docker cp "$CID":/out/. dist/
+docker image inspect "$TAG" --format 'image_id: {{.Id}}' >> dist/BUILD_PROVENANCE.txt
+echo "hermetic wheel + provenance in dist/:"
+ls -l dist/
+cat dist/BUILD_PROVENANCE.txt
