@@ -130,14 +130,21 @@ class Server {
     void handle_simple(Conn* c);
     bool alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases);
     // Budget-sliced segment ops (see ServerConfig::slice_bytes).
+    void queue_cont(Conn* c);
     void suspend_for_cont(Conn* c);
+    void suspend_retry(Conn* c, uint8_t op);
     void run_cont_slice(Conn* c);
+    void run_getloc_slice(Conn* c);
     void finish_cont(Conn* c, uint32_t status);
     void arm_read(Conn* c, bool want_read);
     void finish_payload(Conn* c);
     void send_status(Conn* c, uint32_t status);
     void send_resp(Conn* c, uint32_t status, std::vector<uint8_t> body,
                    std::vector<iovec> payload, std::vector<BlockRef> refs);
+    void send_loc_resp(Conn* c, ShmLocResp& resp,
+                       const std::vector<PoolDirEntry>& dir);
+    bool shm_mappable(const void* ptr, const std::vector<PoolDirEntry>& dir,
+                      PoolLoc* out);
     void flush_out(Conn* c);
     void arm(Conn* c, bool want_write);
     bool ensure_capacity(size_t need_bytes);
@@ -169,6 +176,20 @@ class Server {
     bool slice_mode_ = false;
     bool slice_capped_ = false;
     size_t slice_reclaim_left_ = 0;
+    // RAII scope for the above: an exception between set and clear would
+    // otherwise leave slice_mode_ stuck true server-wide (silently skipping
+    // the ratio evict sweep for every later allocation).
+    struct SliceBudget {
+        Server* s;
+        SliceBudget(Server* srv, size_t budget_blocks) : s(srv) {
+            s->slice_mode_ = true;
+            // Slack beyond the nominal budget: a few demotes may free no
+            // RAM (entries pinned by in-flight ops) through no fault of
+            // this op's sizing.
+            s->slice_reclaim_left_ = budget_blocks + 4;
+        }
+        ~SliceBudget() { s->slice_mode_ = false; }
+    };
     // close_conn() defers destruction here so callers holding a Conn* across
     // a close (e.g. readable -> dispatch -> flush -> error) never dangle; the
     // reactor clears it between epoll batches.

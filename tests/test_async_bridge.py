@@ -1,0 +1,184 @@
+"""Eventfd completion-ring bridge: lifecycle paths the data-plane tests
+don't isolate — teardown with ops in flight, event-loop churn, multiple
+loops sharing one connection, and the legacy-callback fallback staying
+equivalent. (lib.py: _drain_ready/_dispatch_completions/_drain_ring_locked;
+native: Connection::set_completion_fd/drain_completions.)"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+
+BLOCK = 64 << 10
+
+
+@pytest.fixture()
+def server():
+    srv = its.start_local_server(prealloc_bytes=128 << 20, block_bytes=BLOCK)
+    yield srv
+    srv.stop()
+
+
+def _conn(srv, **kw):
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=srv.port, log_level="error", **kw
+        )
+    )
+    c.connect()
+    return c
+
+
+def test_ring_mode_active_and_roundtrip(server):
+    c = _conn(server)
+    try:
+        assert c._efd is not None, "eventfd bridge should be on (Linux)"
+        buf = c.alloc_shm_mr(8 * BLOCK)
+        buf[:] = np.random.randint(0, 256, size=buf.nbytes, dtype=np.uint8)
+        gold = buf.copy()
+        pairs = [(f"rb-{i}", i * BLOCK) for i in range(8)]
+
+        async def run():
+            await c.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+            buf[:] = 0
+            await c.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+        asyncio.run(run())
+        assert np.array_equal(buf, gold)
+    finally:
+        c.close()
+
+
+def test_loop_churn_prunes_semaphores(server):
+    """asyncio.run per batch (the bench/example pattern) must not grow the
+    per-loop registry without bound (r3 advisor + verdict item)."""
+    c = _conn(server)
+    try:
+        buf = c.alloc_shm_mr(BLOCK)
+        buf[:] = 1
+        for i in range(25):
+            asyncio.run(c.write_cache_async([(f"lc-{i}", 0)], BLOCK, buf.ctypes.data))
+        # Every run() made a fresh loop; dead ones must have been pruned.
+        assert len(c._semaphores) <= 2, len(c._semaphores)
+    finally:
+        c.close()
+
+
+def test_two_loops_in_threads_share_connection(server):
+    """Ops from two concurrent event loops (different threads) on ONE
+    connection: each future resolves on its own loop."""
+    c = _conn(server)
+    try:
+        buf = c.alloc_shm_mr(64 * BLOCK)
+        buf[:] = 7
+        errs = []
+
+        def worker(base):
+            async def run():
+                pairs = [(f"tl-{base}-{i}", (base * 32 + i) * BLOCK) for i in range(32)]
+                for _ in range(10):
+                    await c.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+                    await c.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+            try:
+                asyncio.run(run())
+            except Exception as e:  # surface in the main thread
+                errs.append(e)
+
+        ts = [threading.Thread(target=worker, args=(b,)) for b in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+    finally:
+        c.close()
+
+
+def test_server_death_fails_inflight_futures_typed(server):
+    """Kill the server with async ops in flight: every pending future must
+    resolve with a typed InfiniStoreException (fail_all -> ring -> loop
+    drain), never hang."""
+    c = _conn(server)
+    buf = c.alloc_shm_mr(256 * BLOCK)
+    buf[:] = 3
+    pairs = [(f"sd-{i}", i * BLOCK) for i in range(256)]
+
+    async def run():
+        futs = [
+            asyncio.ensure_future(
+                c.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+            )
+            for _ in range(8)
+        ]
+        await asyncio.sleep(0)  # let submits land
+        server.stop()
+        results = await asyncio.wait_for(
+            asyncio.gather(*futs, return_exceptions=True), timeout=30
+        )
+        return results
+
+    results = asyncio.run(run())
+    for r in results:
+        # Ops that raced the shutdown may have completed; the rest must be
+        # typed errors, not hangs or bare cancellations.
+        assert r == 200 or isinstance(r, its.InfiniStoreException), r
+    c.close()
+
+
+def test_close_with_pending_futures_resolves_them(server):
+    """close() from another thread while a loop has ops pending: the final
+    ring drain must resolve every future (typed error or success)."""
+    c = _conn(server)
+    buf = c.alloc_shm_mr(256 * BLOCK)
+    buf[:] = 5
+    pairs = [(f"cp-{i}", i * BLOCK) for i in range(256)]
+    done = {}
+
+    async def run():
+        futs = [
+            asyncio.ensure_future(c.write_cache_async(pairs, BLOCK, buf.ctypes.data))
+            for _ in range(8)
+        ]
+        await asyncio.sleep(0)
+        threading.Thread(target=c.close).start()
+        done["res"] = await asyncio.wait_for(
+            asyncio.gather(*futs, return_exceptions=True), timeout=30
+        )
+
+    asyncio.run(run())
+    assert len(done["res"]) == 8
+    for r in done["res"]:
+        assert r == 200 or isinstance(r, its.InfiniStoreException), r
+
+
+def test_legacy_callback_fallback_equivalent(server):
+    """With the eventfd disabled (the non-Linux fallback), the async API
+    must behave identically through the ctypes-callback path."""
+    c = its.InfinityConnection(
+        its.ClientConfig(
+            host_addr="127.0.0.1", service_port=server.port, log_level="error"
+        )
+    )
+    c._efd = None  # force legacy path before connect
+    c.connect()
+    try:
+        buf = c.alloc_shm_mr(8 * BLOCK)
+        buf[:] = np.random.randint(0, 256, size=buf.nbytes, dtype=np.uint8)
+        gold = buf.copy()
+        pairs = [(f"lg-{i}", i * BLOCK) for i in range(8)]
+
+        async def run():
+            await c.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+            buf[:] = 0
+            await c.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+        asyncio.run(run())
+        assert np.array_equal(buf, gold)
+        with pytest.raises(its.InfiniStoreKeyNotFound):
+            asyncio.run(c.read_cache_async([("absent", 0)], BLOCK, buf.ctypes.data))
+    finally:
+        c.close()
