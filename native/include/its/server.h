@@ -132,9 +132,9 @@ class Server {
     // Budget-sliced segment ops (see ServerConfig::slice_bytes).
     void queue_cont(Conn* c);
     void suspend_for_cont(Conn* c);
-    void suspend_retry(Conn* c, uint8_t op);
     void run_cont_slice(Conn* c);
     void run_getloc_slice(Conn* c);
+    void run_putalloc_slice(Conn* c);
     void finish_cont(Conn* c, uint32_t status);
     void arm_read(Conn* c, bool want_read);
     void finish_payload(Conn* c);
