@@ -509,8 +509,8 @@ def _tpu_connector_gbps(its, np, conn):
     d2h_window = kvc._writer.d2h_window
 
     def d2h_stage_once() -> float:
-        """The writer's device stage, verbatim (layerwise.py write): gather +
-        async D2H for every layer with d2h_window transfers in flight."""
+        """The writer's device stage, verbatim (layerwise.py write): gather,
+        pack K+V, ONE async D2H per layer, d2h_window transfers in flight."""
         from collections import deque
 
         staged: deque = deque()
@@ -523,8 +523,10 @@ def _tpu_connector_gbps(its, np, conn):
                     break
                 k_cache, v_cache = caches[layer]
                 staged.append(StagedTransfer([
-                    gather_blocks(k_cache, ids_dev),
-                    gather_blocks(v_cache, ids_dev),
+                    jnp.concatenate([
+                        gather_blocks(k_cache, ids_dev),
+                        gather_blocks(v_cache, ids_dev),
+                    ])
                 ]))
             if not staged:
                 break
@@ -601,9 +603,11 @@ def _tpu_connector_gbps(its, np, conn):
     # pipeline must be sampled round-robin with EQUAL counts — separate
     # min-of-N blocks would let one side harvest a fast period the other
     # never saw, and the ratio (the figure of merit) would be noise, not
-    # pipeline quality.
+    # pipeline quality. Six rounds: with per-layer transfers in the 100s of
+    # ms on slow tunnel days, min-estimators need the extra samples to
+    # converge (measured: 4 rounds leave ~0.1 swings in the ratios).
     d2h_dt = h2d_dt = best_save = best_load = float("inf")
-    for _ in range(4):
+    for _ in range(6):
         d2h_dt = min(d2h_dt, d2h_stage_once())
         best_save = min(best_save, save_once())
         h2d_dt = min(h2d_dt, h2d_stage_once(hosts))

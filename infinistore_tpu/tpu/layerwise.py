@@ -79,10 +79,13 @@ class LayerwiseKVWriter:
     """Stream a request's KV blocks to the store, one layer at a time.
 
     Pipeline per layer: Pallas-gather blocks from the paged cache (device),
-    start the async D2H, and ship previous layers' host buffers on the
-    network concurrently — up to ``depth`` layer-groups of puts in flight.
-    Puts go straight from jax's D2H buffers (registered for the op's
-    lifetime), so the only host copy is the one into the server's pool."""
+    pack K and V into one array, start ONE async D2H (per-transfer fixed
+    cost dominates on tunneled/remote TPU hosts — same reason the reader
+    uploads one packed span per layer), and ship previous layers' host
+    buffers on the network concurrently — up to ``depth`` layer-groups of
+    puts in flight. Puts go straight from jax's D2H buffer (registered for
+    the op's lifetime), so the only host copy is the one into the server's
+    pool."""
 
     def __init__(self, conn, pool: HostStagingPool, spec: PagedKVCacheSpec,
                  max_blocks: int, depth: int = 2, d2h_window: int = 4):
@@ -149,9 +152,14 @@ class LayerwiseKVWriter:
                     return
                 pos, layer = nxt
                 k_cache, v_cache = caches[layer]
+                # K blocks then V blocks packed into ONE device array -> one
+                # D2H transfer per layer (the device-side concat is an HBM
+                # copy, trivial next to the host transfer it halves).
                 staged.append((pos, layer, pool.stage_out([
-                    gather_blocks(k_cache, ids_dev),
-                    gather_blocks(v_cache, ids_dev),
+                    jax.numpy.concatenate([
+                        gather_blocks(k_cache, ids_dev),
+                        gather_blocks(v_cache, ids_dev),
+                    ])
                 ])))
 
         try:
@@ -166,14 +174,15 @@ class LayerwiseKVWriter:
                     # completed (= committed) before the sentinel ships.
                     while inflight:
                         total += await drain_one()
-                k_host, v_host = tr.wait()  # registers both buffers
+                (kv_host,) = tr.wait()  # registers the packed buffer
+                base = kv_host.ctypes.data
                 futs = (
                     asyncio.ensure_future(self.conn.write_cache_async(
                         [(key_fn(layer, "k", i), i * bn) for i in range(n)],
-                        bn, k_host.ctypes.data)),
+                        bn, base)),
                     asyncio.ensure_future(self.conn.write_cache_async(
                         [(key_fn(layer, "v", i), i * bn) for i in range(n)],
-                        bn, v_host.ctypes.data)),
+                        bn, base + n * bn)),
                 )
                 inflight.append((futs, tr, 2 * n))
                 top_up()  # refill the D2H pipeline before blocking again

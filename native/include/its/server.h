@@ -135,6 +135,11 @@ class Server {
     void run_cont_slice(Conn* c);
     void run_getloc_slice(Conn* c);
     void run_putalloc_slice(Conn* c);
+    // Shared promote+pin slice for GetLoc and GetInto's pin phase; the
+    // validator rejects a pinned block (replies kStatusInvalidReq).
+    enum class PinResult { kDone, kYield, kFinished };
+    PinResult pin_slice(Conn* c,
+                        const std::function<bool(size_t, const BlockRef&)>& validate);
     void finish_cont(Conn* c, uint32_t status);
     void arm_read(Conn* c, bool want_read);
     void finish_payload(Conn* c);

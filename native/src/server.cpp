@@ -525,7 +525,8 @@ void Server::run_putalloc_slice(Conn* c) {
     for (const auto& b : ct.blocks) {
         PoolLoc loc;
         mappable = mappable && shm_mappable(b->data(), dir, &loc);
-        resp.locs.push_back(ShmLoc{loc.pool_id, loc.offset, bs});
+        resp.locs.push_back(
+            ShmLoc{loc.pool_id, loc.offset, static_cast<uint32_t>(bs)});
     }
     if (!mappable) {
         // Blocks landed in an anonymous-fallback pool: tell the client to
@@ -553,53 +554,65 @@ void Server::finish_cont(Conn* c, uint32_t status) {
     send_status(c, status);
 }
 
-// One budget slice of a suspended GetLoc. The budget charges ACTUAL
-// promotion work (each promotion = a spill read + possibly a demote), not
-// key count: a fully RAM-resident batch is all O(1) LRU touches and
-// completes in its first slice — the same reactor tick as its dispatch —
-// while spill-heavy batches yield every ~half byte-budget of promotions.
-// Pins persist in the continuation, so progress is monotone: the op
-// completes, or reclaim genuinely runs dry (its own pins exceed RAM) and
-// 507s — never a retry livelock.
-void Server::run_getloc_slice(Conn* c) {
+// Shared promote+pin slice (GetLoc and GetInto's pin phase). The budget
+// charges ACTUAL promotion work (each promotion = a spill read + possibly a
+// demote), not key count: a fully RAM-resident batch is all O(1) LRU
+// touches and completes in its first slice — the same reactor tick as its
+// dispatch — while spill-heavy batches yield every ~half byte-budget of
+// promotions. Pins persist in the continuation, so progress is monotone:
+// the op completes, or reclaim genuinely runs dry (its own pins exceed
+// RAM) and 507s — never a retry livelock.
+Server::PinResult Server::pin_slice(
+    Conn* c, const std::function<bool(size_t, const BlockRef&)>& validate) {
     Conn::SegCont& ct = *c->cont;
     const size_t n = ct.m.keys.size();
-    const size_t bs = ct.m.block_size;
-    const size_t budget_blocks = std::max<size_t>(1, config_.slice_bytes / bs);
+    const size_t budget_blocks =
+        std::max<size_t>(1, config_.slice_bytes / ct.m.block_size);
     const size_t promote_cap = std::max<size_t>(1, budget_blocks / 2);
     // Resident gets are ~free but not literally free; cap touches per slice
     // so a huge resident batch still yields within ~tens of microseconds.
     const size_t touch_cap = std::max<size_t>(256, budget_blocks);
     const uint64_t p0 = kv_->spill_promotions();
     size_t touched = 0;
-    {
-        SliceBudget budget(this, budget_blocks);
-        while (ct.idx < n) {
-            if (kv_->spill_promotions() - p0 >= promote_cap || touched >= touch_cap)
-                return;  // slice's work done; pins kept, retry next tick
-            BlockRef b = kv_->get(ct.m.keys[ct.idx]);  // LRU touch; promotes
-            touched++;
-            if (b == nullptr) {
-                if (!kv_->exists(ct.m.keys[ct.idx])) {
-                    // Deleted between slices: a miss, not pressure (checked
-                    // before slice_capped_ — a plain map miss leaves the
-                    // flag stale).
-                    finish_cont(c, kStatusKeyNotFound);
-                    return;
-                }
-                if (slice_capped_) return;  // pins kept; retry next tick
-                // Reclaim ran dry with the key still spilled: genuine
-                // pressure (typically this op's own pins exceed RAM).
-                finish_cont(c, kStatusOutOfMemory);
-                return;
+    SliceBudget budget(this, budget_blocks);
+    while (ct.idx < n) {
+        if (kv_->spill_promotions() - p0 >= promote_cap || touched >= touch_cap)
+            return PinResult::kYield;  // slice's work done; pins kept
+        BlockRef b = kv_->get(ct.m.keys[ct.idx]);  // LRU touch; promotes
+        touched++;
+        if (b == nullptr) {
+            if (!kv_->exists(ct.m.keys[ct.idx])) {
+                // Deleted between slices: a miss, not pressure (checked
+                // before slice_capped_ — a plain map miss leaves the flag
+                // stale).
+                finish_cont(c, kStatusKeyNotFound);
+                return PinResult::kFinished;
             }
-            if (b->size() > bs) {
-                finish_cont(c, kStatusInvalidReq);
-                return;
-            }
-            ct.blocks.push_back(std::move(b));
-            ct.idx++;
+            if (slice_capped_) return PinResult::kYield;  // pins kept
+            // Reclaim ran dry with the key still spilled: genuine pressure
+            // (typically this op's own pins exceed RAM).
+            finish_cont(c, kStatusOutOfMemory);
+            return PinResult::kFinished;
         }
+        if (!validate(ct.idx, b)) {
+            finish_cont(c, kStatusInvalidReq);
+            return PinResult::kFinished;
+        }
+        ct.blocks.push_back(std::move(b));
+        ct.idx++;
+    }
+    return PinResult::kDone;
+}
+
+// One budget slice of a suspended GetLoc (see pin_slice for the budget
+// discipline).
+void Server::run_getloc_slice(Conn* c) {
+    Conn::SegCont& ct = *c->cont;
+    const size_t bs = ct.m.block_size;
+    if (pin_slice(c, [bs](size_t, const BlockRef& b) {
+            return b->size() <= bs;
+        }) != PinResult::kDone) {
+        return;
     }
     // All pinned: resolve locations against the CURRENT pool directory
     // (promotion may have auto-extended a pool) and reply.
@@ -691,45 +704,13 @@ void Server::run_cont_slice(Conn* c) {
 
     // kOpGetInto
     if (ct.phase == Conn::SegCont::Phase::kPin) {
-        // Same promotion-work budget as run_getloc_slice: charge actual
-        // promotions (each can cost a demote AND a spill read) against
-        // ~half the byte budget; resident gets are LRU touches under a
-        // higher count cap, so an all-resident pin phase finishes in one
-        // slice. ONE reclaim budget spans the slice.
-        const size_t promote_cap = std::max<size_t>(1, budget_blocks / 2);
-        const size_t touch_cap = std::max<size_t>(256, budget_blocks);
-        const uint64_t p0 = kv_->spill_promotions();
-        size_t touched = 0;
-        SliceBudget budget(this, budget_blocks);
-        while (ct.idx < n) {
-            if (kv_->spill_promotions() - p0 >= promote_cap || touched >= touch_cap)
-                return;  // slice's work done; pins kept, retry next tick
-            BlockRef b = kv_->get(ct.m.keys[ct.idx]);  // LRU touch; promotes
-            touched++;
-            if (b == nullptr) {
-                if (!kv_->exists(ct.m.keys[ct.idx])) {
-                    // Deleted/evicted between slices (the up-front existence
-                    // pass ran ticks ago): a miss, not pressure. Must be
-                    // checked BEFORE slice_capped_ — a plain map miss never
-                    // calls alloc_blocks, so the flag would be stale and a
-                    // capped verdict here would retry this dead key forever.
-                    finish_cont(c, kStatusKeyNotFound);
-                    return;
-                }
-                if (slice_capped_) return;  // pins kept; retry next tick
-                // Spilled + unpromotable: pressure, not a miss.
-                finish_cont(c, kStatusOutOfMemory);
-                return;
-            }
-            uint64_t off = ct.m.offsets[ct.idx];
-            if (b->size() > bs || off > seg.size || b->size() > seg.size - off) {
-                finish_cont(c, kStatusInvalidReq);
-                return;
-            }
-            ct.blocks.push_back(std::move(b));
-            ct.idx++;
-        }
-        ct.phase = Conn::SegCont::Phase::kCopy;
+        // Shared promotion-work budget (pin_slice); the validator adds the
+        // segment bounds check the one-RTT path needs.
+        PinResult r = pin_slice(c, [&ct, &seg, bs](size_t k, const BlockRef& b) {
+            uint64_t off = ct.m.offsets[k];
+            return b->size() <= bs && off <= seg.size && b->size() <= seg.size - off;
+        });
+        if (r == PinResult::kDone) ct.phase = Conn::SegCont::Phase::kCopy;
         return;
     }
     size_t chunk = std::min(budget_blocks, n - ct.copied);
@@ -1072,7 +1053,10 @@ void Server::handle_shm(Conn* c) {
             cont->m.block_size = m.block_size;
             cont->blocks.reserve(n);
             c->cont = std::move(cont);
-            suspend_for_cont(c);
+            // First slice inline: the free-RAM case completes right here
+            // with no suspension (no epoll re-arms, no extra tick).
+            run_putalloc_slice(c);
+            if (!c->dead && c->cont != nullptr) suspend_for_cont(c);
             return;
         }
         case kOpPutCommit: {
@@ -1123,7 +1107,10 @@ void Server::handle_shm(Conn* c) {
             cont->phase = Conn::SegCont::Phase::kPin;
             cont->blocks.reserve(cont->m.keys.size());
             c->cont = std::move(cont);
-            suspend_for_cont(c);
+            // First slice inline: a RAM-resident batch completes right here
+            // with no suspension (no epoll re-arms, no extra tick).
+            run_getloc_slice(c);
+            if (!c->dead && c->cont != nullptr) suspend_for_cont(c);
             return;
         }
         case kOpRelease: {
