@@ -64,12 +64,42 @@ def test_survives_garbage_bytes(server):
 
 
 def test_survives_hostile_header_sizes(server):
-    # Valid magic, each op code, body_size from 0 to 4GB-ish: the server
-    # must bound allocations and drain or drop without dying.
-    for op in (ord("P"), ord("R"), ord("G"), ord("E"), ord("D"), ord("M"), 0xFF):
+    # Valid magic, EVERY op code — including the shm two-phase and one-RTT
+    # segment ops whose handlers park budget-sliced continuations — with
+    # body_size from 0 to 4GB-ish: the server must bound allocations,
+    # reject before suspending, and drain or drop without dying.
+    all_ops = (
+        wire.OP_PUT_BATCH, wire.OP_GET_BATCH, wire.OP_TCP_PUT, wire.OP_TCP_GET,
+        wire.OP_CHECK_EXIST, wire.OP_MATCH_LAST_IDX, wire.OP_DELETE_KEYS,
+        wire.OP_STAT, wire.OP_SHM_HELLO, wire.OP_PUT_ALLOC, wire.OP_PUT_COMMIT,
+        wire.OP_GET_LOC, wire.OP_RELEASE, wire.OP_REG_SEGMENT,
+        wire.OP_PUT_FROM, wire.OP_GET_INTO, 0xFF,
+    )
+    for op in all_ops:
         for body_size in (0, 1, 0xFFFF, 0x00FFFFFF, 0xFFFFFFFF):
             hdr = wire.pack_req_header(op, body_size & 0xFFFFFFFF)
             _blast(server.port, hdr + b"A" * min(body_size, 1 << 16))
+    assert _healthy(server)
+
+
+def test_survives_mutated_segment_frames(server):
+    """Bit-flipped SegBatchMeta frames (the one-RTT PutFrom/GetInto path):
+    the server must reject hostile seg ids/offsets/counts BEFORE any
+    continuation suspends, and stay healthy."""
+    rng = np.random.default_rng(23)
+    meta = wire.SegBatchMeta(
+        block_size=4096, seg_id=1, keys=["sg-a", "sg-b"], offsets=[0, 4096]
+    ).encode()
+    hdr_len = 9  # flips stay in the META region: header-field hostility is
+    # test_survives_hostile_header_sizes's job, and an inflated body_size
+    # would just make the server wait out the recv timeout (pure idle time).
+    for op in (wire.OP_PUT_FROM, wire.OP_GET_INTO):
+        base = wire.pack_req_header(op, len(meta)) + meta
+        for _ in range(200):
+            buf = bytearray(base)
+            for _ in range(rng.integers(1, 4)):
+                buf[rng.integers(hdr_len, len(buf))] ^= 1 << rng.integers(0, 8)
+            _blast(server.port, bytes(buf))
     assert _healthy(server)
 
 

@@ -57,3 +57,45 @@ def test_sustained_ops_do_not_leak():
     assert grown < 20, f"RSS grew {grown:.1f} MB over sustained ops"
     c.close()
     srv.stop()
+
+
+def test_spill_churn_does_not_leak():
+    """Sustained demote/promote churn through the budget-sliced segment
+    ops: continuations (SegCont allocations, banked pins, cont_queue
+    entries) and spill-slot bookkeeping must not accumulate."""
+    block = 16 << 10
+    srv = its.start_local_server(
+        prealloc_bytes=1 << 20, block_bytes=block,  # RAM holds 64 blocks
+        spill_dir="/tmp", spill_bytes=16 << 20,
+    )
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    n = 192  # 3x RAM -> constant churn
+    buf = c.alloc_shm_mr(n * block)
+    if buf is None:
+        buf = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+        c.register_mr(buf)
+    else:
+        buf[:] = 7
+    pairs = [(f"sc-{i}", i * block) for i in range(n)]
+
+    async def churn(rounds):
+        for _ in range(rounds):
+            for s in range(0, n, 32):
+                await c.write_cache_async(pairs[s : s + 32], block, buf.ctypes.data)
+            for s in range(0, n, 32):
+                await c.read_cache_async(pairs[s : s + 32], block, buf.ctypes.data)
+
+    asyncio.run(churn(3))  # warm allocators, spill file pages
+    gc.collect()
+    base = _rss_mb()
+    asyncio.run(churn(12))
+    gc.collect()
+    grown = _rss_mb() - base
+    stats = c.get_stats()["spill"]
+    assert stats["promotions"] > 500, "churn did not actually exercise spill"
+    assert grown < 20, f"RSS grew {grown:.1f} MB under spill churn"
+    c.close()
+    srv.stop()
