@@ -84,13 +84,26 @@ class DeviceGate:
         self._cond = asyncio.Condition()
         self._shared = 0
         self._exclusive = False
+        # Writer priority: a waiting mutator blocks NEW shared holders, or a
+        # steady stream of snapshot/verify phases could starve loads and
+        # computes indefinitely. (Phases are never nested per request, so
+        # priority cannot deadlock.)
+        self._exclusive_waiting = 0
 
     @asynccontextmanager
     async def exclusive(self):
         async with self._cond:
-            await self._cond.wait_for(
-                lambda: not self._exclusive and self._shared == 0
-            )
+            self._exclusive_waiting += 1
+            try:
+                await self._cond.wait_for(
+                    lambda: not self._exclusive and self._shared == 0
+                )
+            finally:
+                self._exclusive_waiting -= 1
+                # A cancelled wait (e.g. a timed-out request) may be the
+                # writer that shared() waiters queued behind; without this
+                # notify they would sleep forever on a free gate.
+                self._cond.notify_all()
             self._exclusive = True
         try:
             yield
@@ -102,7 +115,9 @@ class DeviceGate:
     @asynccontextmanager
     async def shared(self):
         async with self._cond:
-            await self._cond.wait_for(lambda: not self._exclusive)
+            await self._cond.wait_for(
+                lambda: not self._exclusive and self._exclusive_waiting == 0
+            )
             self._shared += 1
         try:
             yield

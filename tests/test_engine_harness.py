@@ -188,10 +188,53 @@ def test_device_gate_excludes_mutators():
         r2 = asyncio.ensure_future(reader("b", 0.02))
         await asyncio.sleep(0.005)
         w = asyncio.ensure_future(writer())
-        await asyncio.gather(r1, r2, w)
-        # Both readers overlapped (a+ b+ before a- b-), writer strictly after.
+        await asyncio.sleep(0.005)
+        # Writer priority: a reader arriving while the writer WAITS must
+        # queue behind it, or a steady reader stream starves every mutator.
+        r3 = asyncio.ensure_future(reader("c", 0.0))
+        await asyncio.gather(r1, r2, w, r3)
+        # Both early readers overlapped (a+ b+ before a- b-), writer after
+        # them, late reader after the writer.
         assert order.index("b+") < order.index("a-")
         assert order.index("w+") > order.index("a-")
         assert order.index("w+") > order.index("b-")
+        assert order.index("c+") > order.index("w-")
 
     asyncio.run(run())
+
+
+def test_device_gate_cancelled_writer_releases_queued_readers():
+    """A reader queued behind a WAITING writer must wake when that writer's
+    task is cancelled (e.g. a timed-out request) — not sleep forever on a
+    free gate."""
+
+    async def run():
+        gate = DeviceGate()
+        got = []
+
+        async def hold_shared():
+            async with gate.shared():
+                await asyncio.sleep(0.05)
+
+        async def writer():
+            async with gate.exclusive():
+                got.append("w")
+
+        async def late_reader():
+            async with gate.shared():
+                got.append("r2")
+
+        r1 = asyncio.ensure_future(hold_shared())
+        await asyncio.sleep(0.01)
+        w = asyncio.ensure_future(writer())
+        await asyncio.sleep(0.01)
+        r2 = asyncio.ensure_future(late_reader())
+        await asyncio.sleep(0.01)
+        w.cancel()
+        await asyncio.gather(r1, r2, w, return_exceptions=True)
+        assert got == ["r2"], got
+        async with gate.exclusive():  # gate still fully functional
+            got.append("w2")
+        assert got == ["r2", "w2"], got
+
+    asyncio.run(asyncio.wait_for(run(), 10))
