@@ -7,6 +7,7 @@
 // Deliberately dependency-free (no gtest in the image): tiny CHECK macro,
 // main() runs every case, nonzero exit on failure.
 #include <string.h>
+#include <sys/eventfd.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -25,7 +26,7 @@
 #include "its/protocol.h"
 #include "its/server.h"
 
-static int g_failures = 0;
+static std::atomic<int> g_failures{0};
 
 #define CHECK(cond)                                                            \
     do {                                                                       \
@@ -333,6 +334,107 @@ static void test_abandoned_sync_ops_stress(bool enable_shm) {
     (void)reconnects;
 }
 
+// Eventfd completion ring: concurrent pushes from the reactor against a
+// draining "event loop" thread, fd signalling semantics, and fail-all
+// delivery through the ring. Runs under ASAN and TSAN in CI — this is the
+// cross-thread structure the Python asyncio bridge relies on.
+static void test_completion_ring(bool enable_shm) {
+    ServerConfig scfg;
+    scfg.bind_addr = "127.0.0.1";
+    scfg.service_port = 0;
+    scfg.prealloc_bytes = 16 << 20;
+    scfg.block_size = 16 << 10;
+    scfg.pin_memory = false;
+    scfg.enable_shm = enable_shm;
+    Server server(scfg);
+    CHECK(server.start());
+
+    ClientConfig ccfg;
+    ccfg.host = "127.0.0.1";
+    ccfg.port = server.port();
+    ccfg.enable_shm = enable_shm;
+    Connection conn(ccfg);
+    CHECK(conn.connect() == 0);
+
+    int efd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    CHECK(efd >= 0);
+    conn.set_completion_fd(efd);
+
+    const size_t n = 4, bs = 16 << 10;
+    std::vector<char> src(n * bs);
+    for (size_t i = 0; i < src.size(); i++) src[i] = static_cast<char>(i * 13 + 3);
+    conn.register_mr(src.data(), src.size());
+    std::vector<std::string> keys;
+    std::vector<uint64_t> offs;
+    for (size_t i = 0; i < n; i++) {
+        keys.push_back("ring" + std::to_string(i));
+        offs.push_back(i * bs);
+    }
+
+    // Drainer thread = the event loop: waits on the fd, drains tokens.
+    const int kOps = 200;
+    std::atomic<bool> stop{false};
+    std::atomic<int> drained{0};
+    std::atomic<int> ok_codes{0};
+    std::thread drainer([&] {
+        uint64_t tokens[32];
+        int32_t codes[32];
+        while (!stop.load()) {
+            uint64_t v;
+            if (read(efd, &v, sizeof(v)) < 0)
+                std::this_thread::sleep_for(std::chrono::microseconds(200));
+            int got;
+            while ((got = conn.drain_completions(tokens, codes, 32)) > 0) {
+                for (int i = 0; i < got; i++) {
+                    drained.fetch_add(1);
+                    if (codes[i] == 200) ok_codes.fetch_add(1);
+                    CHECK(tokens[i] >= 1 && tokens[i] <= kOps);
+                }
+            }
+        }
+    });
+
+    // Ring-mode submits: cb = nullptr, ctx = token.
+    for (int i = 1; i <= kOps; i++) {
+        CHECK(conn.put_batch_async(keys, offs, bs, src.data(), nullptr,
+                                   reinterpret_cast<void*>(static_cast<uintptr_t>(i))) == 0);
+        if (i % 16 == 0) {
+            // Throttle so the in-flight window stays modest.
+            for (int spin = 0; spin < 2000 && drained.load() < i - 32; spin++)
+                std::this_thread::sleep_for(std::chrono::milliseconds(1));
+        }
+    }
+    for (int spin = 0; spin < 5000 && drained.load() < kOps; spin++)
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    CHECK(drained.load() == kOps);
+    CHECK(ok_codes.load() == kOps);
+
+    // fail_all delivery: submit, then close the connection — every pending
+    // op must surface through the ring with a non-200 code (or have
+    // completed 200 first), never vanish.
+    int before = drained.load();
+    int accepted = 0;
+    for (int i = 1; i <= 8; i++) {
+        if (conn.put_batch_async(keys, offs, bs, src.data(), nullptr,
+                                 reinterpret_cast<void*>(static_cast<uintptr_t>(i))) == 0)
+            accepted++;
+    }
+    CHECK(accepted == 8);  // a rejected submit never enters the ring
+    conn.close();  // reactor joined: completions (success or 503) are in the ring
+    uint64_t tokens[32];
+    int32_t codes[32];
+    int got, total_after = 0;
+    while ((got = conn.drain_completions(tokens, codes, 32)) > 0) total_after += got;
+    // Drainer may have consumed some first; between both, all 8 resolved.
+    stop.store(true);
+    drainer.join();
+    int resolved = drained.load() - before + total_after;
+    CHECK(resolved == accepted);
+
+    close(efd);
+    server.stop();
+}
+
 static void test_opstats_percentile_accuracy() {
     // The HDR-style histogram must report percentiles within ~10% — the
     // BASELINE latency metric is p50, so 2x power-of-two quantization is
@@ -369,12 +471,14 @@ int main() {
     test_wire_codec_roundtrip();
     test_loopback_end_to_end(/*enable_shm=*/true);
     test_loopback_end_to_end(/*enable_shm=*/false);
+    test_completion_ring(/*enable_shm=*/true);
+    test_completion_ring(/*enable_shm=*/false);
     test_abandoned_sync_ops_stress(/*enable_shm=*/true);
     test_abandoned_sync_ops_stress(/*enable_shm=*/false);
     if (g_failures == 0) {
         printf("native tests: all passed\n");
         return 0;
     }
-    fprintf(stderr, "native tests: %d failure(s)\n", g_failures);
+    fprintf(stderr, "native tests: %d failure(s)\n", g_failures.load());
     return 1;
 }
