@@ -1,0 +1,105 @@
+"""Cross-process prefill→decode disaggregation through the ENGINE adapter —
+BASELINE config 5 in the shape a real deployment has: the prefill engine and
+the decode engine are separate OS processes that share nothing but the store
+(reference scenario (a), README.md:13-14; its splitwise-demos analogue).
+
+The prefill process runs the demo Llama over the prompt and saves its KV
+through EngineKVAdapter. The decode process — fresh JAX runtime, fresh
+params from the same seed — probes the prefix at admission, loads every
+block through the adapter into ITS OWN block layout, verifies the KV against
+a locally recomputed prefill oracle, and runs a real decode step over the
+loaded cache. Byte movement crosses process boundaries on the store's data
+planes; nothing else is shared."""
+
+import subprocess
+import sys
+
+import pytest
+
+import infinistore_tpu as its
+
+_COMMON = r"""
+import asyncio, sys
+from infinistore_tpu.hostmesh import force_cpu_devices
+force_cpu_devices(1)
+import numpy as np
+import jax
+import jax.numpy as jnp
+import infinistore_tpu as its
+from infinistore_tpu import EngineKVAdapter, KVConnector
+from infinistore_tpu.models import LlamaConfig, decode_step, init_params, prefill
+
+port = int(sys.argv[1])
+want_shm = sys.argv[2] == "shm" 
+CFG = LlamaConfig(vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                  ffn_dim=128, block_tokens=8, dtype=jnp.float32)
+NUM_BLOCKS, REQ_BLOCKS = 16, 4
+params = init_params(CFG, jax.random.PRNGKey(0))  # same seed -> same engine
+prompt = (np.arange(REQ_BLOCKS * CFG.block_tokens) * 37 % CFG.vocab).tolist()
+conn = its.InfinityConnection(its.ClientConfig(
+    host_addr="127.0.0.1", service_port=port, log_level="error"))
+conn.connect()
+# The plane under test must actually be the plane in use (the shm handshake
+# degrading to socket would silently collapse both parametrizations).
+assert conn.shm_active == want_shm, f"shm_active={conn.shm_active}"
+adapter = EngineKVAdapter(
+    KVConnector(conn, CFG.kv_spec(NUM_BLOCKS), "disagg-engine", max_blocks=REQ_BLOCKS))
+"""
+
+_PREFILL = _COMMON + r"""
+caches = CFG.kv_spec(NUM_BLOCKS).make_caches()
+table = np.asarray([2, 5, 11, 7], np.int32)  # prefill engine's block layout
+_, caches = prefill(params, jnp.asarray(prompt, jnp.int32), caches,
+                    jnp.asarray(table), CFG)
+wrote = asyncio.run(adapter.save_kv(prompt, caches, table))
+assert wrote == 2 * CFG.n_layers * REQ_BLOCKS, wrote
+conn.close()
+print("prefill ok")
+"""
+
+_DECODE = _COMMON + r"""
+hit = adapter.get_num_matched_tokens(prompt)
+assert hit == len(prompt), f"expected full prefix hit, got {hit}"
+caches = CFG.kv_spec(NUM_BLOCKS).make_caches()
+table = np.asarray([9, 0, 3, 14], np.int32)  # DIFFERENT block layout
+caches, loaded = asyncio.run(adapter.load_kv(prompt, caches, table))
+assert loaded == len(prompt), f"loaded {loaded}"
+
+# Oracle: recompute the prefill locally (same params by construction).
+oracle = CFG.kv_spec(REQ_BLOCKS).make_caches()
+_, oracle = prefill(params, jnp.asarray(prompt, jnp.int32), oracle,
+                    jnp.arange(REQ_BLOCKS, dtype=jnp.int32), CFG)
+for layer in range(CFG.n_layers):
+    for kind in range(2):
+        got = np.asarray(caches[layer][kind][table], np.float32)
+        want = np.asarray(oracle[layer][kind], np.float32)
+        assert np.array_equal(got, want), f"KV mismatch L{layer} kind{kind}"
+
+# Real decode step over the loaded cache: the new token needs its OWN block
+# slot (position // block_tokens == REQ_BLOCKS), so the decode table carries
+# one spare entry beyond the loaded prefix.
+decode_table = np.append(table, np.int32(6))
+logits, _ = decode_step(params, jnp.int32(42), jnp.int32(len(prompt)),
+                        caches, jnp.asarray(decode_table), CFG, REQ_BLOCKS + 1)
+assert np.isfinite(np.asarray(logits)).all()
+conn.close()
+print("decode ok")
+"""
+
+
+@pytest.mark.parametrize("plane", ["shm", "socket"])
+def test_cross_process_engine_disagg(plane):
+    srv = its.start_local_server(
+        prealloc_bytes=64 << 20, block_bytes=64 << 10,
+        enable_shm=plane == "shm",
+    )
+    try:
+        for script, want in ((_PREFILL, "prefill ok"), (_DECODE, "decode ok")):
+            r = subprocess.run(
+                [sys.executable, "-c", script, str(srv.port), plane],
+                capture_output=True, text=True, timeout=300,
+            )
+            assert r.returncode == 0, f"{want} process failed:\n{r.stderr[-2000:]}"
+            assert want in r.stdout
+    finally:
+        srv.stop()
