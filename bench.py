@@ -621,27 +621,39 @@ def _tpu_connector_gbps(its, np, conn):
     # Noise guard: the ceiling does a strict subset of the pipeline's work,
     # so achieved > ceiling can only be timing noise — take more ceiling
     # samples until the invariant holds (min-time estimator converges).
-    for _ in range(5):
+    for _ in range(8):
         if best_save >= d2h_dt:
             break
         d2h_dt = min(d2h_dt, d2h_stage_once())
-    for _ in range(5):
+    for _ in range(8):
         if best_load >= h2d_dt:
             break
         h2d_dt = min(h2d_dt, h2d_stage_once(hosts))
 
     per_layer_d2h_ms = d2h_dt / spec.num_layers * 1e3
     per_layer_h2d_ms = h2d_dt / spec.num_layers * 1e3
-    return {
+    # If the box's swings still beat the guard (measured: a fast period
+    # during the pipeline samples and none during 14 ceiling samples can
+    # leave the "impossible" >1), CLAMP: ratio > 1 is self-contradictory by
+    # construction, and reporting it would be a measurement artifact
+    # masquerading as data. The raw value is kept for transparency.
+    save_ratio = d2h_dt / best_save  # achieved/ceiling rate = time ratio
+    load_ratio = h2d_dt / best_load
+    out = {
         "save_gbps": nbytes / best_save / (1 << 30),
         "load_gbps": nbytes / best_load / (1 << 30),
         "d2h_ceiling_gbps": nbytes / d2h_dt / (1 << 30),
         "h2d_ceiling_gbps": nbytes / h2d_dt / (1 << 30),
         "d2h_per_layer_ms": per_layer_d2h_ms,
         "h2d_per_layer_ms": per_layer_h2d_ms,
-        "save_vs_ceiling": (nbytes / best_save) / (nbytes / d2h_dt),
-        "load_vs_ceiling": (nbytes / best_load) / (nbytes / h2d_dt),
+        "save_vs_ceiling": min(1.0, save_ratio),
+        "load_vs_ceiling": min(1.0, load_ratio),
     }
+    if save_ratio > 1.0:
+        out["save_vs_ceiling_raw"] = save_ratio
+    if load_ratio > 1.0:
+        out["load_vs_ceiling_raw"] = load_ratio
+    return out
 
 
 def _engine_harness_metrics(its, np) -> dict:
@@ -814,6 +826,11 @@ def main() -> int:
                 "tpu_load_vs_ceiling": round(tpu["load_vs_ceiling"], 3),
             }
         )
+        # Present only when the noise guard couldn't converge and the ratio
+        # was clamped at its logical bound of 1.0 (see _tpu_connector_gbps).
+        for raw_key in ("save_vs_ceiling_raw", "load_vs_ceiling_raw"):
+            if raw_key in tpu:
+                extra[f"tpu_{raw_key}"] = round(tpu[raw_key], 3)
 
     print(
         json.dumps(
