@@ -1,11 +1,14 @@
 #!/usr/bin/env python
-"""Profile the H2D (load-path) stage shapes on the real chip.
+"""Profile H2D (load-path) transfer-shape primitives on the real chip.
 
-Question from the r3 bench: the load pipeline reaches 49% of its own H2D
-ceiling while the save side reaches 88%. The ceiling dispatches all
-device_puts back-to-back and blocks once; the reader interleaves fetches,
-device_puts from shm-segment views, scatters, and region-reuse barriers.
-This script isolates each axis:
+Historical harness from the r4 investigation of the r3 bench's finding that
+the load pipeline reached 49% of its own H2D ceiling. Its measurements
+(per-transfer fixed cost dominates; one packed transfer beats two; barriers
+on scatter outputs serialize where barriers on uploads don't) drove the
+current packed single-upload reader in tpu/layerwise.py — the "reader-shaped"
+configs below reproduce the OLD reader's shape, not the current one, and are
+kept for comparing transfer primitives when tunnel behavior shifts again.
+The configs isolate each axis:
 
   a. all-dispatch-then-block from standalone contiguous arrays (= r3 ceiling)
   b. same but source views into one big host buffer (= reader's slot views)
