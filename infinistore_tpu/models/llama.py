@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..tpu.paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
+from ..tpu.paged import PagedKVCacheSpec, scatter_blocks
+from ..tpu.paged_attention import paged_decode_attention
 
 Params = Dict[str, jax.Array]
 Caches = List[Tuple[jax.Array, jax.Array]]
@@ -123,14 +124,37 @@ def _attention(
     v: jax.Array,  # [B, T, KVH, D]
     mask: jax.Array,  # [B, S, T] True = attend
 ) -> jax.Array:
+    """Dense attention with the framework-wide numeric contract: logits and
+    softmax statistics in float32 (preferred_element_type keeps the MXU's
+    native f32 accumulation for bf16 operands; HIGHEST stops XLA from
+    running f32 operands in reduced-precision passes), output cast back to
+    the query dtype. The fused paged decode kernel
+    (tpu/paged_attention.py) and the ring/Ulysses paths follow the same
+    contract, so every attention implementation agrees to float32 rounding
+    on every backend."""
     groups = q.shape[2] // k.shape[2]
     k = jnp.repeat(k, groups, axis=2)
     v = jnp.repeat(v, groups, axis=2)
     scale = 1.0 / np.sqrt(q.shape[-1])
-    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    logits = (
+        jnp.einsum(
+            "bshd,bthd->bhst",
+            q,
+            k,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        * scale
+    )
     logits = jnp.where(mask[:, None, :, :], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthd->bshd", probs, v)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhst,bthd->bshd",
+        probs,
+        v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
+    return out.astype(q.dtype)
 
 
 def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
@@ -139,11 +163,16 @@ def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
     x: [B, S, dim]; k/v: [B, T, KVH, D] (full attention context); returns the
     block output and this segment's (k_new, v_new) before cache insertion."""
     pre = f"l{layer}."
-    h = _rms_norm(x, params[pre + "attn_norm"])
-    q = jnp.einsum("bsd,dhk->bshk", h, params[pre + "wq"])
-    q = _rope(q, q_positions, config.rope_theta)
+    q = _q_proj(params, layer, x, q_positions, config)
     attn = _attention(q, k, v, mask)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
+    return _ffn(params, layer, x, config)
+
+
+def _ffn(params: Params, layer: int, x, config):
+    """FFN half of the block (dense or soft-MoE), shared by the dense path
+    and the fused-decode path."""
+    pre = f"l{layer}."
     h = _rms_norm(x, params[pre + "ffn_norm"])
     if config.n_experts > 0:
         # Soft MoE, expert-major: every einsum keeps the expert axis e
@@ -163,6 +192,13 @@ def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
     gate_up = jnp.einsum("bsd,dcf->bscf", h, params[pre + "w_gate_up"])
     ffn = jax.nn.silu(gate_up[:, :, 0]) * gate_up[:, :, 1]
     return x + jnp.einsum("bsf,fd->bsd", ffn, params[pre + "w_down"])
+
+
+def _q_proj(params: Params, layer: int, x, positions, config):
+    pre = f"l{layer}."
+    h = _rms_norm(x, params[pre + "attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, params[pre + "wq"])
+    return _rope(q, positions, config.rope_theta)
 
 
 def _kv_proj(params: Params, layer: int, x, positions, config):
@@ -226,16 +262,23 @@ def decode_step(
     max_blocks: int,
 ) -> Tuple[jax.Array, Caches]:
     """One decode token against the paged cache: append this token's K/V into
-    its block slot, attend over all context blocks. Returns (logits, caches)."""
+    its block slot, then fused paged attention over the context blocks
+    (tpu/paged_attention.py: on TPU each context block crosses HBM exactly
+    once — no materialized gather; gather+dense XLA elsewhere, same f32
+    softmax contract). ``max_blocks`` must equal the padded block_table
+    length (validated at trace time — a mismatch fails loudly, as the old
+    gather-and-reshape path did). Returns (logits, caches)."""
+    if block_table.shape[0] != max_blocks:
+        raise ValueError(
+            f"block_table has {block_table.shape[0]} entries, expected "
+            f"max_blocks={max_blocks} (pad the table to the static bound)"
+        )
     bt = config.block_tokens
     pos = position[None]  # [1]
     x = jnp.take(params["embed"], token[None], axis=0)[None]  # [1, 1, dim]
 
     block_idx = block_table[position // bt]
     slot = position % bt
-    ctx = max_blocks * bt
-    ctx_positions = jnp.arange(ctx, dtype=jnp.int32)
-    mask = (ctx_positions <= position)[None, None, :]  # [1, 1, T]
 
     new_caches: Caches = []
     for layer, (k_cache, v_cache) in enumerate(caches):
@@ -247,14 +290,13 @@ def decode_step(
         v_cache = jax.lax.dynamic_update_slice(
             v_cache, v.astype(v_cache.dtype), (block_idx, slot, 0, 0)
         )
-        # Gather the sequence's context blocks and attend.
-        k_ctx = gather_blocks(k_cache, block_table).reshape(
-            1, ctx, config.n_kv_heads, config.head_dim
+        pre = f"l{layer}."
+        q = _q_proj(params, layer, x, pos[None], config)
+        attn = paged_decode_attention(
+            q[0, 0], k_cache, v_cache, block_table, position + 1
         )
-        v_ctx = gather_blocks(v_cache, block_table).reshape(
-            1, ctx, config.n_kv_heads, config.head_dim
-        )
-        x = _block(params, layer, x, k_ctx, v_ctx, pos[None], mask, config)
+        x = x + jnp.einsum("hk,hkd->d", attn, params[pre + "wo"])[None, None]
+        x = _ffn(params, layer, x, config)
         new_caches.append((k_cache, v_cache))
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])

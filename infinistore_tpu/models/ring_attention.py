@@ -50,7 +50,15 @@ def _ring_attention_local(
         m, l, o, k_cur, v_cur = carry
         src = (rank - i) % ring
         k_pos = src * s_loc + jnp.arange(s_loc)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)) * scale
+        scores = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk",
+                q32,
+                k_cur.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            * scale
+        )
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]  # [S_loc, S_loc] global
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
@@ -62,7 +70,10 @@ def _ring_attention_local(
         p = jnp.where(jnp.isneginf(scores), 0.0, p)
         l = l * corr + p.sum(axis=-1)
         o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32)
+            "bhqk,bkhd->bhqd",
+            p,
+            v_cur.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
         )
         k_nxt = jax.lax.ppermute(k_cur, axis, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, perm)
@@ -115,7 +126,10 @@ def dense_attention_reference(
     """The oracle: plain softmax attention over the full sequence."""
     d = q.shape[-1]
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
     ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
     if causal:
         s = q.shape[1]
@@ -124,5 +138,10 @@ def dense_attention_reference(
     if mask is not None:
         scores = jnp.where(mask, scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    out = jnp.einsum(
+        "bhqk,bkhd->bhqd",
+        p,
+        v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    )
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)

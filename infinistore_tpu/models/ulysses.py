@@ -41,14 +41,25 @@ def _ulysses_local(q, k, v, axis: str, causal: bool):
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     s = ring * s_loc
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    # Framework-wide attention contract (models/llama.py _attention): f32
+    # softmax statistics, HIGHEST-precision dots (XLA's DEFAULT runs f32
+    # operands in reduced-precision passes on TPU).
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", qf.astype(jnp.float32), kf.astype(jnp.float32)
+        "bqhd,bkhd->bhqk",
+        qf.astype(jnp.float32),
+        kf.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
     ) * scale
     if causal:
         cm = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]
         scores = jnp.where(cm[None, None], scores, -jnp.inf)
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vf.astype(jnp.float32)).astype(q.dtype)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd",
+        p,
+        vf.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(q.dtype)
     return heads_to_seq(out)
 
 

@@ -18,10 +18,13 @@ from .paged import (
     scatter_blocks,
     scatter_blocks_xla,
 )
+from .paged_attention import paged_decode_attention, paged_decode_attention_xla
 from .staging import HostStagingPool, StagedTransfer
 from .layerwise import LayerwiseKVReader, LayerwiseKVWriter, kv_block_key
 
 __all__ = [
+    "paged_decode_attention",
+    "paged_decode_attention_xla",
     "HostStagingPool",
     "StagedTransfer",
     "PagedKVCacheSpec",

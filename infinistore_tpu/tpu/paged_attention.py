@@ -1,0 +1,208 @@
+"""Fused paged decode attention: one query token attending over the paged KV
+cache, computed block-by-block with an online softmax — no materialized
+context.
+
+This is the hot op on the consumer side of the store. The engine resumes a
+request from fetched cache blocks and then decodes token-by-token; every
+decode step attends over the whole context. The unfused path (gather_blocks
+then dense attention) moves each context block HBM->HBM into a contiguous
+buffer and then reads it again for attention — every cached byte crosses HBM
+three times per token. Decode attention does O(1) FLOPs per byte, so it is
+purely HBM-bandwidth-bound and that 3x is the whole cost. The fused kernel
+reads each block exactly once: the scalar-prefetched block table drives the
+BlockSpec index maps (the pipeline DMAs cache[table[i]] directly into VMEM,
+double-buffering consecutive blocks), and a flash-style running
+(max, sum, acc) in VMEM scratch folds each block into the softmax as it
+arrives. The reference never needed this op — CUDA engines bring their own
+paged attention (vLLM) and the store hands them raw pointers; on TPU the
+engine-side kernel is part of the framework's job.
+
+GQA layout: q is [n_heads, head_dim] against caches of n_kv_heads; the
+kernel unrolls over kv heads and issues one MXU dot per (kv head, block) —
+no batched dot_general, which Mosaic handles unevenly at small shapes.
+
+Numerical contract (shared with the XLA fallback and the dense oracle in
+models/llama.py): logits and softmax statistics in float32, output cast to
+the query dtype. Positions >= seq_len are masked out; padded block-table
+entries past the sequence contribute nothing (their probabilities are
+explicitly zeroed, so a whole-block mask cannot poison the running max).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+_NEG_INF = -1e30
+
+
+def _decode_attn_kernel(
+    table_ref,  # scalar-prefetch: [max_blocks] int32 (unused in body; drives DMA)
+    seqlen_ref,  # scalar-prefetch: [1] int32 valid context length
+    q_ref,  # [H, D] query dtype
+    k_ref,  # [1, bt, KVH, D] one cache block
+    v_ref,  # [1, bt, KVH, D]
+    out_ref,  # [H, D]
+    m_scr,  # VMEM [H, 128] f32 running max (broadcast across lanes)
+    l_scr,  # VMEM [H, 128] f32 running denominator
+    acc_scr,  # VMEM [H, D] f32 running numerator
+):
+    del table_ref
+    i = pl.program_id(0)
+    h, d = q_ref.shape
+    bt, kvh = k_ref.shape[1], k_ref.shape[2]
+    groups = h // kvh
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # All dots request f32 accumulation at HIGHEST precision: XLA's DEFAULT
+    # runs f32 matmuls in bf16 passes (on TPU and on this CPU build), which
+    # would quantize the softmax statistics.
+    scale = 1.0 / np.sqrt(d)
+    q = q_ref[...].astype(jnp.float32)  # [H, D]
+    k = k_ref[0].astype(jnp.float32)  # [bt, KVH, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    # Per-kv-head MXU dots, stacked head-major: logits[H, bt].
+    logits = (
+        jnp.concatenate(
+            [
+                jax.lax.dot_general(
+                    q[g * groups : (g + 1) * groups],  # [G, D]
+                    k[:, g, :],  # [bt, D]
+                    (((1,), (1,)), ((), ())),
+                    precision=jax.lax.Precision.HIGHEST,
+                )
+                for g in range(kvh)
+            ],
+            axis=0,
+        )
+        * scale
+    )
+
+    pos = i * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
+    valid = pos < seqlen_ref[0]
+    logits = jnp.where(valid, logits, _NEG_INF)
+
+    m_prev = m_scr[...]  # [H, 128] (all lanes equal)
+    m_curr = jnp.max(logits, axis=1, keepdims=True)  # [H, 1]
+    m_next = jnp.maximum(m_prev, m_curr)  # [H, 128]
+    alpha = jnp.exp(m_prev[:, :1] - m_next[:, :1])  # [H, 1]
+    p = jnp.exp(logits - m_next[:, :1])  # [H, bt]
+    # A fully-masked block leaves m_next at _NEG_INF and exp(0)=1 would leak
+    # weight onto padded slots; zero them unconditionally instead.
+    p = jnp.where(valid, p, 0.0)
+
+    l_next = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)  # [H, 1]
+    pv = jnp.concatenate(
+        [
+            jax.lax.dot_general(
+                p[g * groups : (g + 1) * groups],  # [G, bt]
+                v[:, g, :],  # [bt, D]
+                (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            for g in range(kvh)
+        ],
+        axis=0,
+    )  # [H, D]
+    m_scr[...] = m_next
+    l_scr[...] = jax.lax.broadcast_in_dim(l_next, l_scr.shape, (0, 1))
+    acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _finish():
+        out_ref[...] = (acc_scr[...] / l_scr[:, :1]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_pallas(q, k_cache, v_cache, block_table, seq_len, *, interpret):
+    h, d = q.shape
+    _, bt, kvh, _ = k_cache.shape
+    n = block_table.shape[0]
+    block = (1, bt, kvh, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((h, d), lambda i, tbl, sl: (0, 0)),
+            pl.BlockSpec(block, lambda i, tbl, sl: (tbl[i], 0, 0, 0)),
+            pl.BlockSpec(block, lambda i, tbl, sl: (tbl[i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((h, d), lambda i, tbl, sl: (0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    seq_len = jnp.asarray(seq_len, dtype=jnp.int32).reshape(1)
+    return pl.pallas_call(
+        _decode_attn_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((h, d), q.dtype),
+        interpret=interpret,
+    )(block_table, seq_len, q, k_cache, v_cache)
+
+
+@jax.jit
+def paged_decode_attention_xla(q, k_cache, v_cache, block_table, seq_len):
+    """Reference semantics on any backend: gather the table's blocks, mask
+    positions >= seq_len, dense softmax. Same f32 statistics as the kernel."""
+    h, d = q.shape
+    _, bt, kvh, _ = k_cache.shape
+    groups = h // kvh
+    k = jnp.take(k_cache, block_table, axis=0).reshape(-1, kvh, d)  # [T, KVH, D]
+    v = jnp.take(v_cache, block_table, axis=0).reshape(-1, kvh, d)
+    k = jnp.repeat(k, groups, axis=1)  # [T, H, D]
+    v = jnp.repeat(v, groups, axis=1)
+    scale = 1.0 / np.sqrt(d)
+    logits = (
+        jnp.einsum(
+            "hd,thd->ht",
+            q.astype(jnp.float32),
+            k.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        * scale
+    )
+    t = k.shape[0]
+    valid = jnp.arange(t, dtype=jnp.int32) < seq_len
+    logits = jnp.where(valid[None, :], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum(
+        "ht,thd->hd",
+        probs,
+        v.astype(jnp.float32),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(q.dtype)
+
+
+def _use_pallas() -> bool:
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def paged_decode_attention(q, k_cache, v_cache, block_table, seq_len):
+    """Single-token decode attention over the paged cache.
+
+    q: [n_heads, head_dim]; k_cache/v_cache: [num_blocks, block_tokens,
+    n_kv_heads, head_dim]; block_table: [max_blocks] int32 (entries past the
+    sequence may be any valid block id); seq_len: scalar int32 count of valid
+    context tokens. Returns [n_heads, head_dim] in q's dtype. Fused Pallas
+    kernel on TPU, gather+dense XLA elsewhere."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas(
+            q, k_cache, v_cache, block_table, seq_len, interpret=False
+        )
+    return paged_decode_attention_xla(q, k_cache, v_cache, block_table, seq_len)

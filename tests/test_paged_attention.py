@@ -1,0 +1,115 @@
+"""Fused paged decode attention: the Pallas kernel (interpret mode on CPU)
+against the XLA fallback and a from-scratch numpy oracle, across GQA shapes,
+partial blocks, and padded tables. The reference has no engine-side compute
+at all (SURVEY.md §2.9) — this kernel is the TPU build's consumer-side hot
+op (models/llama.py decode_step attends through it)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from infinistore_tpu.tpu.paged_attention import (
+    _paged_decode_attention_pallas,
+    paged_decode_attention_xla,
+)
+
+
+def _numpy_oracle(q, k_cache, v_cache, table, seq_len):
+    """Dense decode attention in float64 numpy: gather, mask, softmax."""
+    q = np.asarray(q, np.float64)
+    h, d = q.shape
+    kvh = k_cache.shape[2]
+    groups = h // kvh
+    k = np.asarray(k_cache, np.float64)[np.asarray(table)].reshape(-1, kvh, d)
+    v = np.asarray(v_cache, np.float64)[np.asarray(table)].reshape(-1, kvh, d)
+    k = np.repeat(k, groups, axis=1)
+    v = np.repeat(v, groups, axis=1)
+    logits = np.einsum("hd,thd->ht", q, k) / np.sqrt(d)
+    logits[:, seq_len:] = -np.inf
+    p = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    return np.einsum("ht,thd->hd", p, v)
+
+
+CASES = [
+    # (num_blocks, block_tokens, kv_heads, head_dim, q_heads, table_len)
+    (16, 8, 4, 16, 8, 8),  # GQA x2
+    (32, 16, 2, 32, 8, 16),  # GQA x4
+    (8, 8, 8, 16, 8, 4),  # MHA (no GQA)
+    (16, 8, 1, 64, 4, 16),  # MQA (one kv head)
+]
+
+
+@pytest.mark.parametrize("case", CASES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_matches_oracle(case, dtype):
+    n, bt, kvh, d, h, ntbl = case
+    rng = np.random.default_rng(hash(case) % 2**32)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), dtype)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), dtype)
+    q = jnp.asarray(rng.standard_normal((h, d)), dtype)
+    table = jnp.asarray(rng.permutation(n)[:ntbl], jnp.int32)
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    # seq lens: single token, partial block, block boundary, mid-table, full.
+    for sl in (1, bt - 1, bt, ntbl * bt // 2 + 3, ntbl * bt):
+        want = _numpy_oracle(q, k_cache, v_cache, table, sl)
+        got = _paged_decode_attention_pallas(
+            q, k_cache, v_cache, table, sl, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float64), want, rtol=tol, atol=tol,
+            err_msg=f"sl={sl}",
+        )
+        got_xla = paged_decode_attention_xla(q, k_cache, v_cache, table, sl)
+        np.testing.assert_allclose(
+            np.asarray(got_xla, np.float64), want, rtol=tol, atol=tol
+        )
+
+
+def test_padded_table_entries_are_ignored():
+    """Entries past seq_len may alias ANY valid block (engines pad with 0);
+    their contents must not leak into the output."""
+    n, bt, kvh, d, h = 8, 8, 2, 16, 4
+    rng = np.random.default_rng(7)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((h, d)), jnp.float32)
+    sl = bt + 3  # two blocks in play, second partial
+    base = jnp.asarray([2, 5, 0, 0], jnp.int32)
+    alias = jnp.asarray([2, 5, 7, 1], jnp.int32)  # different garbage tail
+    out_base = _paged_decode_attention_pallas(
+        q, k_cache, v_cache, base, sl, interpret=True
+    )
+    out_alias = _paged_decode_attention_pallas(
+        q, k_cache, v_cache, alias, sl, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out_base), np.asarray(out_alias))
+
+
+def test_decode_step_uses_contract_matching_prefill():
+    """decode_step routes attention through the dispatcher; on CPU that is
+    the XLA fallback, and the f32-softmax contract keeps incremental decode
+    equal to full prefill (the tight-tolerance invariant the model tests
+    pin). This guards the dispatcher wiring specifically."""
+    from infinistore_tpu.models import LlamaConfig, decode_step, init_params, prefill
+
+    cfg = LlamaConfig(
+        vocab=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=64,
+        block_tokens=8, dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    full = jax.random.randint(jax.random.PRNGKey(1), (24,), 0, cfg.vocab)
+    table = jnp.asarray([3, 1, 6, 2], jnp.int32)
+    caches = cfg.kv_spec(8).make_caches()
+    ref_logits, _ = prefill(
+        params, full, cfg.kv_spec(8).make_caches(), table[:3], cfg
+    )
+    logits, caches = prefill(params, full[:16], caches, table[:2], cfg)
+    for pos in range(16, 24):
+        logits, caches = decode_step(
+            params, full[pos], jnp.int32(pos), caches, table, cfg, 4
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
