@@ -656,6 +656,59 @@ def _tpu_connector_gbps(its, np, conn):
     return out
 
 
+def _tpu_decode_attention_us(np) -> dict:
+    """Consumer-side hot op: fused paged decode attention (Pallas) vs the
+    gather+dense XLA path on the default backend, Llama-8B-ish decode shape
+    (32 q heads / 8 kv heads / head_dim 128, 4k-token context in 16-token
+    blocks). Per-call synchronous medians over distinct block tables — on
+    the tunneled chip the dispatch RTT floors both numbers identically, so
+    the DELTA is the op comparison; absolute us are this-host figures."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas,
+        _use_pallas,
+        paged_decode_attention_xla,
+    )
+
+    if not _use_pallas():
+        # Off-TPU the dispatcher IS the XLA path; timing it against itself
+        # would report timer noise as a kernel comparison.
+        return {}
+
+    N, bt, kvh, d, h, ntbl = 4096, 16, 8, 128, 32, 256
+    rng = np.random.default_rng(0)
+    k_cache = jnp.asarray(rng.standard_normal((N, bt, kvh, d)), jnp.bfloat16)
+    v_cache = jnp.asarray(rng.standard_normal((N, bt, kvh, d)), jnp.bfloat16)
+    q = jnp.asarray(rng.standard_normal((h, d)), jnp.bfloat16)
+    tables = [jnp.asarray(rng.permutation(N)[:ntbl], jnp.int32) for _ in range(24)]
+    sl = jnp.int32(ntbl * bt)
+
+    def median_us(fn) -> float:
+        fn(tables[0]).block_until_ready()  # compile
+        ts = []
+        for t in tables:
+            t0 = _time.perf_counter()
+            fn(t).block_until_ready()
+            ts.append((_time.perf_counter() - t0) * 1e6)
+        return sorted(ts)[len(ts) // 2]
+
+    fused = median_us(
+        lambda t: _paged_decode_attention_pallas(
+            q, k_cache, v_cache, t, sl, interpret=False
+        )
+    )
+    dense = median_us(lambda t: paged_decode_attention_xla(q, k_cache, v_cache, t, sl))
+    return {
+        "decode_attn_fused_us": fused,
+        "decode_attn_gather_dense_us": dense,
+        "decode_attn_speedup": dense / fused,
+    }
+
+
 def _engine_harness_metrics(its, np) -> dict:
     """BASELINE config 4, engine-shaped: the continuous-batching harness
     drives the connector like a vLLM-TPU-style engine — concurrent requests
@@ -756,6 +809,13 @@ def main() -> int:
         # must fail the bench, not masquerade as a missing chip.
         tpu = None
         backend = f"unavailable ({type(e).__name__})"
+    if tpu is not None:
+        # Own guard: a failure here (e.g. kernel OOM at the 4k-context
+        # shape) must not discard the connector metrics already measured.
+        try:
+            tpu.update(_tpu_decode_attention_us(np))
+        except RuntimeError:
+            pass
 
     conn.close()
     srv.stop()
@@ -826,6 +886,20 @@ def main() -> int:
                 "tpu_load_vs_ceiling": round(tpu["load_vs_ceiling"], 3),
             }
         )
+        if "decode_attn_fused_us" in tpu:
+            # Fused Pallas decode attention vs gather+dense at a 4k context
+            # (tpu/paged_attention.py); the delta is the comparison — the
+            # tunnel RTT floors both absolutes equally. Present only on a
+            # real TPU backend (off-TPU both paths are the same function).
+            extra.update(
+                {
+                    "tpu_decode_attn_fused_us": round(tpu["decode_attn_fused_us"], 1),
+                    "tpu_decode_attn_gather_dense_us": round(
+                        tpu["decode_attn_gather_dense_us"], 1
+                    ),
+                    "tpu_decode_attn_speedup": round(tpu["decode_attn_speedup"], 2),
+                }
+            )
         # Present only when the noise guard couldn't converge and the ratio
         # was clamped at its logical bound of 1.0 (see _tpu_connector_gbps).
         for raw_key in ("save_vs_ceiling_raw", "load_vs_ceiling_raw"):
