@@ -810,12 +810,16 @@ def main() -> int:
         tpu = None
         backend = f"unavailable ({type(e).__name__})"
     if tpu is not None:
-        # Own guard: a failure here (e.g. kernel OOM at the 4k-context
-        # shape) must not discard the connector metrics already measured.
+        # Own guard: a failure here (e.g. kernel OOM or a Pallas lowering
+        # error at the 4k-context shape) must not discard the connector
+        # metrics already measured. AssertionErrors are data-verification
+        # failures and must still fail the bench (module policy above).
         try:
             tpu.update(_tpu_decode_attention_us(np))
-        except RuntimeError:
-            pass
+        except AssertionError:
+            raise
+        except Exception as e:
+            tpu["decode_attn_error"] = type(e).__name__
 
     conn.close()
     srv.stop()
@@ -886,6 +890,8 @@ def main() -> int:
                 "tpu_load_vs_ceiling": round(tpu["load_vs_ceiling"], 3),
             }
         )
+        if "decode_attn_error" in tpu:
+            extra["tpu_decode_attn_error"] = tpu["decode_attn_error"]
         if "decode_attn_fused_us" in tpu:
             # Fused Pallas decode attention vs gather+dense at a 4k context
             # (tpu/paged_attention.py); the delta is the comparison — the

@@ -1,0 +1,93 @@
+"""A KV pool spanning several servers (reference scenario 2, README.md:13-16,
+scaled out: the reference serves its extra-large pool from ONE process and
+leaves multi-node routing to LMCache; here the framework provides it).
+
+Three local servers become one ClusterKVConnector. Prompts route by the hash
+of their FIRST token block (rendezvous hashing), so every prompt sharing a
+system prefix lands on the same server and per-server longest-prefix match
+keeps working. Stopping one server shows the degrade policy: its prompts
+become cache misses (recompute), everyone else's keep hitting.
+"""
+
+import asyncio
+import os
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+# Allow running straight from a repo checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import infinistore_tpu as its
+from infinistore_tpu import ClusterKVConnector
+from infinistore_tpu.tpu import PagedKVCacheSpec
+
+
+def main():
+    spec = PagedKVCacheSpec(
+        num_layers=4, num_blocks=32, block_tokens=8, num_kv_heads=2,
+        head_dim=64, dtype=jnp.bfloat16,
+    )
+    servers, conns = [], []
+    for _ in range(3):
+        srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=16 << 10)
+        conn = its.InfinityConnection(
+            its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                             log_level="error")
+        )
+        conn.connect()
+        servers.append(srv)
+        conns.append(conn)
+    try:
+        cluster = ClusterKVConnector(
+            conns, spec, model_id="demo", max_blocks=8, degrade=True
+        )
+
+        # 12 prompts with distinct roots spread over the members.
+        prompts = [
+            [seed * 1000 + t for t in range(2 * spec.block_tokens)]
+            for seed in range(12)
+        ]
+        for i, p in enumerate(prompts):
+            caches = [
+                (
+                    jnp.full(spec.cache_shape, i + 1, spec.dtype),
+                    jnp.full(spec.cache_shape, -(i + 1), spec.dtype),
+                )
+                for _ in range(spec.num_layers)
+            ]
+            asyncio.run(cluster.save(p, caches, np.array([0, 1], np.int32)))
+        owners = [cluster.owner_index(p) for p in prompts]
+        print("owner per prompt:", owners)
+        print("members used:", sorted(set(owners)))
+
+        hits = sum(cluster.lookup(p) for p in prompts)
+        print(f"blocks cached across the pool: {hits}")
+
+        # Drain one member: only its prompts degrade to misses.
+        victim = owners[0]
+        servers[victim].stop()
+        after = [cluster.lookup(p) for p in prompts]
+        lost = sum(1 for o, h in zip(owners, after) if o == victim and h == 0)
+        kept = sum(1 for o, h in zip(owners, after) if o != victim and h == 2)
+        print(
+            f"after stopping member {victim}: {lost} prompts degraded to "
+            f"miss, {kept} still fully cached, degraded_ops="
+            f"{cluster.degraded_ops}"
+        )
+    finally:
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    main()

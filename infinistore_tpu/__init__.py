@@ -47,12 +47,18 @@ def __getattr__(name):
         from . import engine
 
         return getattr(engine, name)
+    if name in ("ClusterKVConnector", "rendezvous_owner"):
+        from . import cluster
+
+        return getattr(cluster, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = [
     "KVConnector",
     "token_chain_hashes",
+    "ClusterKVConnector",
+    "rendezvous_owner",
     "EngineKVAdapter",
     "ContinuousBatchingHarness",
     "BlockPool",

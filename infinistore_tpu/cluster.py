@@ -1,0 +1,185 @@
+"""Multi-server KV pool: route requests across independent store servers.
+
+The reference serves its "extra-large KV-cache pool + cross-node reuse"
+scenario (reference README.md:13-16) with ONE server process; pooling across
+several nodes is left to the layer above (LMCache routing). This module is
+that layer for the TPU build: a cluster of independent servers presented as
+one ``KVConnector``-shaped surface, so an engine (or the continuous-batching
+harness) scales its cache pool horizontally without any change at the call
+sites.
+
+Routing is **prefix-affine**: a request's owner is chosen by rendezvous
+(HRW) hashing of its chain ROOT — the hash of the first token block
+(connector.py token_chain_hashes). Every prompt sharing a first block maps
+to the same server, so an entire prefix tree colocates and the store's
+binary-search longest-prefix match keeps working per-server with no
+cross-server merge. Rendezvous hashing makes membership changes cheap:
+removing a server remaps only the keys it owned; every other root keeps its
+owner (tested), which is what lets an operator drain one cache node without
+invalidating the rest of the pool.
+
+Failure policy is explicit: ``degrade=False`` (default) propagates member
+transport errors — the engine must see "store unreachable" (the lookup()
+contract, connector.py). ``degrade=True`` converts a DOWN member into cache
+misses (lookup 0 / load 0 / save skipped, counted in ``degraded_ops``): on
+an engine, a dead cache node should cost recompute, not availability.
+"""
+
+import hashlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .connector import KVConnector, token_chain_hashes
+from .lib import InfiniStoreException
+from .tpu.layerwise import PartialReadError
+from .tpu.paged import PagedKVCacheSpec
+
+
+def rendezvous_owner(member_ids: Sequence[str], root: str) -> int:
+    """Index of the HRW winner for ``root``: argmax of
+    sha256(member_id | root). Stable under membership change — removing one
+    member only remaps the roots it owned."""
+    if not member_ids:
+        raise ValueError("rendezvous_owner needs at least one member")
+    best, best_score = 0, b""
+    for i, mid in enumerate(member_ids):
+        score = hashlib.sha256(f"{mid}|{root}".encode()).digest()
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+class ClusterKVConnector:
+    """``KVConnector`` surface over N servers with prefix-affine routing.
+
+    Duck-type compatible with what ``EngineKVAdapter`` needs (``spec``,
+    ``lookup``/``load``/``save``/``drop``), so the continuous-batching
+    harness runs unmodified over a cluster pool. Each member builds its own
+    ``KVConnector`` (staging pool registered on that member's connection);
+    ``handoff`` stays a per-member concern — it is mesh topology, not key
+    routing.
+    """
+
+    def __init__(
+        self,
+        conns: Sequence,
+        spec: PagedKVCacheSpec,
+        model_id: str,
+        max_blocks: int,
+        member_ids: Optional[Sequence[str]] = None,
+        degrade: bool = False,
+    ):
+        if not conns:
+            raise ValueError("cluster needs at least one connection")
+        if member_ids is None:
+            # host:port is stable across restarts and list reordering; an
+            # operator can pass explicit ids when addresses are ephemeral.
+            member_ids = [
+                f"{c.config.host_addr}:{c.config.service_port}" for c in conns
+            ]
+        if len(member_ids) != len(conns):
+            raise ValueError(
+                f"{len(member_ids)} member_ids for {len(conns)} connections"
+            )
+        if len(set(member_ids)) != len(member_ids):
+            raise ValueError(f"member_ids must be unique, got {member_ids}")
+        self.member_ids = list(member_ids)
+        self.members = [
+            KVConnector(c, spec, model_id, max_blocks) for c in conns
+        ]
+        self.spec = spec
+        self.model_id = model_id
+        self.max_blocks = max_blocks
+        self.degrade = degrade
+        self.degraded_ops = 0
+
+    # -- routing -------------------------------------------------------------
+
+    def owner_index(self, token_ids: Sequence[int]) -> Optional[int]:
+        """Which member owns this prompt's prefix tree (None when the prompt
+        has no complete block — nothing to route)."""
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if not chains:
+            return None
+        return rendezvous_owner(self.member_ids, chains[0])
+
+    def _owner(self, token_ids) -> Optional[KVConnector]:
+        i = self.owner_index(token_ids)
+        return None if i is None else self.members[i]
+
+    def _absorb(self, exc: InfiniStoreException) -> None:
+        """The failure policy, in one place: strict mode re-raises the
+        member's error; degrade mode counts it (caller then returns its
+        miss value)."""
+        if not self.degrade:
+            raise exc
+        self.degraded_ops += 1
+
+    # -- engine surface (KVConnector-shaped) ---------------------------------
+
+    def lookup(self, token_ids: Sequence[int]) -> int:
+        member = self._owner(token_ids)
+        if member is None:
+            return 0
+        try:
+            return member.lookup(token_ids)
+        except InfiniStoreException as e:
+            self._absorb(e)
+            return 0
+
+    async def load(self, token_ids, caches, block_ids: np.ndarray):
+        member = self._owner(token_ids)
+        if member is None:
+            return list(caches), 0
+        try:
+            return await member.load(token_ids, caches, block_ids)
+        except PartialReadError as e:
+            # The member died mid-read AFTER some layers' scatters donated
+            # their input buffers: the partial list is the only live one.
+            self._absorb(e)
+            return e.caches, 0
+        except InfiniStoreException as e:
+            self._absorb(e)
+            return list(caches), 0
+
+    async def save(
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0
+    ) -> int:
+        member = self._owner(token_ids)
+        if member is None:
+            return 0
+        try:
+            return await member.save(
+                token_ids, caches, block_ids, first_block=first_block
+            )
+        except InfiniStoreException as e:
+            self._absorb(e)
+            return 0
+
+    def drop(self, token_ids) -> int:
+        member = self._owner(token_ids)
+        if member is None:
+            return 0
+        try:
+            return member.drop(token_ids)
+        except InfiniStoreException as e:
+            self._absorb(e)
+            return 0
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> List[dict]:
+        """Per-member connection stats with the member id attached; an
+        unreachable member reports ``{"unreachable": True}`` instead of
+        killing the listing (the cluster's own counter is
+        ``degraded_ops``)."""
+        out = []
+        for mid, m in zip(self.member_ids, self.members):
+            try:
+                s = dict(m.conn.get_stats())
+            except InfiniStoreException:
+                s = {"unreachable": True}
+            s["member_id"] = mid
+            out.append(s)
+        return out

@@ -26,7 +26,7 @@ from .lib import (
     InfiniStoreNoMatch,
     InfiniStoreResourcePressure,
 )
-from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter
+from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter, PartialReadError
 from .tpu.paged import PagedKVCacheSpec
 from .tpu.staging import HostStagingPool
 
@@ -192,15 +192,20 @@ class KVConnector:
             out = await self._reader.read(
                 caches, np.asarray(block_ids[:n]), self._key_fn(chains[:n])
             )
-        except InfiniStoreKeyNotFound:
-            # Blocks raced away (eviction/delete between lookup and read):
-            # cache semantics — the engine just recomputes.
-            return list(caches), 0
-        except InfiniStoreResourcePressure:
-            # Store RAM too pressured to promote/serve right now (507; the
-            # spilled data survives). Recompute beats stalling the engine;
-            # transport errors still propagate (lookup()'s contract).
-            return list(caches), 0
+        except PartialReadError as e:
+            # e.caches, not the original list: layers scattered before the
+            # failure donated their input buffers (deleted on TPU).
+            if isinstance(
+                e.cause, (InfiniStoreKeyNotFound, InfiniStoreResourcePressure)
+            ):
+                # KeyNotFound: blocks raced away (eviction/delete between
+                # lookup and read). ResourcePressure: store RAM too pressured
+                # to promote/serve right now (507; the spilled data
+                # survives). Cache semantics either way — the engine just
+                # recomputes; transport errors still propagate (lookup()'s
+                # contract), carrying the partial caches.
+                return e.caches, 0
+            raise
         return out, n
 
     async def handoff(

@@ -850,7 +850,13 @@ class InfinityConnection:
         n = lib.its_conn_stat_json(self._handle, buf, len(buf))
         if n < 0:
             raise InfiniStoreException("stat query failed")
-        return json.loads(buf.value.decode())
+        try:
+            return json.loads(buf.value.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            # A dead/half-closed server can answer with an empty or truncated
+            # payload; that is a transport failure, not a caller bug — keep
+            # the typed-exception contract every other op has.
+            raise InfiniStoreException(f"stat query returned invalid payload: {e}")
 
 
 class StripedConnection:

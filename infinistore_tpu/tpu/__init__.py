@@ -20,7 +20,12 @@ from .paged import (
 )
 from .paged_attention import paged_decode_attention, paged_decode_attention_xla
 from .staging import HostStagingPool, StagedTransfer
-from .layerwise import LayerwiseKVReader, LayerwiseKVWriter, kv_block_key
+from .layerwise import (
+    LayerwiseKVReader,
+    LayerwiseKVWriter,
+    PartialReadError,
+    kv_block_key,
+)
 
 __all__ = [
     "paged_decode_attention",
@@ -34,5 +39,6 @@ __all__ = [
     "scatter_blocks_xla",
     "LayerwiseKVWriter",
     "LayerwiseKVReader",
+    "PartialReadError",
     "kv_block_key",
 ]

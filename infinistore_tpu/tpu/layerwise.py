@@ -21,10 +21,29 @@ from typing import Callable, List, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..lib import InfiniStoreException
 from .paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
 from .staging import HostStagingPool
 
 KeyFn = Callable[[int, str, int], str]  # (layer, "k"|"v", block_index) -> key
+
+
+class PartialReadError(InfiniStoreException):
+    """A layerwise read failed mid-pipeline.
+
+    ``caches`` is the ONLY valid cache list after this error: layers
+    scattered before the failure are new arrays whose inputs were DONATED
+    (in-place update on TPU — the caller's originals are deleted buffers
+    there); layers at/after the failure are the caller's untouched arrays.
+    ``cause`` is the underlying store error (e.g. InfiniStoreKeyNotFound
+    when blocks raced away between lookup and read). Callers that swallow
+    the failure as a cache miss must hand ``caches`` — never their original
+    list — back to the engine."""
+
+    def __init__(self, caches, cause: BaseException):
+        super().__init__(f"layerwise read failed mid-pipeline: {cause!r}")
+        self.caches = caches
+        self.cause = cause
 
 # On TPU, device_put always copies host bytes into HBM, so "upload ready"
 # means the staging region is free. On CPU (the test backend), device_put of
@@ -292,6 +311,11 @@ class LayerwiseKVReader:
                     scatter_blocks(v_cache, ids_dev, kv_dev[n:]),
                 )
                 start(layer + W)
+        except Exception as exc:
+            # Already-scattered layers donated their input buffers; the
+            # caller's original list is unusable on TPU. Ship the partial
+            # result with the error so recovery paths return live arrays.
+            raise PartialReadError(out, exc) from exc
         finally:
             # Failure drain: pending fetches would otherwise keep writing
             # into regions a subsequent read() on this pool is using. The

@@ -102,6 +102,37 @@ def test_load_partial_prefix(connector):
         assert float(jnp.abs(loaded[layer][0][7]).sum()) == 0.0
 
 
+def test_load_mid_read_race_returns_partial_caches(connector, conn):
+    """Blocks raced away between lookup and read, AFTER layer 0 scattered:
+    load must report a miss but hand back the reader's PARTIAL cache list —
+    layer 0's scatters donated their input buffers (deleted on TPU), so the
+    caller's original arrays for that layer are unusable."""
+    tokens = list(range(16))  # 2 blocks
+    caches = _rand_caches(5)
+    asyncio.run(connector.save(tokens, caches, np.array([1, 2], dtype=np.int32)))
+    chains = token_chain_hashes(tokens, SPEC.block_tokens)
+    # Delete a deeper layer's K keys: the layer-0 sentinel stays, so lookup
+    # still hits and the read fails mid-pipeline at layer 1.
+    assert conn.delete_keys([connector.block_key(1, "k", c) for c in chains]) == 2
+
+    fresh = SPEC.make_caches()
+    orig_last = fresh[-1][0]
+    loaded, n = asyncio.run(
+        connector.load(tokens, fresh, np.array([4, 5], dtype=np.int32))
+    )
+    assert n == 0
+    # Layer 0 was scattered before the failure: new arrays, carrying the
+    # fetched bytes; untouched layers are the caller's own arrays.
+    assert loaded[-1][0] is orig_last
+    got = np.asarray(
+        gather_blocks(loaded[0][0], jnp.asarray([4, 5], jnp.int32)), np.float32
+    )
+    want = np.asarray(
+        gather_blocks(caches[0][0], jnp.asarray([1, 2], jnp.int32)), np.float32
+    )
+    np.testing.assert_array_equal(got, want)
+
+
 def test_writer_commits_layer0_last(connector, conn):
     """The lookup sentinel (layer-0 K key) must be written after all deeper
     layers, so a half-saved block reads as absent rather than a false hit."""
