@@ -12,6 +12,7 @@ step to compile.
 from .llama import (
     LlamaConfig,
     decode_step,
+    decode_step_batched,
     init_params,
     loss_fn,
     prefill,
@@ -23,6 +24,7 @@ __all__ = [
     "init_params",
     "prefill",
     "decode_step",
+    "decode_step_batched",
     "loss_fn",
     "train_step",
 ]

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tpu.paged import PagedKVCacheSpec, scatter_blocks
-from ..tpu.paged_attention import paged_decode_attention
+from ..tpu.paged_attention import paged_decode_attention_batched
 
 Params = Dict[str, jax.Array]
 Caches = List[Tuple[jax.Array, jax.Array]]
@@ -267,40 +267,78 @@ def decode_step(
     once — no materialized gather; gather+dense XLA elsewhere, same f32
     softmax contract). ``max_blocks`` must equal the padded block_table
     length (validated at trace time — a mismatch fails loudly, as the old
-    gather-and-reshape path did). Returns (logits, caches)."""
+    gather-and-reshape path did). Returns (logits, caches).
+
+    This is the B=1 wrapper over ``decode_step_batched`` — one decode body
+    to maintain, mirroring the same pattern in tpu/paged_attention.py."""
     if block_table.shape[0] != max_blocks:
         raise ValueError(
             f"block_table has {block_table.shape[0]} entries, expected "
             f"max_blocks={max_blocks} (pad the table to the static bound)"
         )
-    bt = config.block_tokens
-    pos = position[None]  # [1]
-    x = jnp.take(params["embed"], token[None], axis=0)[None]  # [1, 1, dim]
+    logits, new_caches = decode_step_batched(
+        params,
+        token[None],
+        position[None],
+        caches,
+        block_table[None],
+        config,
+        max_blocks,
+    )
+    return logits[0], new_caches
 
-    block_idx = block_table[position // bt]
-    slot = position % bt
+
+@functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
+def decode_step_batched(
+    params: Params,
+    tokens: jax.Array,  # [B] int32, one next-token per live request
+    positions: jax.Array,  # [B] int32 absolute position of each token
+    caches: Caches,  # SHARED paged cache across the wave
+    block_tables: jax.Array,  # [B, max_blocks] int32 (rows padded)
+    config: LlamaConfig,
+    max_blocks: int,
+) -> Tuple[jax.Array, Caches]:
+    """One decode step for a WAVE of requests sharing the paged cache — the
+    continuous-batching engine's inner loop (every live request advances one
+    token per step). Each request's K/V lands in ITS block slot (requests
+    must own disjoint blocks — the engine's block-table manager guarantees
+    it; overlapping writes would race), then one batched fused attention
+    launch covers the whole wave (tpu/paged_attention.py). Per-token
+    semantics are identical to ``decode_step`` (tested); the win is paying
+    the model's dispatch and kernel-launch cost once per wave instead of
+    once per request. Returns ([B, vocab] logits, updated caches)."""
+    bsz = tokens.shape[0]
+    if block_tables.shape != (bsz, max_blocks):
+        raise ValueError(
+            f"block_tables must be [{bsz}, {max_blocks}] (one padded row per "
+            f"request), got {block_tables.shape}"
+        )
+    bt = config.block_tokens
+    x = jnp.take(params["embed"], tokens, axis=0)[:, None]  # [B, 1, dim]
+    pos2 = positions[:, None]  # [B, 1]
+
+    block_idx = jnp.take_along_axis(
+        block_tables, (positions // bt)[:, None], axis=1
+    )[:, 0]  # [B]
+    slots = positions % bt  # [B]
 
     new_caches: Caches = []
     for layer, (k_cache, v_cache) in enumerate(caches):
-        k, v = _kv_proj(params, layer, x, pos[None], config)
-        # Insert the new token's K/V at (block_idx, slot).
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (block_idx, slot, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (block_idx, slot, 0, 0)
-        )
+        k, v = _kv_proj(params, layer, x, pos2, config)  # [B, 1, KVH, D]
+        # Batched insert at (block_idx[b], slots[b]) — disjoint by contract.
+        k_cache = k_cache.at[block_idx, slots].set(k[:, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[block_idx, slots].set(v[:, 0].astype(v_cache.dtype))
         pre = f"l{layer}."
-        q = _q_proj(params, layer, x, pos[None], config)
-        attn = paged_decode_attention(
-            q[0, 0], k_cache, v_cache, block_table, position + 1
-        )
-        x = x + jnp.einsum("hk,hkd->d", attn, params[pre + "wo"])[None, None]
+        q = _q_proj(params, layer, x, pos2, config)  # [B, 1, H, D]
+        attn = paged_decode_attention_batched(
+            q[:, 0], k_cache, v_cache, block_tables, positions + 1
+        )  # [B, H, D]
+        x = x + jnp.einsum("bhk,hkd->bd", attn, params[pre + "wo"])[:, None]
         x = _ffn(params, layer, x, config)
         new_caches.append((k_cache, v_cache))
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
-    return logits[0, 0], new_caches
+    return logits[:, 0], new_caches
 
 
 # ---------------------------------------------------------------------------

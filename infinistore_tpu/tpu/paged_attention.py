@@ -44,22 +44,26 @@ _NEG_INF = -1e30
 
 
 def _decode_attn_kernel(
-    table_ref,  # scalar-prefetch: [max_blocks] int32 (unused in body; drives DMA)
-    seqlen_ref,  # scalar-prefetch: [1] int32 valid context length
-    q_ref,  # [H, D] query dtype
+    table_ref,  # scalar-prefetch: [B, max_blocks] int32 (drives DMA)
+    seqlen_ref,  # scalar-prefetch: [B] int32 valid context lengths
+    q_ref,  # [1, H, D] query dtype (this request's query)
     k_ref,  # [1, bt, KVH, D] one cache block
     v_ref,  # [1, bt, KVH, D]
-    out_ref,  # [H, D]
+    out_ref,  # [1, H, D]
     m_scr,  # VMEM [H, 128] f32 running max (broadcast across lanes)
     l_scr,  # VMEM [H, 128] f32 running denominator
     acc_scr,  # VMEM [H, D] f32 running numerator
 ):
     del table_ref
-    i = pl.program_id(0)
-    h, d = q_ref.shape
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    _, h, d = q_ref.shape
     bt, kvh = k_ref.shape[1], k_ref.shape[2]
     groups = h // kvh
 
+    # Grid order is row-major (request b outer, block i inner), so the
+    # accumulators reset at each request's first block and out_ref[b] is
+    # finalized before the grid moves to request b+1.
     @pl.when(i == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
@@ -70,7 +74,7 @@ def _decode_attn_kernel(
     # runs f32 matmuls in bf16 passes (on TPU and on this CPU build), which
     # would quantize the softmax statistics.
     scale = 1.0 / np.sqrt(d)
-    q = q_ref[...].astype(jnp.float32)  # [H, D]
+    q = q_ref[0].astype(jnp.float32)  # [H, D]
     k = k_ref[0].astype(jnp.float32)  # [bt, KVH, D]
     v = v_ref[0].astype(jnp.float32)
 
@@ -92,7 +96,7 @@ def _decode_attn_kernel(
     )
 
     pos = i * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
-    valid = pos < seqlen_ref[0]
+    valid = pos < seqlen_ref[b]
     logits = jnp.where(valid, logits, _NEG_INF)
 
     m_prev = m_scr[...]  # [H, 128] (all lanes equal)
@@ -121,39 +125,50 @@ def _decode_attn_kernel(
     l_scr[...] = jax.lax.broadcast_in_dim(l_next, l_scr.shape, (0, 1))
     acc_scr[...] = acc_scr[...] * alpha + pv
 
-    @pl.when(i == pl.num_programs(0) - 1)
+    @pl.when(i == pl.num_programs(1) - 1)
     def _finish():
-        out_ref[...] = (acc_scr[...] / l_scr[:, :1]).astype(out_ref.dtype)
+        out_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _paged_decode_attention_pallas(q, k_cache, v_cache, block_table, seq_len, *, interpret):
-    h, d = q.shape
+def _paged_decode_attention_pallas_batched(
+    q, k_cache, v_cache, block_tables, seq_lens, *, interpret
+):
+    """q: [B, H, D]; block_tables: [B, max_blocks]; seq_lens: [B]."""
+    bsz, h, d = q.shape
     _, bt, kvh, _ = k_cache.shape
-    n = block_table.shape[0]
+    n = block_tables.shape[1]
     block = (1, bt, kvh, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(n,),
+        grid=(bsz, n),
         in_specs=[
-            pl.BlockSpec((h, d), lambda i, tbl, sl: (0, 0)),
-            pl.BlockSpec(block, lambda i, tbl, sl: (tbl[i], 0, 0, 0)),
-            pl.BlockSpec(block, lambda i, tbl, sl: (tbl[i], 0, 0, 0)),
+            pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
+            pl.BlockSpec(block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec(block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((h, d), lambda i, tbl, sl: (0, 0)),
+        out_specs=pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((h, 128), jnp.float32),
             pltpu.VMEM((h, 128), jnp.float32),
             pltpu.VMEM((h, d), jnp.float32),
         ],
     )
-    seq_len = jnp.asarray(seq_len, dtype=jnp.int32).reshape(1)
+    seq_lens = jnp.asarray(seq_lens, dtype=jnp.int32).reshape(bsz)
     return pl.pallas_call(
         _decode_attn_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bsz, h, d), q.dtype),
         interpret=interpret,
-    )(block_table, seq_len, q, k_cache, v_cache)
+    )(block_tables, seq_lens, q, k_cache, v_cache)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_pallas(q, k_cache, v_cache, block_table, seq_len, *, interpret):
+    seq_len = jnp.asarray(seq_len, dtype=jnp.int32).reshape(1)
+    return _paged_decode_attention_pallas_batched(
+        q[None], k_cache, v_cache, block_table[None], seq_len, interpret=interpret
+    )[0]
 
 
 @jax.jit
@@ -189,8 +204,34 @@ def paged_decode_attention_xla(q, k_cache, v_cache, block_table, seq_len):
     ).astype(q.dtype)
 
 
+@jax.jit
+def paged_decode_attention_xla_batched(q, k_cache, v_cache, block_tables, seq_lens):
+    """Batched reference semantics: vmap of the single-query fallback over
+    (query, table, seq_len) with the caches broadcast."""
+    return jax.vmap(
+        paged_decode_attention_xla, in_axes=(0, None, None, 0, 0)
+    )(q, k_cache, v_cache, block_tables, seq_lens)
+
+
 def _use_pallas() -> bool:
     return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def paged_decode_attention_batched(q, k_cache, v_cache, block_tables, seq_lens):
+    """Decode attention for a WAVE of requests against one shared paged
+    cache — the continuous-batching serving shape (every live request
+    decodes one token per engine step).
+
+    q: [B, n_heads, head_dim]; block_tables: [B, max_blocks] (each row padded
+    with any valid block id); seq_lens: [B]. Returns [B, n_heads, head_dim].
+    One fused kernel launch covers the whole wave on TPU (requests are grid
+    rows, so per-request dispatch cost is paid once per wave, not per
+    request); gather+dense vmap elsewhere."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas_batched(
+            q, k_cache, v_cache, block_tables, seq_lens, interpret=False
+        )
+    return paged_decode_attention_xla_batched(q, k_cache, v_cache, block_tables, seq_lens)
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_table, seq_len):
