@@ -18,7 +18,12 @@ from .paged import (
     scatter_blocks,
     scatter_blocks_xla,
 )
-from .paged_attention import paged_decode_attention, paged_decode_attention_xla
+from .paged_attention import (
+    paged_decode_attention,
+    paged_decode_attention_batched,
+    paged_decode_attention_sharded,
+    paged_decode_attention_xla,
+)
 from .staging import HostStagingPool, StagedTransfer
 from .layerwise import (
     LayerwiseKVReader,
@@ -29,6 +34,8 @@ from .layerwise import (
 
 __all__ = [
     "paged_decode_attention",
+    "paged_decode_attention_batched",
+    "paged_decode_attention_sharded",
     "paged_decode_attention_xla",
     "HostStagingPool",
     "StagedTransfer",

@@ -43,26 +43,16 @@ except ImportError:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _decode_attn_kernel(
-    table_ref,  # scalar-prefetch: [B, max_blocks] int32 (drives DMA)
-    seqlen_ref,  # scalar-prefetch: [B] int32 valid context lengths
-    q_ref,  # [1, H, D] query dtype (this request's query)
-    k_ref,  # [1, bt, KVH, D] one cache block
-    v_ref,  # [1, bt, KVH, D]
-    out_ref,  # [1, H, D]
-    m_scr,  # VMEM [H, 128] f32 running max (broadcast across lanes)
-    l_scr,  # VMEM [H, 128] f32 running denominator
-    acc_scr,  # VMEM [H, D] f32 running numerator
-):
-    del table_ref
-    b = pl.program_id(0)
-    i = pl.program_id(1)
+def _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr):
+    """One grid step of the online softmax: fold cache block ``i`` of request
+    ``b`` into the running (max, denominator, accumulator) scratch. Shared by
+    the normalizing kernel and the partial-stats kernel (sharded decode)."""
     _, h, d = q_ref.shape
     bt, kvh = k_ref.shape[1], k_ref.shape[2]
     groups = h // kvh
 
     # Grid order is row-major (request b outer, block i inner), so the
-    # accumulators reset at each request's first block and out_ref[b] is
+    # accumulators reset at each request's first block and the output is
     # finalized before the grid moves to request b+1.
     @pl.when(i == 0)
     def _init():
@@ -125,9 +115,59 @@ def _decode_attn_kernel(
     l_scr[...] = jax.lax.broadcast_in_dim(l_next, l_scr.shape, (0, 1))
     acc_scr[...] = acc_scr[...] * alpha + pv
 
+
+def _decode_attn_kernel(
+    table_ref,  # scalar-prefetch: [B, max_blocks] int32 (drives DMA)
+    seqlen_ref,  # scalar-prefetch: [B] int32 valid context lengths
+    q_ref,  # [1, H, D] query dtype (this request's query)
+    k_ref,  # [1, bt, KVH, D] one cache block
+    v_ref,  # [1, bt, KVH, D]
+    out_ref,  # [1, H, D]
+    m_scr,  # VMEM [H, 128] f32 running max (broadcast across lanes)
+    l_scr,  # VMEM [H, 128] f32 running denominator
+    acc_scr,  # VMEM [H, D] f32 running numerator
+):
+    del table_ref
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+
     @pl.when(i == pl.num_programs(1) - 1)
     def _finish():
-        out_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(out_ref.dtype)
+        # max(l, tiny): for any non-empty row l >= 1 (the max logit's exp),
+        # so this only changes the seq_len == 0 case — which must yield
+        # zeros, not 0/0 NaN (contract shared with the XLA fallback).
+        out_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(out_ref.dtype)
+
+
+def _decode_attn_stats_kernel(
+    table_ref,
+    seqlen_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    acc_ref,  # [1, H, D] f32 UNNORMALIZED numerator
+    m_ref,  # [1, H, 128] f32 running max (lane-broadcast)
+    l_ref,  # [1, H, 128] f32 denominator (lane-broadcast)
+    m_scr,
+    l_scr,
+    acc_scr,
+):
+    """Same online softmax, but emits the raw (acc, m, l) statistics instead
+    of normalizing — the shard-local half of sharded decode attention, whose
+    cross-shard combine rescales by the global max and sums."""
+    del table_ref
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finish():
+        acc_ref[0] = acc_scr[...]
+        m_ref[0] = m_scr[...]
+        l_ref[0] = l_scr[...]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -171,50 +211,192 @@ def _paged_decode_attention_pallas(q, k_cache, v_cache, block_table, seq_len, *,
     )[0]
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_pallas_stats(
+    q, k_cache, v_cache, block_tables, seq_lens, *, interpret
+):
+    """Raw (acc, m, l) per request: acc [B,H,D] f32, m/l [B,H,1] f32."""
+    bsz, h, d = q.shape
+    _, bt, kvh, _ = k_cache.shape
+    n = block_tables.shape[1]
+    block = (1, bt, kvh, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bsz, n),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
+            pl.BlockSpec(block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec(block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
+            pl.BlockSpec((1, h, 128), lambda b, i, tbl, sl: (b, 0, 0)),
+            pl.BlockSpec((1, h, 128), lambda b, i, tbl, sl: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    seq_lens = jnp.asarray(seq_lens, dtype=jnp.int32).reshape(bsz)
+    acc, m, l = pl.pallas_call(
+        _decode_attn_stats_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bsz, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bsz, h, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(block_tables, seq_lens, q, k_cache, v_cache)
+    return acc, m[:, :, :1], l[:, :, :1]
+
+
+@jax.jit
+def _decode_attention_stats_xla(q, k_cache, v_cache, block_tables, seq_lens):
+    """XLA fallback for the raw statistics (same shapes as the Pallas one)."""
+    _, bt, kvh, d = k_cache.shape
+    h = q.shape[1]
+    groups = h // kvh
+    scale = 1.0 / np.sqrt(d)
+
+    def one(qb, tbl, sl):
+        k = jnp.take(k_cache, tbl, axis=0).reshape(-1, kvh, d)
+        v = jnp.take(v_cache, tbl, axis=0).reshape(-1, kvh, d)
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+        logits = (
+            jnp.einsum(
+                "hd,thd->ht",
+                qb.astype(jnp.float32),
+                k.astype(jnp.float32),
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            * scale
+        )
+        t = k.shape[0]
+        valid = jnp.arange(t, dtype=jnp.int32) < sl
+        logits = jnp.where(valid[None, :], logits, _NEG_INF)
+        m = jnp.max(logits, axis=1, keepdims=True)  # [H, 1]
+        p = jnp.exp(logits - m)
+        # An all-masked shard (sl == 0) leaves m at _NEG_INF and exp(0)=1;
+        # zero those weights so its (acc, l) contribute nothing.
+        p = jnp.where(valid[None, :], p, 0.0)
+        l = jnp.sum(p, axis=1, keepdims=True)  # [H, 1]
+        acc = jnp.einsum(
+            "ht,thd->hd", p, v.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        return acc, m, l
+
+    return jax.vmap(one)(q, block_tables, seq_lens)
+
+
 @jax.jit
 def paged_decode_attention_xla(q, k_cache, v_cache, block_table, seq_len):
     """Reference semantics on any backend: gather the table's blocks, mask
-    positions >= seq_len, dense softmax. Same f32 statistics as the kernel."""
-    h, d = q.shape
-    _, bt, kvh, _ = k_cache.shape
-    groups = h // kvh
-    k = jnp.take(k_cache, block_table, axis=0).reshape(-1, kvh, d)  # [T, KVH, D]
-    v = jnp.take(v_cache, block_table, axis=0).reshape(-1, kvh, d)
-    k = jnp.repeat(k, groups, axis=1)  # [T, H, D]
-    v = jnp.repeat(v, groups, axis=1)
-    scale = 1.0 / np.sqrt(d)
-    logits = (
-        jnp.einsum(
-            "hd,thd->ht",
-            q.astype(jnp.float32),
-            k.astype(jnp.float32),
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        * scale
+    positions >= seq_len, softmax via the SAME statistics computation the
+    sharded combine uses (one body to keep the numeric contract in). A
+    seq_len of 0 yields zeros — matching the kernel, not NaN."""
+    seq_len = jnp.asarray(seq_len, dtype=jnp.int32).reshape(1)
+    acc, _, l = _decode_attention_stats_xla(
+        q[None], k_cache, v_cache, block_table[None], seq_len
     )
-    t = k.shape[0]
-    valid = jnp.arange(t, dtype=jnp.int32) < seq_len
-    logits = jnp.where(valid[None, :], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum(
-        "ht,thd->hd",
-        probs,
-        v.astype(jnp.float32),
-        precision=jax.lax.Precision.HIGHEST,
-    ).astype(q.dtype)
+    return (acc[0] / jnp.maximum(l[0], 1e-30)).astype(q.dtype)
 
 
 @jax.jit
 def paged_decode_attention_xla_batched(q, k_cache, v_cache, block_tables, seq_lens):
-    """Batched reference semantics: vmap of the single-query fallback over
-    (query, table, seq_len) with the caches broadcast."""
-    return jax.vmap(
-        paged_decode_attention_xla, in_axes=(0, None, None, 0, 0)
-    )(q, k_cache, v_cache, block_tables, seq_lens)
+    """Batched reference semantics, derived from the stats body (one copy of
+    the numeric contract). Zero-length rows yield zeros."""
+    acc, _, l = _decode_attention_stats_xla(
+        q, k_cache, v_cache, block_tables, seq_lens
+    )
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
 def _use_pallas() -> bool:
     return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def paged_decode_attention_sharded(
+    q, k_cache, v_cache, local_tables, local_lens, *, mesh, axis: str = "sp"
+):
+    """Decode attention over a paged KV cache SHARDED across a mesh axis —
+    the long-context serving shape where one request's context exceeds a
+    single device's HBM (the decode-side complement of ring/Ulysses prefill,
+    models/ring_attention.py).
+
+    Layout contract: ``k_cache``/``v_cache`` are [P * blocks_per_shard, bt,
+    KVH, D] sharded over ``axis`` on the block dimension — shard p owns
+    global rows [p*blocks_per_shard, (p+1)*blocks_per_shard). ``local_tables``
+    is [P, n_local] of SHARD-LOCAL block ids (each row indexes within its
+    shard's rows); ``local_lens`` is [P] valid token counts per shard (0 is
+    fine — an empty shard contributes nothing). ``q`` is [H, D], replicated.
+
+    Each shard folds its local blocks with the same online-softmax kernel the
+    single-chip path uses, but emits raw (acc, m, l); one ``pmax`` + two
+    ``psum`` over ``axis`` combine them exactly (softmax is permutation-
+    invariant, so shard order does not matter):
+
+        out = sum_p(acc_p * e^(m_p - m)) / sum_p(l_p * e^(m_p - m)),
+        m = max_p(m_p)
+
+    Every byte of cached context stays on its owning shard — only [H, D]-
+    sized statistics cross the interconnect. Returns [H, D] replicated.
+
+    The shard_map is built once per (mesh, axis) (_sharded_decode_fn is
+    lru_cached) — this is a per-decode-token entry point, so a fresh
+    closure per call would retrace every token. device_put on an input
+    already laid out per the contract is a no-op view."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn, cache_spec = _sharded_decode_fn(mesh, axis)
+    put = lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec))
+    return fn(
+        put(q, P(None, None)),
+        put(k_cache, cache_spec),
+        put(v_cache, cache_spec),
+        put(jnp.asarray(local_tables, jnp.int32), P(axis, None)),
+        put(jnp.asarray(local_lens, jnp.int32), P(axis)),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_decode_fn(mesh, axis: str):
+    """Build (once per mesh/axis) the shard_map'd local-stats + combine."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local_fn(q_rep, kc, vc, tbl, sl):
+        acc, m, l = _decode_attention_stats(q_rep[None], kc, vc, tbl, sl)
+        acc, m, l = acc[0], m[0], l[0]  # [H, D], [H, 1], [H, 1]
+        m_g = jax.lax.pmax(m, axis)
+        w = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * w, axis)
+        acc_g = jax.lax.psum(acc * w, axis)
+        # max(l, tiny): only the "whole context empty" case, which decode
+        # never presents (>= 1 token globally); avoids 0/0 surprises anyway.
+        return (acc_g / jnp.maximum(l_g, 1e-30)).astype(q_rep.dtype)
+
+    cache_spec = P(axis, None, None, None)
+    fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(None, None), cache_spec, cache_spec, P(axis, None), P(axis)),
+        out_specs=P(None, None),
+    )
+    return fn, cache_spec
+
+
+def _decode_attention_stats(q, k_cache, v_cache, block_tables, seq_lens):
+    """Dispatcher for the raw-stats computation (Pallas on TPU, XLA off)."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas_stats(
+            q, k_cache, v_cache, block_tables, seq_lens, interpret=False
+        )
+    return _decode_attention_stats_xla(q, k_cache, v_cache, block_tables, seq_lens)
 
 
 def paged_decode_attention_batched(q, k_cache, v_cache, block_tables, seq_lens):
@@ -223,10 +405,11 @@ def paged_decode_attention_batched(q, k_cache, v_cache, block_tables, seq_lens):
     decodes one token per engine step).
 
     q: [B, n_heads, head_dim]; block_tables: [B, max_blocks] (each row padded
-    with any valid block id); seq_lens: [B]. Returns [B, n_heads, head_dim].
-    One fused kernel launch covers the whole wave on TPU (requests are grid
-    rows, so per-request dispatch cost is paid once per wave, not per
-    request); gather+dense vmap elsewhere."""
+    with any valid block id); seq_lens: [B] — a row with seq_lens[b] == 0
+    returns zeros on every backend (not NaN). Returns [B, n_heads,
+    head_dim]. One fused kernel launch covers the whole wave on TPU
+    (requests are grid rows, so per-request dispatch cost is paid once per
+    wave, not per request); gather+dense elsewhere."""
     if _use_pallas():
         return _paged_decode_attention_pallas_batched(
             q, k_cache, v_cache, block_tables, seq_lens, interpret=False

@@ -178,6 +178,119 @@ def test_decode_step_batched_matches_sequential():
             )
 
 
+def test_zero_length_row_returns_zeros_both_backends():
+    """A just-admitted request with no cached tokens (seq_len 0) must read
+    as zeros — not 0/0 NaN (kernel) or a uniform garbage average (naive
+    softmax fallback)."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas_batched,
+        paged_decode_attention_xla_batched,
+    )
+
+    n, bt, kvh, d, h = 8, 8, 2, 16, 4
+    rng = np.random.default_rng(21)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((2, h, d)), jnp.float32)
+    tables = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    sls = jnp.asarray([0, 5], jnp.int32)
+    for out in (
+        _paged_decode_attention_pallas_batched(
+            q, k_cache, v_cache, tables, sls, interpret=True
+        ),
+        paged_decode_attention_xla_batched(q, k_cache, v_cache, tables, sls),
+    ):
+        row0 = np.asarray(out[0], np.float64)
+        assert np.array_equal(row0, np.zeros_like(row0))
+        assert np.isfinite(np.asarray(out, np.float64)).all()
+        # The non-empty row is real attention, not zeros.
+        assert np.abs(np.asarray(out[1], np.float64)).max() > 0
+
+
+def test_sharded_decode_matches_dense_oracle():
+    """Context sharded over an 8-way 'sp' mesh: shard-local online-softmax
+    stats combined with pmax/psum must equal dense attention over the
+    concatenated context — including an EMPTY shard (len 0) and ragged
+    per-shard lengths."""
+    from jax.sharding import Mesh
+
+    from infinistore_tpu.tpu.paged_attention import paged_decode_attention_sharded
+
+    P_, nb_local, bt, kvh, d, h, n_local = 8, 4, 4, 2, 16, 4, 3
+    rng = np.random.default_rng(11)
+    k_cache = jnp.asarray(
+        rng.standard_normal((P_ * nb_local, bt, kvh, d)), jnp.float32
+    )
+    v_cache = jnp.asarray(
+        rng.standard_normal((P_ * nb_local, bt, kvh, d)), jnp.float32
+    )
+    q = jnp.asarray(rng.standard_normal((h, d)), jnp.float32)
+    local_tables = np.stack(
+        [rng.permutation(nb_local)[:n_local] for _ in range(P_)]
+    ).astype(np.int32)
+    local_lens = np.array([5, 12, 0, 3, 8, 1, 12, 2], np.int32)  # ragged + empty
+
+    devices = jax.devices()
+    assert len(devices) == 8
+    mesh = Mesh(np.array(devices), ("sp",))
+    got = paged_decode_attention_sharded(
+        q, k_cache, v_cache, local_tables, local_lens, mesh=mesh
+    )
+
+    # Oracle: concatenate every shard's valid tokens, dense softmax.
+    ctx_k, ctx_v = [], []
+    for p in range(P_):
+        rows = p * nb_local + local_tables[p]
+        k_toks = np.asarray(k_cache)[rows].reshape(-1, kvh, d)[: local_lens[p]]
+        v_toks = np.asarray(v_cache)[rows].reshape(-1, kvh, d)[: local_lens[p]]
+        ctx_k.append(k_toks)
+        ctx_v.append(v_toks)
+    k_all = np.concatenate(ctx_k)  # [T, KVH, D]
+    v_all = np.concatenate(ctx_v)
+    groups = h // kvh
+    k_rep = np.repeat(k_all, groups, axis=1).astype(np.float64)
+    v_rep = np.repeat(v_all, groups, axis=1).astype(np.float64)
+    logits = np.einsum("hd,thd->ht", np.asarray(q, np.float64), k_rep) / np.sqrt(d)
+    p_ = np.exp(logits - logits.max(axis=1, keepdims=True))
+    p_ /= p_.sum(axis=1, keepdims=True)
+    want = np.einsum("ht,thd->hd", p_, v_rep)
+    np.testing.assert_allclose(np.asarray(got, np.float64), want, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_stats_kernel_matches_xla_stats():
+    """The Pallas stats kernel (interpret mode) and the XLA stats fallback
+    must produce combinable (acc, m, l) that normalize to the same output."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _decode_attention_stats_xla,
+        _paged_decode_attention_pallas_stats,
+    )
+
+    n, bt, kvh, d, h, ntbl, bsz = 16, 8, 2, 16, 4, 4, 3
+    rng = np.random.default_rng(13)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((bsz, h, d)), jnp.float32)
+    tables = jnp.asarray(
+        np.stack([rng.permutation(n)[:ntbl] for _ in range(bsz)]), jnp.int32
+    )
+    sls = jnp.asarray([1, ntbl * bt, 0], jnp.int32)  # incl. an empty row
+    a1, m1, l1 = _paged_decode_attention_pallas_stats(
+        q, k_cache, v_cache, tables, sls, interpret=True
+    )
+    a2, m2, l2 = _decode_attention_stats_xla(q, k_cache, v_cache, tables, sls)
+    # Stats normalize identically for non-empty rows; the empty row has
+    # l == 0 and acc == 0 in both (its combine weight is zero).
+    for b in range(bsz):
+        if float(l2[b].max()) == 0.0:
+            assert float(l1[b].max()) == 0.0 and float(jnp.abs(a1[b]).max()) == 0.0
+            assert float(jnp.abs(a2[b]).max()) == 0.0
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a1[b] / l1[b]), np.asarray(a2[b] / l2[b]),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
 def test_decode_step_uses_contract_matching_prefill():
     """decode_step routes attention through the dispatcher; on CPU that is
     the XLA fallback, and the f32-softmax contract keeps incremental decode
