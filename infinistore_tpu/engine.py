@@ -13,8 +13,9 @@ of that story for JAX/TPU engines:
 - ``ContinuousBatchingHarness`` — a scheduler-shaped driver: N requests in
   flight against ONE shared paged cache (``BlockPool`` hands out physical
   blocks, exactly an engine's block-table manager), prefix-hit loads skipping
-  recompute, suffix compute via the demo model's own ``prefill``/
-  ``decode_step``, byte-verified against the model's prefill oracle, and
+  recompute, suffix decode coalesced across live requests into lockstep
+  batched waves (``WaveDecoder`` -> one ``decode_step_batched`` call per
+  wave), byte-verified against the model's prefill oracle, and
   store writes of every computed prefix. Device-cache discipline mirrors a
   real engine scheduler: mutating phases (load scatters donate cache
   buffers; compute rewrites blocks) are exclusive; saves snapshot their
@@ -38,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.llama import decode_step, prefill
+from .models.llama import decode_step_batched, prefill
 from .tpu.paged import gather_blocks
 
 
@@ -128,6 +129,90 @@ class DeviceGate:
                     self._cond.notify_all()
 
 
+class WaveDecoder:
+    """Coalesce decode steps from concurrent requests into lockstep waves.
+
+    A real continuous-batching engine advances EVERY live request one token
+    per step with one batched model call; per-request sequential decode
+    forfeits that. Each request awaits ``step(token, position, table)``;
+    the first arrival schedules a flush, the flush yields to the event loop
+    so every decode-ready request joins, then ONE ``decode_step_batched``
+    call (under the device gate's exclusive phase — it mutates the shared
+    cache) advances the whole wave and resolves each request's logits.
+
+    Wave sizes vary with load, so the jitted batched step compiles once per
+    distinct B it sees (an engine would pad to fixed buckets; at harness
+    scale the handful of compilations is cheaper than the padding logic).
+    """
+
+    def __init__(self, harness: "ContinuousBatchingHarness"):
+        self.h = harness
+        self._pending: List[tuple] = []
+        self._flush_scheduled = False
+        # Strong reference: the event loop holds only weak refs to tasks, so
+        # a fire-and-forget flush could be GC'd mid-flight and strand every
+        # waiter with _flush_scheduled stuck True.
+        self._flush_task = None
+        self.waves = 0
+        self.max_wave = 0
+
+    async def step(self, token: int, position: int, padded_table) -> jax.Array:
+        """Advance this request by one token; returns its logits row."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((token, position, padded_table, fut))
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            self._flush_task = asyncio.ensure_future(self._flush())
+        return await fut
+
+    async def _flush(self):
+        batch: List[tuple] = []
+        try:
+            # Yield twice: once so sibling coroutines already unblocked this
+            # tick can enqueue, once more for requests their completions wake.
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+            batch, self._pending = self._pending, []
+            # New arrivals after this point start the next wave.
+            self._flush_scheduled = False
+            if not batch:
+                return
+            async with self.h.gate.exclusive():
+                tokens = jnp.asarray([b[0] for b in batch], jnp.int32)
+                positions = jnp.asarray([b[1] for b in batch], jnp.int32)
+                tables = jnp.stack([b[2] for b in batch])
+                logits, self.h.caches = decode_step_batched(
+                    self.h.params,
+                    tokens,
+                    positions,
+                    self.h.caches,
+                    tables,
+                    self.h.config,
+                    self.h.max_req_blocks,
+                )
+            self.waves += 1
+            self.max_wave = max(self.max_wave, len(batch))
+            for i, (_, _, _, fut) in enumerate(batch):
+                if not fut.done():
+                    fut.set_result(logits[i])
+        except BaseException as e:  # noqa: BLE001 - must fail the waiters
+            # A dead flush (model error, or cancellation/GC at shutdown)
+            # must strand NO waiter: fail the taken batch and anything still
+            # pending, and clear the flag so a later step() starts fresh.
+            self._flush_scheduled = False
+            stranded, self._pending = batch + self._pending, []
+            exc = e if isinstance(e, Exception) else RuntimeError(
+                f"decode wave aborted: {e!r}"
+            )
+            for _, _, _, fut in stranded:
+                if not fut.done():
+                    fut.set_exception(exc)
+            if not isinstance(e, Exception):
+                raise
+        finally:
+            self._flush_task = None
+
+
 class EngineKVAdapter:
     """vLLM-TPU-style connector surface over ``KVConnector`` (engine terms:
     token counts in, engine-owned physical block tables in, caches out)."""
@@ -205,6 +290,7 @@ class ContinuousBatchingHarness:
         self.caches = config.kv_spec(num_blocks).make_caches()
         self.pool = BlockPool(num_blocks)
         self.gate = DeviceGate()
+        self.wave = WaveDecoder(self)
         self.max_req_blocks = max_req_blocks
         self.verify = verify
         # Instrumentation the test pins: request-level concurrency and
@@ -228,36 +314,35 @@ class ContinuousBatchingHarness:
         pad[: len(table)] = table
         return jnp.asarray(pad)
 
-    def _compute(self, token_ids, table: np.ndarray, start_block: int):
-        """Fill blocks [start_block:] of this request: full prefill when
-        nothing was loaded, else token-by-token decode attending over the
-        loaded prefix (the engine's actual prefix-cache resume path)."""
+    def _prefill_full(self, token_ids, table: np.ndarray):
+        """Whole-prompt prefill into this request's blocks (cache-mutating:
+        caller holds the exclusive gate)."""
+        t0 = time.perf_counter()
+        _, self.caches = self._prefill(
+            self.params,
+            jnp.asarray(token_ids, dtype=jnp.int32),
+            self.caches,
+            jnp.asarray(table),
+            self.config,
+        )
+        jax.block_until_ready(self.caches[-1][0])
+        # Calibrates recompute_saved_s: what one block of prefill costs
+        # on this device. Min across calls — the first includes the jit
+        # compile, which a steady-state engine never pays per request.
+        per_block = (time.perf_counter() - t0) / len(table)
+        if self._prefill_per_block_s is None or per_block < self._prefill_per_block_s:
+            self._prefill_per_block_s = per_block
+
+    async def _decode_suffix(self, token_ids, table: np.ndarray, start_block: int):
+        """Token-by-token decode of the suffix after a prefix hit (the
+        engine's prefix-cache resume path) — each step rides the shared
+        WaveDecoder, so concurrent resuming requests advance in lockstep
+        batched waves. No gate held here: the wave flusher takes the
+        exclusive phase per wave."""
         bt = self.config.block_tokens
-        tokens = jnp.asarray(token_ids, dtype=jnp.int32)
-        if start_block == 0:
-            t0 = time.perf_counter()
-            _, self.caches = self._prefill(
-                self.params, tokens, self.caches, jnp.asarray(table), self.config
-            )
-            jax.block_until_ready(self.caches[-1][0])
-            # Calibrates recompute_saved_s: what one block of prefill costs
-            # on this device. Min across calls — the first includes the jit
-            # compile, which a steady-state engine never pays per request.
-            per_block = (time.perf_counter() - t0) / len(table)
-            if self._prefill_per_block_s is None or per_block < self._prefill_per_block_s:
-                self._prefill_per_block_s = per_block
-        else:
-            padded = self._padded_table(table)
-            for pos in range(start_block * bt, len(token_ids)):
-                _, self.caches = decode_step(
-                    self.params,
-                    tokens[pos],
-                    jnp.int32(pos),
-                    self.caches,
-                    padded,
-                    self.config,
-                    self.max_req_blocks,
-                )
+        padded = self._padded_table(table)
+        for pos in range(start_block * bt, len(token_ids)):
+            await self.wave.step(int(token_ids[pos]), pos, padded)
 
     def _verify_request(self, token_ids, table: np.ndarray) -> bool:
         """Compare the harness cache's blocks for this request against a
@@ -306,8 +391,11 @@ class ContinuousBatchingHarness:
             loaded_blocks = loaded_tokens // bt
             raced = hit_tokens > 0 and loaded_tokens == 0
             if loaded_blocks < n_blocks:
-                async with self.gate.exclusive():
-                    self._compute(token_ids, table, loaded_blocks)
+                if loaded_blocks == 0:
+                    async with self.gate.exclusive():
+                        self._prefill_full(token_ids, table)
+                else:
+                    await self._decode_suffix(token_ids, table, loaded_blocks)
             verified = None
             if self.verify:
                 async with self.gate.shared():
@@ -390,6 +478,8 @@ class ContinuousBatchingHarness:
             "prefill_per_block_s": per_block,
             "max_live_requests": self.max_live,
             "max_concurrent_saves": self.max_concurrent_saves,
+            "decode_waves": self.wave.waves,
+            "max_wave_size": self.wave.max_wave,
             "all_verified": all(
                 s.verified for s in self.stats if s.verified is not None
             ),

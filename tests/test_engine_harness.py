@@ -148,6 +148,28 @@ def test_eviction_churn_correctness(params):
         srv.stop()
 
 
+def test_concurrent_resumes_decode_in_batched_waves(conn, params):
+    """Requests resuming from a prefix hit decode their suffixes through the
+    shared WaveDecoder: with several resuming concurrently, at least one
+    wave must carry >= 2 requests (one decode_step_batched call advancing
+    both), and every request still verifies against the oracle."""
+    h = _harness(conn, params, "engine-waves")
+    # Seed one 2-block family so later admissions hit 2 and decode 2.
+    fams = _prompts(4, shared_blocks=2, total_blocks=4, seed=13)
+    asyncio.run(h.run_request(fams[0]))
+    h.stats.clear()
+    m = asyncio.run(h.run(fams[1:], concurrency=3))
+    assert m["all_verified"]
+    assert m["loaded_blocks"] >= 3 * 2  # each resumed the seeded prefix
+    assert m["decode_waves"] > 0
+    assert m["max_wave_size"] >= 2, (
+        "concurrent suffix decodes never coalesced into one batched wave"
+    )
+    # Lockstep actually reduced step count: 3 requests x 16 suffix tokens
+    # would be 48 sequential steps; waves must have merged a chunk of them.
+    assert m["decode_waves"] < 48
+
+
 def test_block_pool_backpressure():
     """alloc() waits for free blocks instead of failing (scheduler-style
     admission deferral)."""
