@@ -820,14 +820,18 @@ def main() -> int:
     )
     conn.connect()
 
-    # Interleave ceiling and headline sampling over two rounds and keep the
-    # PAIR from the best-throughput round: this host swings ~2x between
+    # Interleave ceiling and headline sampling over three rounds and keep
+    # the PAIR from the best-throughput round: this host swings ~2x between
     # seconds, and mixing a ceiling from one period with a throughput from
     # another (independent maxima included) would make vs_baseline a
     # cross-period artifact instead of transport quality (same discipline
-    # as the TPU section).
+    # as the TPU section). Three rounds because with two, a single slow
+    # period during the throughput leg leaves the ratio hostage to whichever
+    # period the paired ceiling saw (observed r4 spread: 0.68-0.88 across
+    # runs; a third paired sample tightens the odds the best round is a
+    # genuinely aligned one).
     ceiling = gbps = 0.0
-    for _ in range(2):
+    for _ in range(3):
         c_round = _memcpy_ceiling_gbps(np)
         g_round = _loopback_throughput(its, np, conn)
         if g_round > gbps:
