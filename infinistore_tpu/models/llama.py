@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..tpu.flash_prefill import flash_prefill_attention
 from ..tpu.paged import PagedKVCacheSpec, scatter_blocks
 from ..tpu.paged_attention import paged_decode_attention_batched
 
@@ -160,11 +161,17 @@ def _attention(
 def _block(params: Params, layer: int, x, k, v, q_positions, mask, config):
     """Shared transformer block math given already-materialized K/V context.
 
-    x: [B, S, dim]; k/v: [B, T, KVH, D] (full attention context); returns the
-    block output and this segment's (k_new, v_new) before cache insertion."""
+    x: [B, S, dim]; k/v: [B, T, KVH, D] (full attention context). ``mask``
+    is [B, S, T] (True = attend), or None for plain causal — the None form
+    routes through the flash prefill kernel on TPU (no S x T logits
+    materialized; forward-only, so training losses pass an explicit mask
+    and keep the differentiable dense path)."""
     pre = f"l{layer}."
     q = _q_proj(params, layer, x, q_positions, config)
-    attn = _attention(q, k, v, mask)
+    if mask is None:
+        attn = flash_prefill_attention(q, k, v, causal=True)
+    else:
+        attn = _attention(q, k, v, mask)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
     return _ffn(params, layer, x, config)
 
@@ -231,7 +238,7 @@ def prefill(
     bt = config.block_tokens
     positions = jnp.arange(s, dtype=jnp.int32)[None]
     x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, S, dim]
-    mask = (positions[:, :, None] >= positions[:, None, :])  # causal [1, S, S]
+    mask = None  # plain causal -> flash prefill kernel on TPU (_block)
 
     new_caches: Caches = []
     for layer, (k_cache, v_cache) in enumerate(caches):

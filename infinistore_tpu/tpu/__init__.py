@@ -18,6 +18,7 @@ from .paged import (
     scatter_blocks,
     scatter_blocks_xla,
 )
+from .flash_prefill import flash_prefill_attention, flash_prefill_xla
 from .paged_attention import (
     paged_decode_attention,
     paged_decode_attention_batched,
@@ -33,6 +34,8 @@ from .layerwise import (
 )
 
 __all__ = [
+    "flash_prefill_attention",
+    "flash_prefill_xla",
     "paged_decode_attention",
     "paged_decode_attention_batched",
     "paged_decode_attention_sharded",
