@@ -19,6 +19,12 @@ from .paged import (
     scatter_blocks_xla,
 )
 from .flash_prefill import flash_prefill_attention, flash_prefill_xla
+from .kv_quant import (
+    QuantizedKVConnector,
+    dequantize_kv,
+    paged_decode_attention_quantized,
+    quantize_kv,
+)
 from .paged_attention import (
     paged_decode_attention,
     paged_decode_attention_batched,
@@ -36,6 +42,10 @@ from .layerwise import (
 __all__ = [
     "flash_prefill_attention",
     "flash_prefill_xla",
+    "QuantizedKVConnector",
+    "quantize_kv",
+    "dequantize_kv",
+    "paged_decode_attention_quantized",
     "paged_decode_attention",
     "paged_decode_attention_batched",
     "paged_decode_attention_sharded",

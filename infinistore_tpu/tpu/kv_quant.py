@@ -1,0 +1,288 @@
+"""int8 KV-cache quantization: half the HBM traffic per decode token, half
+the store bytes per cached block.
+
+Decode attention is HBM-bandwidth-bound (paged_attention.py), so the cache's
+dtype IS its speed — and the store's capacity doubles for free. This module
+provides the symmetric per-(token, head) int8 scheme TPU serving stacks use:
+
+- ``quantize_kv(x)`` -> (int8 data, f32 scales): scale = absmax / 127 over
+  each (token, head) vector of ``head_dim`` values. Per-vector scaling keeps
+  the error at RoPE'd-key scale (a single per-block scale would be hostage
+  to one outlier token).
+- ``dequantize_kv(data, scales)`` -> the float cache (any target dtype).
+- ``paged_decode_attention_quantized``: the fused decode kernel over int8
+  caches — blocks are DMA'd at int8 width (the bandwidth win) and
+  dequantized in VMEM right before the dots, with the same online-softmax
+  and the same f32 statistics as the float kernel.
+
+The scales array is [N, bt, KVH] f32 — 1/head_dim of the data bytes — and
+rides to the store as its own tiny blocks (`connector.py` works on any
+dtype; a quantized engine binds one connector for data and one for scales
+over the same chain keys, tested in tests/test_kv_quant.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas bits
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+@jax.jit
+def quantize_kv(x: jax.Array):
+    """Symmetric int8 per-(token, head) quantization.
+
+    x: [..., head_dim] float; returns (int8 of x's shape, f32 scales of
+    x.shape[:-1]). Zero vectors get scale 0 and dequantize to exact zeros.
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = absmax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) * inv[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def dequantize_kv(data: jax.Array, scales: jax.Array, dtype=jnp.float32):
+    """Inverse of quantize_kv: data [..., D] int8, scales [...] f32."""
+    return (data.astype(jnp.float32) * scales[..., None]).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fused decode attention over int8 caches.
+# ---------------------------------------------------------------------------
+
+
+def _quant_decode_kernel(
+    table_ref,  # scalar-prefetch: [B, max_blocks] int32
+    seqlen_ref,  # scalar-prefetch: [B] int32
+    q_ref,  # [1, H, D] float query
+    k_ref,  # [1, bt, KVH, D] int8
+    ks_ref,  # [1, bt, KVH] f32 scales
+    v_ref,  # [1, bt, KVH, D] int8
+    vs_ref,  # [1, bt, KVH] f32
+    out_ref,  # [1, H, D]
+    m_scr,  # VMEM [H, 128] f32
+    l_scr,  # VMEM [H, 128] f32
+    acc_scr,  # VMEM [H, D] f32
+):
+    from .paged_attention import _attn_block_update
+
+    del table_ref
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    # Dequantize in VMEM — the HBM read was int8 width — then delegate to
+    # the SAME online-softmax update the float kernels use (one copy of the
+    # numeric contract, paged_attention.py).
+    _attn_block_update(
+        b,
+        i,
+        seqlen_ref,
+        q_ref[0].astype(jnp.float32),
+        k_ref[0].astype(jnp.float32) * ks_ref[0][..., None],
+        v_ref[0].astype(jnp.float32) * vs_ref[0][..., None],
+        m_scr,
+        l_scr,
+        acc_scr,
+    )
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finish():
+        out_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _quant_decode_pallas(
+    q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens, *, interpret
+):
+    bsz, h, d = q.shape
+    _, bt, kvh, _ = k_data.shape
+    n = block_tables.shape[1]
+    data_block = (1, bt, kvh, d)
+    scale_block = (1, bt, kvh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bsz, n),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
+            pl.BlockSpec(data_block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec(scale_block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0)),
+            pl.BlockSpec(data_block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0, 0)),
+            pl.BlockSpec(scale_block, lambda b, i, tbl, sl: (tbl[b, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda b, i, tbl, sl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    seq_lens = jnp.asarray(seq_lens, dtype=jnp.int32).reshape(bsz)
+    return pl.pallas_call(
+        _quant_decode_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bsz, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, seq_lens, q, k_data, k_scales, v_data, v_scales)
+
+
+@jax.jit
+def _quant_decode_xla(q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens):
+    """Fallback: dequantize the caches, then the float batched path (the
+    identical numeric contract lives there)."""
+    from .paged_attention import paged_decode_attention_xla_batched
+
+    return paged_decode_attention_xla_batched(
+        q,
+        dequantize_kv(k_data, k_scales),
+        dequantize_kv(v_data, v_scales),
+        block_tables,
+        seq_lens,
+    )
+
+
+class QuantizedKVConnector:
+    """Store glue for an int8 paged cache: half the bytes per cached block.
+
+    A quantized engine's cache is (int8 data, f32 scales) per K/V side. This
+    binds TWO ``KVConnector``s over the same chain keys — one for the data
+    blocks (int8, half the float bytes), one for the scale blocks (1/head_dim
+    of the data bytes) — and keeps the commit order safe: scales are saved
+    BEFORE data, so the data connector's layer-0 sentinel (what ``lookup``
+    probes) commits last and a hit implies the scales are present too. A
+    scales load that still races eviction degrades to a full miss
+    (recompute), never a half-loaded cache.
+
+    Total stored bytes per block: data/2 + data/(2*head_dim) vs data — a
+    ~2x capacity win for the same pool, on top of the kernel's bandwidth
+    story (paged_decode_attention_quantized).
+    """
+
+    def __init__(self, conn, spec, model_id: str, max_blocks: int):
+        """``spec``: the FLOAT cache spec the engine would use unquantized
+        (its dtype is ignored for storage — data rides int8, scales f32)."""
+        from .paged import PagedKVCacheSpec
+
+        # Deferred import: connector pulls in the layerwise machinery.
+        from ..connector import KVConnector
+
+        self.spec = spec
+        data_spec = PagedKVCacheSpec(
+            num_layers=spec.num_layers,
+            num_blocks=spec.num_blocks,
+            block_tokens=spec.block_tokens,
+            num_kv_heads=spec.num_kv_heads,
+            head_dim=spec.head_dim,
+            dtype=jnp.int8,
+        )
+        scale_spec = PagedKVCacheSpec(
+            num_layers=spec.num_layers,
+            num_blocks=spec.num_blocks,
+            block_tokens=spec.block_tokens,
+            num_kv_heads=spec.num_kv_heads,
+            head_dim=1,
+            dtype=jnp.float32,
+        )
+        self.data = KVConnector(conn, data_spec, f"{model_id}/q8", max_blocks)
+        self.scales = KVConnector(conn, scale_spec, f"{model_id}/q8s", max_blocks)
+
+    def lookup(self, token_ids) -> int:
+        """Blocks cached (data sentinel; commit order makes it imply scales)."""
+        return self.data.lookup(token_ids)
+
+    async def save(self, token_ids, quant_caches, block_ids, first_block: int = 0):
+        """quant_caches: per layer ((k_int8, k_scales), (v_int8, v_scales)).
+        Returns data blocks written."""
+        scale_caches = [
+            (ks[..., None], vs[..., None]) for (_, ks), (_, vs) in quant_caches
+        ]
+        data_caches = [(kq, vq) for (kq, _), (vq, _) in quant_caches]
+        await self.scales.save(
+            token_ids, scale_caches, block_ids, first_block=first_block
+        )
+        return await self.data.save(
+            token_ids, data_caches, block_ids, first_block=first_block
+        )
+
+    async def load(self, token_ids, quant_caches, block_ids):
+        """Fetch the cached prefix into (data, scales) caches. Returns
+        (updated quant_caches, blocks_loaded); a scales race degrades to a
+        miss. Data/scale caches are donated — use the returned ones. A
+        transport error mid-read re-raises PartialReadError whose
+        ``caches`` carry the ZIPPED quantized structure (the donated-buffer
+        contract the base connector has, tpu/layerwise.py)."""
+        from .layerwise import PartialReadError
+
+        data_caches = [(kq, vq) for (kq, _), (vq, _) in quant_caches]
+        scale_caches = [
+            (ks[..., None], vs[..., None]) for (_, ks), (_, vs) in quant_caches
+        ]
+        try:
+            data_out, n = await self.data.load(token_ids, data_caches, block_ids)
+        except PartialReadError as e:
+            raise PartialReadError(
+                self._zip(e.caches, scale_caches), e.cause
+            ) from e.cause
+        if n == 0:
+            return self._zip(data_out, scale_caches), 0
+        try:
+            scale_out, ns = await self.scales.load(
+                token_ids, scale_caches, block_ids
+            )
+        except PartialReadError as e:
+            # The already-donated data caches must travel with the error or
+            # the engine is left with deleted buffers on TPU.
+            raise PartialReadError(
+                self._zip(data_out, e.caches), e.cause
+            ) from e.cause
+        if ns < n:
+            # Scales raced away after the data hit: the data alone is
+            # useless — report a miss (cache semantics; engine recomputes).
+            return self._zip(data_out, scale_out), 0
+        return self._zip(data_out, scale_out), n
+
+    @staticmethod
+    def _zip(data_caches, scale_caches):
+        return [
+            ((kq, ks[..., 0]), (vq, vs[..., 0]))
+            for (kq, vq), (ks, vs) in zip(data_caches, scale_caches)
+        ]
+
+    def drop(self, token_ids) -> int:
+        """Remove this prompt's data AND scale blocks."""
+        return self.data.drop(token_ids) + self.scales.drop(token_ids)
+
+
+def _use_pallas() -> bool:
+    return pltpu is not None and jax.default_backend() == "tpu"
+
+
+def paged_decode_attention_quantized(
+    q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens
+):
+    """Batched decode attention over an int8 paged cache.
+
+    q: [B, H, D] float; k/v_data: [N, bt, KVH, D] int8 with f32 scales
+    [N, bt, KVH] (from quantize_kv); block_tables [B, max_blocks];
+    seq_lens [B] (a zero row returns zeros). Returns [B, H, D] in q's
+    dtype. The TPU kernel DMAs blocks at int8 width and dequantizes in
+    VMEM; outputs equal attention over the dequantized cache to f32
+    rounding (the quantization error itself is the int8 scheme's, measured
+    in tests)."""
+    if _use_pallas():
+        return _quant_decode_pallas(
+            q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens,
+            interpret=False,
+        )
+    return _quant_decode_xla(
+        q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens
+    )

@@ -43,12 +43,17 @@ except ImportError:  # pragma: no cover
 _NEG_INF = -1e30
 
 
-def _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr):
+def _attn_block_update(b, i, seqlen_ref, q, k, v, m_scr, l_scr, acc_scr):
     """One grid step of the online softmax: fold cache block ``i`` of request
     ``b`` into the running (max, denominator, accumulator) scratch. Shared by
-    the normalizing kernel and the partial-stats kernel (sharded decode)."""
-    _, h, d = q_ref.shape
-    bt, kvh = k_ref.shape[1], k_ref.shape[2]
+    the normalizing kernel, the partial-stats kernel (sharded decode), and
+    the int8 kernel (kv_quant.py, which dequantizes in VMEM first).
+
+    q: [H, D] f32; k/v: [bt, KVH, D] f32 (already loaded from refs — all
+    dots request f32 accumulation at HIGHEST precision: XLA's DEFAULT runs
+    f32 matmuls in bf16 passes, which would quantize the statistics)."""
+    h, d = q.shape
+    bt, kvh = k.shape[0], k.shape[1]
     groups = h // kvh
 
     # Grid order is row-major (request b outer, block i inner), so the
@@ -60,13 +65,7 @@ def _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # All dots request f32 accumulation at HIGHEST precision: XLA's DEFAULT
-    # runs f32 matmuls in bf16 passes (on TPU and on this CPU build), which
-    # would quantize the softmax statistics.
     scale = 1.0 / np.sqrt(d)
-    q = q_ref[0].astype(jnp.float32)  # [H, D]
-    k = k_ref[0].astype(jnp.float32)  # [bt, KVH, D]
-    v = v_ref[0].astype(jnp.float32)
 
     # Per-kv-head MXU dots, stacked head-major: logits[H, bt].
     logits = (
@@ -130,7 +129,17 @@ def _decode_attn_kernel(
     del table_ref
     b = pl.program_id(0)
     i = pl.program_id(1)
-    _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+    _attn_block_update(
+        b,
+        i,
+        seqlen_ref,
+        q_ref[0].astype(jnp.float32),
+        k_ref[0].astype(jnp.float32),
+        v_ref[0].astype(jnp.float32),
+        m_scr,
+        l_scr,
+        acc_scr,
+    )
 
     @pl.when(i == pl.num_programs(1) - 1)
     def _finish():
@@ -161,7 +170,17 @@ def _decode_attn_stats_kernel(
     del table_ref
     b = pl.program_id(0)
     i = pl.program_id(1)
-    _attn_block_update(b, i, seqlen_ref, q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr)
+    _attn_block_update(
+        b,
+        i,
+        seqlen_ref,
+        q_ref[0].astype(jnp.float32),
+        k_ref[0].astype(jnp.float32),
+        v_ref[0].astype(jnp.float32),
+        m_scr,
+        l_scr,
+        acc_scr,
+    )
 
     @pl.when(i == pl.num_programs(1) - 1)
     def _finish():

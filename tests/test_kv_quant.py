@@ -1,0 +1,126 @@
+"""int8 KV-cache quantization: the quantizer's error bounds, the fused
+dequantizing decode kernel (interpret mode) against the XLA fallback and the
+full-precision oracle, and the two-connector store roundtrip (half the data
+bytes per block, commit order making a data hit imply scales)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from infinistore_tpu.tpu.kv_quant import (
+    QuantizedKVConnector,
+    _quant_decode_pallas,
+    _quant_decode_xla,
+    dequantize_kv,
+    quantize_kv,
+)
+from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+from infinistore_tpu.tpu.paged_attention import paged_decode_attention_xla_batched
+
+SPEC = PagedKVCacheSpec(
+    num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2, head_dim=32,
+    dtype=jnp.float32,
+)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((16, 8, 2, 32)) * 3.0, jnp.float32)
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    back = dequantize_kv(q, s)
+    # Per-vector bound: half a quantization step of that vector's absmax.
+    step = np.asarray(jnp.max(jnp.abs(x), axis=-1)) / 127.0
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err <= step[..., None] * 0.5000001 + 1e-7).all()
+    # Zero vectors: scale 0, exact zeros back.
+    zq, zs = quantize_kv(jnp.zeros((4, 8)))
+    assert float(jnp.abs(dequantize_kv(zq, zs)).max()) == 0.0
+
+
+def test_kernel_matches_xla_and_tracks_full_precision():
+    rng = np.random.default_rng(2)
+    N, bt, kvh, d, h, ntbl, bsz = 16, 8, 4, 16, 8, 8, 3
+    k = jnp.asarray(rng.standard_normal((N, bt, kvh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((N, bt, kvh, d)), jnp.float32)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    q = jnp.asarray(rng.standard_normal((bsz, h, d)), jnp.float32)
+    tbls = jnp.asarray(
+        np.stack([rng.permutation(N)[:ntbl] for _ in range(bsz)]), jnp.int32
+    )
+    sls = jnp.asarray([1, 30, ntbl * bt], jnp.int32)
+    got = _quant_decode_pallas(q, kq, ks, vq, vs, tbls, sls, interpret=True)
+    want = _quant_decode_xla(q, kq, ks, vq, vs, tbls, sls)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    # Against full precision: bounded by the int8 scheme, not exploding
+    # through the softmax.
+    full = paged_decode_attention_xla_batched(q, k, v, tbls, sls)
+    assert float(jnp.max(jnp.abs(want - full))) < 5e-2
+
+
+def _quant_caches(seed):
+    out = []
+    rng = np.random.default_rng(seed)
+    for _ in range(SPEC.num_layers):
+        k = jnp.asarray(rng.standard_normal(SPEC.cache_shape), jnp.float32)
+        v = jnp.asarray(rng.standard_normal(SPEC.cache_shape), jnp.float32)
+        out.append((quantize_kv(k), quantize_kv(v)))
+    return out
+
+
+def test_store_roundtrip_half_bytes(conn):
+    qc = QuantizedKVConnector(conn, SPEC, "quant-demo", max_blocks=4)
+    tokens = list(range(16))  # 2 blocks
+    caches = _quant_caches(3)
+    src = np.array([3, 9], np.int32)
+    assert asyncio.run(qc.save(tokens, caches, src)) == 2 * 2 * SPEC.num_layers
+    assert qc.lookup(tokens) == 2
+
+    fresh = [
+        (
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros((*SPEC.cache_shape[:-1],), jnp.float32)),
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros((*SPEC.cache_shape[:-1],), jnp.float32)),
+        )
+        for _ in range(SPEC.num_layers)
+    ]
+    dst = np.array([5, 0], np.int32)
+    loaded, n = asyncio.run(qc.load(tokens, fresh, dst))
+    assert n == 2
+    for layer in range(SPEC.num_layers):
+        for side in (0, 1):
+            dq_src = dequantize_kv(*caches[layer][side])
+            dq_dst = dequantize_kv(*loaded[layer][side])
+            np.testing.assert_array_equal(
+                np.asarray(dq_src)[src], np.asarray(dq_dst)[dst]
+            )
+    # Drop removes BOTH key families (data + scales).
+    assert qc.drop(tokens) == 2 * (2 * 2 * SPEC.num_layers)
+    assert qc.lookup(tokens) == 0
+
+
+def test_scales_race_degrades_to_miss(conn):
+    """Data sentinel present but scales evicted: load must report 0 (the
+    engine recomputes) — never hand back data with garbage scales."""
+    qc = QuantizedKVConnector(conn, SPEC, "quant-race", max_blocks=4)
+    tokens = list(range(16))
+    asyncio.run(qc.save(tokens, _quant_caches(4), np.array([1, 2], np.int32)))
+    assert qc.scales.drop(tokens) > 0  # the race, made deterministic
+    fresh = [
+        (
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros((*SPEC.cache_shape[:-1],), jnp.float32)),
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros((*SPEC.cache_shape[:-1],), jnp.float32)),
+        )
+        for _ in range(SPEC.num_layers)
+    ]
+    _, n = asyncio.run(qc.load(tokens, fresh, np.array([4, 5], np.int32)))
+    assert n == 0
