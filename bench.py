@@ -794,14 +794,32 @@ def _engine_harness_metrics(its, np) -> dict:
             rng.integers(0, cfg.vocab, size=req_blocks * cfg.block_tokens).tolist()
             for _ in range(3)
         ]
-        # Seed sequentially (these 3 prefill+save), then 9 concurrent
-        # admissions — every one a full hit if lookup/load work under load.
-        for f in fams:
-            asyncio.run(h.run_request(f))
-        h.stats.clear()
-        m = asyncio.run(h.run([fams[i % 3] for i in range(9)], concurrency=4))
-        assert m["max_live_requests"] >= 2
-        return m
+        # ONE event loop for the whole leg: the harness's asyncio
+        # primitives (pool/gate conditions, wave futures) bind to the loop
+        # that first awaits them.
+        async def drive():
+            # Seed sequentially (these 3 prefill+save), then 9 concurrent
+            # admissions — every one a full hit if lookup/load work.
+            for f in fams:
+                await h.run_request(f)
+            h.stats.clear()
+            m = await h.run([fams[i % 3] for i in range(9)], concurrency=4)
+            assert m["max_live_requests"] >= 2
+            # Partial-hit wave: 3 prompts share each family's 2-block
+            # prefix and diverge after -> the loaded prefix resumes and the
+            # suffixes decode through the WaveDecoder concurrently (the
+            # continuous-batching inner loop).
+            half = 2 * cfg.block_tokens
+            partial = [
+                fams[i][:half] + rng.integers(0, cfg.vocab, size=half).tolist()
+                for i in range(3)
+            ]
+            await h.run(partial, concurrency=3)
+            m["decode_waves"] = h.wave.waves
+            m["max_wave_size"] = h.wave.max_wave
+            return m
+
+        return asyncio.run(drive())
     finally:
         conn.close()
         srv.stop()
@@ -923,6 +941,10 @@ def main() -> int:
         "engine_p99_admission_us": round(engine["p99_admission_us"], 1),
         "engine_recompute_saved_s": round(engine["recompute_saved_s"], 4),
         "engine_max_live_requests": engine["max_live_requests"],
+        # Partial-hit resumes decode their suffixes in lockstep batched
+        # waves (engine.py WaveDecoder; one decode_step_batched per wave).
+        "engine_decode_waves": engine["decode_waves"],
+        "engine_max_wave_size": engine["max_wave_size"],
         "tpu_backend": backend,
     }
     if tpu is not None:

@@ -267,6 +267,11 @@ class ContinuousBatchingHarness:
     paged cache — the BASELINE config-4 workload shape (vLLM paged-KV via an
     LMCache-style connector), minus the real engine.
 
+    Drive one harness instance from ONE event loop: its asyncio primitives
+    (pool/gate conditions, wave futures) bind to the loop that first awaits
+    them, so spreading requests across several ``asyncio.run`` calls raises
+    "bound to a different event loop" once anything actually blocks.
+
     ``verify=True`` recomputes every request with a fresh one-shot prefill
     (the model's own oracle) and compares the harness cache's blocks —
     catching any stale/corrupt bytes a load under eviction churn could have
