@@ -288,6 +288,7 @@ class ContinuousBatchingHarness:
         num_blocks: int,
         max_req_blocks: int,
         verify: bool = False,
+        verify_tol: float = 2e-4,
     ):
         self.adapter = adapter
         self.params = params
@@ -298,6 +299,9 @@ class ContinuousBatchingHarness:
         self.wave = WaveDecoder(self)
         self.max_req_blocks = max_req_blocks
         self.verify = verify
+        # float-exact stores hold 2e-4; a quantizing adapter (int8 blocks,
+        # tpu/kv_quant.py QuantizingKVAdapter) needs the scheme's tolerance.
+        self.verify_tol = verify_tol
         # Instrumentation the test pins: request-level concurrency and
         # overlapping store writes.
         self.live = 0
@@ -368,7 +372,9 @@ class ContinuousBatchingHarness:
                     gather_blocks(self.caches[layer][kind], ids), np.float32
                 )
                 want = np.asarray(oracle_caches[layer][kind], np.float32)
-                if not np.allclose(got, want, rtol=2e-4, atol=2e-4):
+                if not np.allclose(
+                    got, want, rtol=self.verify_tol, atol=self.verify_tol
+                ):
                     return False
         return True
 

@@ -21,6 +21,7 @@ from .paged import (
 from .flash_prefill import flash_prefill_attention, flash_prefill_xla
 from .kv_quant import (
     QuantizedKVConnector,
+    QuantizingKVAdapter,
     dequantize_kv,
     paged_decode_attention_quantized,
     quantize_kv,
@@ -43,6 +44,7 @@ __all__ = [
     "flash_prefill_attention",
     "flash_prefill_xla",
     "QuantizedKVConnector",
+    "QuantizingKVAdapter",
     "quantize_kv",
     "dequantize_kv",
     "paged_decode_attention_quantized",

@@ -25,6 +25,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # TPU-specific pallas bits
@@ -286,3 +287,89 @@ def paged_decode_attention_quantized(
     return _quant_decode_xla(
         q, k_data, k_scales, v_data, v_scales, block_tables, seq_lens
     )
+
+
+class QuantizingKVAdapter:
+    """EngineKVAdapter-shaped surface that compresses a FLOAT engine cache
+    to int8 on the way to the store, transparently.
+
+    The engine keeps its float paged cache and its block tables exactly as
+    with the plain adapter (engine.py EngineKVAdapter); only the store
+    bytes change: ``save_kv`` gathers the request's float blocks, quantizes
+    them on device, and ships int8 + scales; ``load_kv`` fetches int8 +
+    scales and scatters dequantized floats back into the engine's cache.
+    ~2x cached context per pool at the int8 scheme's error — a harness
+    verifying against the prefill oracle must use a quantization-aware
+    tolerance (ContinuousBatchingHarness(verify_tol=...)).
+    """
+
+    def __init__(self, qconn: "QuantizedKVConnector"):
+        self.qconn = qconn
+        self.block_tokens = qconn.spec.block_tokens
+        self._nq = qconn.spec.num_blocks  # staging rows for fetch/ship
+
+    def _fresh_quant(self, rows: int):
+        spec = self.qconn.spec
+        shape = (rows, spec.block_tokens, spec.num_kv_heads, spec.head_dim)
+        return [
+            (
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32)),
+                (jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32)),
+            )
+            for _ in range(spec.num_layers)
+        ]
+
+    def get_num_matched_tokens(self, token_ids) -> int:
+        return self.qconn.lookup(token_ids) * self.block_tokens
+
+    async def save_kv(self, token_ids, caches, block_table, first_block: int = 0):
+        """Gather the float blocks, quantize, ship int8 + scales. ``caches``
+        may be the engine's full cache (gathered at ``block_table``) or
+        already-gathered block arrays with an identity table."""
+        from .paged import gather_blocks
+
+        n = len(block_table)
+        ids = jnp.asarray(np.asarray(block_table), jnp.int32)
+        quant = []
+        for k_cache, v_cache in caches:
+            kb = gather_blocks(k_cache, ids)
+            vb = gather_blocks(v_cache, ids)
+            quant.append((quantize_kv(kb), quantize_kv(vb)))
+        return await self.qconn.save(
+            token_ids, quant, np.arange(n, dtype=np.int32), first_block=first_block
+        )
+
+    async def load_kv(self, token_ids, caches, block_table):
+        """Fetch int8 + scales, dequantize, scatter into the engine's float
+        cache blocks. Returns (updated caches, tokens_loaded). The float
+        ``caches`` are donated by the scatters — use the returned ones."""
+        from .paged import scatter_blocks
+
+        # One control RTT total: qconn.load does its own prefix lookup and
+        # caps by the staging ids. Staging rows are bounded by the spec's
+        # num_blocks (a longer hit loads a shorter prefix; the engine
+        # computes the rest — never an out-of-bounds scatter).
+        n = min(len(block_table), self._nq)
+        if n == 0:
+            return list(caches), 0
+        staged, got = await self.qconn.load(
+            token_ids, self._fresh_quant(n), np.arange(n, dtype=np.int32)
+        )
+        if got == 0:
+            return list(caches), 0
+        ids = jnp.asarray(np.asarray(block_table[:got]), jnp.int32)
+        out = []
+        for (k_cache, v_cache), ((kq, ks), (vq, vs)) in zip(caches, staged):
+            dtype = k_cache.dtype
+            k_blocks = dequantize_kv(kq[:got], ks[:got], dtype=dtype)
+            v_blocks = dequantize_kv(vq[:got], vs[:got], dtype=dtype)
+            out.append(
+                (
+                    scatter_blocks(k_cache, ids, k_blocks),
+                    scatter_blocks(v_cache, ids, v_blocks),
+                )
+            )
+        return out, got * self.block_tokens
+
+    def evict_request(self, token_ids) -> int:
+        return self.qconn.drop(token_ids)

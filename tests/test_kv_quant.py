@@ -106,6 +106,42 @@ def test_store_roundtrip_half_bytes(conn):
     assert qc.lookup(tokens) == 0
 
 
+def test_engine_harness_over_quantizing_adapter(conn):
+    """A float engine runs unmodified over the quantizing adapter: its store
+    footprint halves and prefix hits come back as dequantized floats within
+    the int8 scheme's tolerance (verify_tol), with real hits on wave two."""
+    from infinistore_tpu.engine import ContinuousBatchingHarness
+    from infinistore_tpu.models import LlamaConfig, init_params
+    from infinistore_tpu.tpu.kv_quant import QuantizingKVAdapter
+
+    cfg = LlamaConfig(
+        vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
+        block_tokens=8, dtype=jnp.float32,
+    )
+    qc = QuantizedKVConnector(conn, cfg.kv_spec(4), "quant-engine", max_blocks=4)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    h = ContinuousBatchingHarness(
+        QuantizingKVAdapter(qc), params, cfg, num_blocks=16, max_req_blocks=4,
+        verify=True, verify_tol=5e-2,
+    )
+    rng = np.random.default_rng(6)
+    prompts = [
+        rng.integers(0, cfg.vocab, size=4 * cfg.block_tokens).tolist()
+        for _ in range(3)
+    ]
+
+    async def drive():
+        m1 = await h.run(prompts, concurrency=3)
+        h.stats.clear()
+        m2 = await h.run(prompts, concurrency=3)
+        return m1, m2
+
+    m1, m2 = asyncio.run(drive())
+    assert m1["all_verified"], "first wave (compute + quantized save) diverged"
+    assert m2["hit_rate"] == 1.0, "second wave should be served from the store"
+    assert m2["all_verified"], "dequantized blocks exceeded the int8 tolerance"
+
+
 def test_scales_race_degrades_to_miss(conn):
     """Data sentinel present but scales evicted: load must report 0 (the
     engine recomputes) — never hand back data with garbage scales."""
