@@ -92,10 +92,60 @@ def shaped_striping():
     srv.stop()
 
 
+def quantized_cache():
+    """int8 KV blocks: half the store bytes per block, dequantized loads
+    within the scheme's tolerance (tpu/kv_quant.py)."""
+    import asyncio
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from infinistore_tpu.tpu import (
+        PagedKVCacheSpec, QuantizedKVConnector, dequantize_kv, quantize_kv,
+    )
+
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=16 << 10)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                         log_level="error")
+    )
+    c.connect()
+    try:
+        spec = PagedKVCacheSpec(
+            num_layers=2, num_blocks=8, block_tokens=8, num_kv_heads=2,
+            head_dim=64, dtype=jnp.float32,
+        )
+        qc = QuantizedKVConnector(c, spec, "tour", max_blocks=4)
+        rng = np.random.default_rng(0)
+        float_caches = [
+            (jnp.asarray(rng.standard_normal(spec.cache_shape), jnp.float32),
+             jnp.asarray(rng.standard_normal(spec.cache_shape), jnp.float32))
+            for _ in range(spec.num_layers)
+        ]
+        quant = [
+            (quantize_kv(k), quantize_kv(v)) for k, v in float_caches
+        ]
+        tokens = list(range(16))
+        asyncio.run(qc.save(tokens, quant, np.array([0, 1], np.int32)))
+        float_bytes = 2 * spec.num_layers * 2 * spec.block_nbytes
+        data_bytes = float_bytes // 4  # f32 -> int8
+        scale_bytes = data_bytes // spec.head_dim * 4
+        err = float(
+            jnp.abs(dequantize_kv(*quant[0][0]) - float_caches[0][0]).max()
+        )
+        print(f"[quant] 2 blocks x 2 layers: float {float_bytes} B -> int8+scales "
+              f"{data_bytes + scale_bytes} B stored; max dequant err {err:.4f}; "
+              f"lookup hits {qc.lookup(tokens)} blocks")
+    finally:
+        c.close()
+        srv.stop()
+
+
 def main():
     spill_tier()
     auto_reconnect()
     shaped_striping()
+    quantized_cache()
 
 
 if __name__ == "__main__":

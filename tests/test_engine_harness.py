@@ -170,6 +170,40 @@ def test_concurrent_resumes_decode_in_batched_waves(conn, params):
     assert m["decode_waves"] < 48
 
 
+def test_wave_decoder_failure_fails_all_waiters(params):
+    """A flush that dies (model error) must fail every waiter — taken batch
+    AND still-pending — and leave the decoder usable for the next wave, not
+    wedge decode forever."""
+    from infinistore_tpu.engine import ContinuousBatchingHarness, WaveDecoder
+
+    class _Boom(Exception):
+        pass
+
+    h = ContinuousBatchingHarness.__new__(ContinuousBatchingHarness)
+    h.params = params
+    h.config = CFG
+    h.caches = CFG.kv_spec(NUM_BLOCKS).make_caches()
+    h.max_req_blocks = MAX_REQ_BLOCKS
+    h.gate = DeviceGate()
+    wave = WaveDecoder(h)
+
+    async def run():
+        bad = np.zeros(MAX_REQ_BLOCKS, np.int32)
+        # Poison one step: a wrong-shaped table makes decode_step_batched
+        # raise for the whole wave.
+        t1 = asyncio.ensure_future(wave.step(1, 8, jnp.asarray(bad)))
+        t2 = asyncio.ensure_future(wave.step(2, 8, jnp.asarray(bad[:2])))
+        r1, r2 = await asyncio.gather(t1, t2, return_exceptions=True)
+        assert isinstance(r1, Exception) and isinstance(r2, Exception)
+        # The decoder recovered: a good wave still decodes.
+        good = np.arange(MAX_REQ_BLOCKS, dtype=np.int32)
+        logits = await wave.step(3, 8, jnp.asarray(good))
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
+        assert wave.waves >= 1
+
+    asyncio.run(run())
+
+
 def test_block_pool_backpressure():
     """alloc() waits for free blocks instead of failing (scheduler-style
     admission deferral)."""
