@@ -805,18 +805,19 @@ def _engine_harness_metrics(its, np) -> dict:
             h.stats.clear()
             m = await h.run([fams[i % 3] for i in range(9)], concurrency=4)
             assert m["max_live_requests"] >= 2
-            # Partial-hit wave: 3 prompts share each family's 2-block
-            # prefix and diverge after -> the loaded prefix resumes and the
-            # suffixes decode through the WaveDecoder concurrently (the
+            # Generation round: 3 partial-hit prompts resume via chunked
+            # continuation (one prefill_continue call each) and then
+            # generate in lockstep waves through the WaveDecoder (the
             # continuous-batching inner loop).
             half = 2 * cfg.block_tokens
             partial = [
-                fams[i][:half] + rng.integers(0, cfg.vocab, size=half).tolist()
+                fams[i][:half]
+                + rng.integers(0, cfg.vocab, size=cfg.block_tokens).tolist()
                 for i in range(3)
             ]
-            await h.run(partial, concurrency=3)
-            m["decode_waves"] = h.wave.waves
-            m["max_wave_size"] = h.wave.max_wave
+            m2 = await h.run(partial, concurrency=3, gen_tokens=8)
+            for key in ("decode_waves", "max_wave_size", "generated_tokens"):
+                m[key] = m2[key]
             return m
 
         return asyncio.run(drive())
@@ -945,6 +946,7 @@ def main() -> int:
         # waves (engine.py WaveDecoder; one decode_step_batched per wave).
         "engine_decode_waves": engine["decode_waves"],
         "engine_max_wave_size": engine["max_wave_size"],
+        "engine_generated_tokens": engine["generated_tokens"],
         "tpu_backend": backend,
     }
     if tpu is not None:

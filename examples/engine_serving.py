@@ -44,17 +44,25 @@ def main():
         # within a family share everything (think: repeated system prompts).
         rng = np.random.default_rng(7)
         families = [
-            rng.integers(0, cfg.vocab, size=req_blocks * cfg.block_tokens).tolist()
+            rng.integers(
+                0, cfg.vocab, size=(req_blocks - 1) * cfg.block_tokens
+            ).tolist()
             for _ in range(3)
         ]
         workload = [families[i % 3] for i in range(12)]
 
-        metrics = asyncio.run(harness.run(workload, concurrency=4))
+        # Each request also GENERATES a few greedy tokens: concurrent
+        # requests advance in lockstep batched waves (decode_waves /
+        # max_wave_size below).
+        metrics = asyncio.run(
+            harness.run(workload, concurrency=4, gen_tokens=cfg.block_tokens)
+        )
         print("engine-side scoreboard:")
         for k in (
             "requests", "hit_rate", "loaded_blocks", "computed_blocks",
             "raced_evictions", "p50_admission_us", "p99_admission_us",
-            "recompute_saved_s", "max_live_requests", "all_verified",
+            "recompute_saved_s", "max_live_requests", "decode_waves",
+            "max_wave_size", "generated_tokens", "all_verified",
         ):
             v = metrics[k]
             print(f"  {k}: {v:.3f}" if isinstance(v, float) else f"  {k}: {v}")

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.llama import decode_step_batched, prefill
+from .models.llama import decode_step_batched, prefill, prefill_continue
 from .tpu.paged import gather_blocks
 
 
@@ -260,6 +260,7 @@ class RequestStats:
     admission_us: float  # t0 -> prefix load settled (the scheduler stall)
     raced_eviction: bool  # lookup hit but blocks evicted before the read
     verified: Optional[bool]  # None when verification is off
+    generated: Optional[List[int]] = None  # wave-decoded tokens (greedy)
 
 
 class ContinuousBatchingHarness:
@@ -342,16 +343,41 @@ class ContinuousBatchingHarness:
         if self._prefill_per_block_s is None or per_block < self._prefill_per_block_s:
             self._prefill_per_block_s = per_block
 
-    async def _decode_suffix(self, token_ids, table: np.ndarray, start_block: int):
-        """Token-by-token decode of the suffix after a prefix hit (the
-        engine's prefix-cache resume path) — each step rides the shared
-        WaveDecoder, so concurrent resuming requests advance in lockstep
-        batched waves. No gate held here: the wave flusher takes the
-        exclusive phase per wave."""
+    def _chunked_resume(self, token_ids, table: np.ndarray, start_block: int):
+        """Compute the suffix after a prefix hit as ONE chunked continuation
+        (models/llama.py prefill_continue — the engine's chunked-prefill
+        resume path): every suffix row attends its own prefix in a single
+        batched kernel launch per layer, with chunk-wide GEMMs, instead of
+        S_c sequential decode launches. Cache-mutating: caller holds the
+        exclusive gate."""
         bt = self.config.block_tokens
+        suffix = jnp.asarray(token_ids[start_block * bt :], jnp.int32)
+        _, self.caches = prefill_continue(
+            self.params,
+            suffix,
+            jnp.int32(start_block * bt),
+            self.caches,
+            self._padded_table(table),
+            self.config,
+            self.max_req_blocks,
+        )
+
+    async def _generate(self, token_ids, table: np.ndarray, gen_tokens: int):
+        """Greedy generation through the shared WaveDecoder: every live
+        request advances one token per lockstep wave (the continuous-
+        batching inner loop). The first step re-decodes the last prompt
+        token — its K/V insert rewrites identical bytes (the decode ==
+        prefill invariant) and yields the logits that choose token one."""
         padded = self._padded_table(table)
-        for pos in range(start_block * bt, len(token_ids)):
-            await self.wave.step(int(token_ids[pos]), pos, padded)
+        pos = len(token_ids) - 1
+        tok = int(token_ids[-1])
+        out = []
+        for _ in range(gen_tokens):
+            logits = await self.wave.step(tok, pos, padded)
+            tok = int(jnp.argmax(logits))
+            out.append(tok)
+            pos += 1
+        return out
 
     def _verify_request(self, token_ids, table: np.ndarray) -> bool:
         """Compare the harness cache's blocks for this request against a
@@ -380,37 +406,42 @@ class ContinuousBatchingHarness:
 
     # -- request lifecycle ---------------------------------------------------
 
-    async def run_request(self, token_ids: Sequence[int]) -> RequestStats:
+    async def run_request(
+        self, token_ids: Sequence[int], gen_tokens: int = 0
+    ) -> RequestStats:
         bt = self.config.block_tokens
         n_blocks = len(token_ids) // bt
-        if n_blocks == 0 or n_blocks > self.max_req_blocks:
+        total_blocks = -(-(n_blocks * bt + gen_tokens) // bt)
+        if n_blocks == 0 or total_blocks > self.max_req_blocks:
             raise ValueError(
-                f"prompt must span 1..{self.max_req_blocks} complete blocks"
+                f"prompt + generation must span 1..{self.max_req_blocks} "
+                "blocks (prompt in complete blocks)"
             )
         token_ids = list(token_ids)[: n_blocks * bt]
         self.live += 1
         self.max_live = max(self.max_live, self.live)
-        table = await self.pool.alloc(n_blocks)
+        table = await self.pool.alloc(total_blocks)
         try:
             t0 = time.perf_counter()
+            prompt_table = table[:n_blocks]  # tail blocks (if any) are for generation
             hit_tokens = self.adapter.get_num_matched_tokens(token_ids)
             async with self.gate.exclusive():
                 self.caches, loaded_tokens = await self.adapter.load_kv(
-                    token_ids, self.caches, table
+                    token_ids, self.caches, prompt_table
                 )
             admission_us = (time.perf_counter() - t0) * 1e6
             loaded_blocks = loaded_tokens // bt
             raced = hit_tokens > 0 and loaded_tokens == 0
             if loaded_blocks < n_blocks:
-                if loaded_blocks == 0:
-                    async with self.gate.exclusive():
-                        self._prefill_full(token_ids, table)
-                else:
-                    await self._decode_suffix(token_ids, table, loaded_blocks)
+                async with self.gate.exclusive():
+                    if loaded_blocks == 0:
+                        self._prefill_full(token_ids, prompt_table)
+                    else:
+                        self._chunked_resume(token_ids, table, loaded_blocks)
             verified = None
             if self.verify:
                 async with self.gate.shared():
-                    verified = self._verify_request(token_ids, table)
+                    verified = self._verify_request(token_ids, prompt_table)
             # Save ONLY the computed suffix — the loaded prefix came from the
             # store and re-writing it would double write traffic for every
             # prefix hit. Snapshot those blocks into private arrays under the
@@ -420,7 +451,7 @@ class ContinuousBatchingHarness:
             # Holding the gate across the save would serialize the whole
             # pipeline (the next request's exclusive load waits on it).
             if loaded_blocks < n_blocks:
-                suffix_dev = jnp.asarray(table[loaded_blocks:])
+                suffix_dev = jnp.asarray(prompt_table[loaded_blocks:])
                 async with self.gate.shared():
                     snapshot = [
                         (gather_blocks(k, suffix_dev), gather_blocks(v, suffix_dev))
@@ -440,6 +471,9 @@ class ContinuousBatchingHarness:
                     )
                 finally:
                     self._saving -= 1
+            generated = None
+            if gen_tokens:
+                generated = await self._generate(token_ids, table, gen_tokens)
             stats = RequestStats(
                 tokens=len(token_ids),
                 hit_blocks=hit_tokens // bt,
@@ -448,6 +482,7 @@ class ContinuousBatchingHarness:
                 admission_us=admission_us,
                 raced_eviction=raced,
                 verified=verified,
+                generated=generated,
             )
             self.stats.append(stats)
             return stats
@@ -455,14 +490,20 @@ class ContinuousBatchingHarness:
             await self.pool.free(table)
             self.live -= 1
 
-    async def run(self, prompts: Sequence[Sequence[int]], concurrency: int = 4):
-        """Run all prompts with bounded request concurrency; returns the
-        aggregate metrics dict."""
+    async def run(
+        self,
+        prompts: Sequence[Sequence[int]],
+        concurrency: int = 4,
+        gen_tokens: int = 0,
+    ):
+        """Run all prompts with bounded request concurrency (optionally
+        generating ``gen_tokens`` greedy tokens each via lockstep wave
+        decode); returns the aggregate metrics dict."""
         sem = asyncio.Semaphore(concurrency)
 
         async def one(p):
             async with sem:
-                return await self.run_request(p)
+                return await self.run_request(p, gen_tokens=gen_tokens)
 
         await asyncio.gather(*(one(p) for p in prompts))
         return self.metrics()
@@ -491,6 +532,9 @@ class ContinuousBatchingHarness:
             "max_concurrent_saves": self.max_concurrent_saves,
             "decode_waves": self.wave.waves,
             "max_wave_size": self.wave.max_wave,
+            "generated_tokens": sum(
+                len(s.generated) for s in self.stats if s.generated
+            ),
             "all_verified": all(
                 s.verified for s in self.stats if s.verified is not None
             ),

@@ -16,6 +16,7 @@ from .llama import (
     init_params,
     loss_fn,
     prefill,
+    prefill_continue,
     train_step,
 )
 
@@ -23,6 +24,7 @@ __all__ = [
     "LlamaConfig",
     "init_params",
     "prefill",
+    "prefill_continue",
     "decode_step",
     "decode_step_batched",
     "loss_fn",

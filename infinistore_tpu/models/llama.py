@@ -296,6 +296,56 @@ def decode_step(
 
 
 @functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
+def prefill_continue(
+    params: Params,
+    tokens: jax.Array,  # [S_c] int32, the suffix chunk
+    start_pos: jax.Array,  # [] int32, absolute position of tokens[0]
+    caches: Caches,
+    block_table: jax.Array,  # [max_blocks] int32 (padded)
+    config: LlamaConfig,
+    max_blocks: int,
+) -> Tuple[jax.Array, Caches]:
+    """Chunked continuation prefill: compute a multi-token suffix against an
+    already-populated paged prefix in ONE call per layer (the engine's
+    chunked-prefill resume path — vLLM's treatment of a prefix-cache hit).
+    Token-by-token ``decode_step`` costs S_c launches per layer and GEMV
+    matmuls; this inserts the whole chunk's K/V and attends all chunk rows
+    in one batched kernel launch (each row masked to its own prefix length),
+    with chunk-wide GEMMs for the projections and FFN. Semantically equal to
+    the decode loop (tested). Returns ([S_c, vocab] logits, caches)."""
+    if block_table.shape[0] != max_blocks:
+        raise ValueError(
+            f"block_table has {block_table.shape[0]} entries, expected "
+            f"max_blocks={max_blocks} (pad the table to the static bound)"
+        )
+    bt = config.block_tokens
+    s_c = tokens.shape[0]
+    positions = start_pos + jnp.arange(s_c, dtype=jnp.int32)  # [S_c]
+    x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, S_c, dim]
+
+    block_idx = jnp.take(block_table, positions // bt)  # [S_c]
+    slots = positions % bt
+    tables = jnp.broadcast_to(block_table, (s_c, max_blocks))
+
+    new_caches: Caches = []
+    for layer, (k_cache, v_cache) in enumerate(caches):
+        k, v = _kv_proj(params, layer, x, positions[None], config)  # [1,S_c,KVH,D]
+        k_cache = k_cache.at[block_idx, slots].set(k[0].astype(k_cache.dtype))
+        v_cache = v_cache.at[block_idx, slots].set(v[0].astype(v_cache.dtype))
+        pre = f"l{layer}."
+        q = _q_proj(params, layer, x, positions[None], config)  # [1,S_c,H,D]
+        attn = paged_decode_attention_batched(
+            q[0], k_cache, v_cache, tables, positions + 1
+        )  # [S_c, H, D]
+        x = x + jnp.einsum("shk,hkd->sd", attn, params[pre + "wo"])[None]
+        x = _ffn(params, layer, x, config)
+        new_caches.append((k_cache, v_cache))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits[0], new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
 def decode_step_batched(
     params: Params,
     tokens: jax.Array,  # [B] int32, one next-token per live request

@@ -148,26 +148,58 @@ def test_eviction_churn_correctness(params):
         srv.stop()
 
 
-def test_concurrent_resumes_decode_in_batched_waves(conn, params):
-    """Requests resuming from a prefix hit decode their suffixes through the
-    shared WaveDecoder: with several resuming concurrently, at least one
-    wave must carry >= 2 requests (one decode_step_batched call advancing
-    both), and every request still verifies against the oracle."""
-    h = _harness(conn, params, "engine-waves")
-    # Seed one 2-block family so later admissions hit 2 and decode 2.
-    fams = _prompts(4, shared_blocks=2, total_blocks=4, seed=13)
-    asyncio.run(h.run_request(fams[0]))
-    h.stats.clear()
-    m = asyncio.run(h.run(fams[1:], concurrency=3))
+def test_resume_is_chunked_and_generation_waves_batch(conn, params):
+    """Prefix-hit resumes compute their suffix as ONE chunked continuation
+    (no per-token decode), while GENERATION rides the shared WaveDecoder:
+    with several requests generating concurrently, at least one wave must
+    carry >= 2 requests, lockstep must merge steps, and everything still
+    verifies against the oracle."""
+
+    async def drive():
+        h = _harness(conn, params, "engine-waves")
+        # Seed one 2-block family so later admissions hit 2 and resume.
+        fams = _prompts(4, shared_blocks=2, total_blocks=3, seed=13)
+        await h.run_request(fams[0])
+        h.stats.clear()
+        m = await h.run(fams[1:], concurrency=3, gen_tokens=8)
+        return m
+
+    m = asyncio.run(drive())
     assert m["all_verified"]
     assert m["loaded_blocks"] >= 3 * 2  # each resumed the seeded prefix
+    assert m["generated_tokens"] == 3 * 8
     assert m["decode_waves"] > 0
     assert m["max_wave_size"] >= 2, (
-        "concurrent suffix decodes never coalesced into one batched wave"
+        "concurrent generations never coalesced into one batched wave"
     )
-    # Lockstep actually reduced step count: 3 requests x 16 suffix tokens
-    # would be 48 sequential steps; waves must have merged a chunk of them.
-    assert m["decode_waves"] < 48
+    # Lockstep actually reduced step count: 3 requests x 8 tokens would be
+    # 24 sequential steps; waves must have merged a chunk of them.
+    assert m["decode_waves"] < 24
+
+
+def test_generation_is_deterministic_under_wave_interleaving(conn, params):
+    """Greedy generation depends only on a request's own cache blocks, so
+    concurrent lockstep waves must produce token-for-token the same output
+    as running each prompt alone."""
+
+    async def concurrent():
+        h = _harness(conn, params, "engine-det", verify=False)
+        prompts = _prompts(3, shared_blocks=1, total_blocks=3, seed=17)
+        await h.run(prompts, concurrency=3, gen_tokens=8)
+        return prompts, {tuple(s.generated) for s in h.stats}
+
+    prompts, together = asyncio.run(concurrent())
+
+    async def solo():
+        h = _harness(conn, params, "engine-det", verify=False)
+        out = set()
+        for p in prompts:
+            s = await h.run_request(p, gen_tokens=8)
+            out.add(tuple(s.generated))
+        return out
+
+    alone = asyncio.run(solo())
+    assert together == alone
 
 
 def test_wave_decoder_failure_fails_all_waiters(params):
