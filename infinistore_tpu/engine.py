@@ -362,6 +362,33 @@ class ContinuousBatchingHarness:
             self.max_req_blocks,
         )
 
+    async def _save_blocks(self, chain_ids, phys_blocks, first_block: int):
+        """Snapshot the given physical blocks into private arrays under the
+        shared gate (device-side gathers, microseconds), then stream them to
+        the store with NO gate held: the save — the long store-I/O phase —
+        overlaps other requests' loads, computes, and saves. Holding the
+        gate across the save would serialize the whole pipeline (the next
+        request's exclusive load waits on it). ``chain_ids`` key the blocks
+        (the prompt, or prompt + generated for response blocks)."""
+        dev = jnp.asarray(np.asarray(phys_blocks))
+        async with self.gate.shared():
+            snapshot = [
+                (gather_blocks(k, dev), gather_blocks(v, dev))
+                for k, v in self.caches
+            ]
+            jax.block_until_ready(snapshot)
+        self._saving += 1
+        self.max_concurrent_saves = max(self.max_concurrent_saves, self._saving)
+        try:
+            await self.adapter.save_kv(
+                chain_ids,
+                snapshot,
+                np.arange(len(phys_blocks), dtype=np.int32),
+                first_block=first_block,
+            )
+        finally:
+            self._saving -= 1
+
     async def _generate(self, token_ids, table: np.ndarray, gen_tokens: int):
         """Greedy generation through the shared WaveDecoder: every live
         request advances one token per lockstep wave (the continuous-
@@ -377,6 +404,12 @@ class ContinuousBatchingHarness:
             tok = int(jnp.argmax(logits))
             out.append(tok)
             pos += 1
+        # Each step inserts the PREVIOUS token's K/V. When the final
+        # generated token completes a block (which the extended-chain save
+        # below persists), one more step lands it; otherwise its block is
+        # an incomplete tail with no chain key — skip the wasted wave.
+        if (len(token_ids) + gen_tokens) % self.config.block_tokens == 0:
+            await self.wave.step(tok, pos, padded)
         return out
 
     def _verify_request(self, token_ids, table: np.ndarray) -> bool:
@@ -444,36 +477,25 @@ class ContinuousBatchingHarness:
                     verified = self._verify_request(token_ids, prompt_table)
             # Save ONLY the computed suffix — the loaded prefix came from the
             # store and re-writing it would double write traffic for every
-            # prefix hit. Snapshot those blocks into private arrays under the
-            # shared gate (device-side gathers, microseconds), then stream
-            # them out with NO gate held: the save — the long store-I/O
-            # phase — overlaps other requests' loads, computes, and saves.
-            # Holding the gate across the save would serialize the whole
-            # pipeline (the next request's exclusive load waits on it).
+            # prefix hit.
             if loaded_blocks < n_blocks:
-                suffix_dev = jnp.asarray(prompt_table[loaded_blocks:])
-                async with self.gate.shared():
-                    snapshot = [
-                        (gather_blocks(k, suffix_dev), gather_blocks(v, suffix_dev))
-                        for k, v in self.caches
-                    ]
-                    jax.block_until_ready(snapshot)
-                self._saving += 1
-                self.max_concurrent_saves = max(
-                    self.max_concurrent_saves, self._saving
+                await self._save_blocks(
+                    token_ids, prompt_table[loaded_blocks:], loaded_blocks
                 )
-                try:
-                    await self.adapter.save_kv(
-                        token_ids,
-                        snapshot,
-                        np.arange(n_blocks - loaded_blocks, dtype=np.int32),
-                        first_block=loaded_blocks,
-                    )
-                finally:
-                    self._saving -= 1
             generated = None
             if gen_tokens:
                 generated = await self._generate(token_ids, table, gen_tokens)
+                # Save the COMPLETE blocks the response filled, keyed by the
+                # extended chain (prompt + generated): a follow-up turn whose
+                # prompt is this conversation so far gets a full prefix hit
+                # instead of recomputing the response's KV (chain hashes
+                # commit to the whole prefix, connector.py).
+                full_ids = token_ids + generated
+                full_blocks = len(full_ids) // bt
+                if full_blocks > n_blocks:
+                    await self._save_blocks(
+                        full_ids, table[n_blocks:full_blocks], n_blocks
+                    )
             stats = RequestStats(
                 tokens=len(token_ids),
                 hit_blocks=hit_tokens // bt,

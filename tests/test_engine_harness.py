@@ -185,21 +185,51 @@ def test_generation_is_deterministic_under_wave_interleaving(conn, params):
     async def concurrent():
         h = _harness(conn, params, "engine-det", verify=False)
         prompts = _prompts(3, shared_blocks=1, total_blocks=3, seed=17)
-        await h.run(prompts, concurrency=3, gen_tokens=8)
-        return prompts, {tuple(s.generated) for s in h.stats}
+        sem = asyncio.Semaphore(3)
+
+        async def one(p):
+            async with sem:
+                return await h.run_request(p, gen_tokens=8)
+
+        # Keep the PROMPT -> OUTPUT pairing: set-compare would miss waves
+        # handing one request another's continuation.
+        stats = await asyncio.gather(*(one(p) for p in prompts))
+        return prompts, [tuple(s.generated) for s in stats]
 
     prompts, together = asyncio.run(concurrent())
 
     async def solo():
         h = _harness(conn, params, "engine-det", verify=False)
-        out = set()
+        out = []
         for p in prompts:
             s = await h.run_request(p, gen_tokens=8)
-            out.add(tuple(s.generated))
+            out.append(tuple(s.generated))
         return out
 
     alone = asyncio.run(solo())
     assert together == alone
+
+
+def test_multi_turn_conversation_hits_generated_blocks(conn, params):
+    """Turn 2's prompt = turn 1's prompt + its generated response: the
+    response blocks were saved under the extended chain, so the follow-up
+    admission is a FULL prefix hit — the conversation's KV never recomputes
+    across turns."""
+
+    async def drive():
+        h = _harness(conn, params, "engine-turns")
+        bt = CFG.block_tokens
+        turn1 = _prompts(1, 1, 2, seed=23)[0]  # 2 complete blocks
+        s1 = await h.run_request(turn1, gen_tokens=bt)  # fills 1 more block
+        assert len(s1.generated) == bt
+        turn2 = turn1 + s1.generated  # the conversation so far, 3 blocks
+        s2 = await h.run_request(turn2)
+        return s1, s2
+
+    s1, s2 = asyncio.run(drive())
+    assert s2.hit_blocks == 3, "generated block should extend the cached chain"
+    assert s2.loaded_blocks == 3 and s2.computed_blocks == 0
+    assert s2.verified
 
 
 def test_wave_decoder_failure_fails_all_waiters(params):

@@ -105,6 +105,36 @@ def test_disagg_prefill_store_decode(conn, params):
     )
 
 
+def test_prefill_continue_matches_decode_loop_logits(params):
+    """Chunked continuation must reproduce the decode loop's logits at EVERY
+    chunk row (not just leave equal cache bytes)."""
+    from infinistore_tpu.models import prefill_continue
+
+    full = jax.random.randint(jax.random.PRNGKey(9), (32,), 0, CFG.vocab)
+    table = jnp.asarray([0, 1, 2, 3], jnp.int32)
+    _, caches = prefill(params, full[:16], _fresh_caches(), table[:2], CFG)
+    cont_logits, cont_caches = prefill_continue(
+        params, full[16:], jnp.int32(16), caches, table, CFG, MAX_BLOCKS
+    )
+
+    _, loop_caches = prefill(params, full[:16], _fresh_caches(), table[:2], CFG)
+    for i, pos in enumerate(range(16, 32)):
+        step_logits, loop_caches = decode_step(
+            params, full[pos], jnp.int32(pos), loop_caches, table, CFG, MAX_BLOCKS
+        )
+        np.testing.assert_allclose(
+            np.asarray(cont_logits[i]), np.asarray(step_logits),
+            rtol=2e-5, atol=2e-5, err_msg=f"row {i}",
+        )
+    for layer in range(CFG.n_layers):
+        for kind in (0, 1):
+            np.testing.assert_allclose(
+                np.asarray(cont_caches[layer][kind]),
+                np.asarray(loop_caches[layer][kind]),
+                rtol=2e-5, atol=2e-5,
+            )
+
+
 def test_train_step_runs(params):
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 32), 0, CFG.vocab)
     import copy
