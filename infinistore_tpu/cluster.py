@@ -69,7 +69,13 @@ class ClusterKVConnector:
         max_blocks: int,
         member_ids: Optional[Sequence[str]] = None,
         degrade: bool = False,
+        member_factory=None,
     ):
+        """``member_factory(conn) -> KVConnector-shaped``: what each member
+        runs over its connection — defaults to a plain ``KVConnector``; pass
+        e.g. ``lambda c: QuantizedKVConnector(c, spec, model_id, max_blocks)``
+        for an int8 pool (routing composes with any member that has
+        lookup/load/save/drop)."""
         if not conns:
             raise ValueError("cluster needs at least one connection")
         if member_ids is None:
@@ -85,9 +91,9 @@ class ClusterKVConnector:
         if len(set(member_ids)) != len(member_ids):
             raise ValueError(f"member_ids must be unique, got {member_ids}")
         self.member_ids = list(member_ids)
-        self.members = [
-            KVConnector(c, spec, model_id, max_blocks) for c in conns
-        ]
+        if member_factory is None:
+            member_factory = lambda c: KVConnector(c, spec, model_id, max_blocks)
+        self.members = [member_factory(c) for c in conns]
         self.spec = spec
         self.model_id = model_id
         self.max_blocks = max_blocks
@@ -176,8 +182,12 @@ class ClusterKVConnector:
         ``degraded_ops``)."""
         out = []
         for mid, m in zip(self.member_ids, self.members):
+            # Members expose get_stats() themselves (KVConnector and the
+            # quantized connector both do) — the cluster stays blind to
+            # member internals; a member without it just reports its id.
+            getter = getattr(m, "get_stats", None)
             try:
-                s = dict(m.conn.get_stats())
+                s = dict(getter()) if getter is not None else {}
             except InfiniStoreException:
                 s = {"unreachable": True}
             s["member_id"] = mid

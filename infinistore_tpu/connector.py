@@ -294,6 +294,12 @@ class KVConnector:
         await self.save(token_ids, caches, np.asarray(src_block_ids)[:n])
         return await self.load(token_ids, caches, np.asarray(dst_block_ids)[:n])
 
+    def get_stats(self) -> dict:
+        """The store connection's per-op stats snapshot (observability
+        surface composed members re-expose — cluster.py stats())."""
+        self._require_store("get_stats")
+        return self.conn.get_stats()
+
     def drop(self, token_ids) -> int:
         """Remove this prompt's blocks from the store (all layers). Returns
         the number of store keys deleted."""

@@ -262,6 +262,10 @@ class QuantizedKVConnector:
         """Remove this prompt's data AND scale blocks."""
         return self.data.drop(token_ids) + self.scales.drop(token_ids)
 
+    def get_stats(self) -> dict:
+        """Connection stats (both planes ride one connection)."""
+        return self.data.get_stats()
+
 
 def _use_pallas() -> bool:
     return pltpu is not None and jax.default_backend() == "tpu"

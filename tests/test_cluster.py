@@ -171,6 +171,49 @@ def test_down_member_strict_raises_degrade_misses(cluster3):
     assert "member_id" in stats[0]
 
 
+def test_quantized_members_compose_with_routing(cluster3):
+    """member_factory swaps each member for a QuantizedKVConnector: the
+    pool stores int8 + scales per member while prefix-affine routing and
+    the degrade policy stay the cluster's."""
+    from infinistore_tpu.tpu.kv_quant import (
+        QuantizedKVConnector, dequantize_kv, quantize_kv,
+    )
+
+    _, conns = cluster3
+    cluster = ClusterKVConnector(
+        conns, SPEC, "demo-q8", max_blocks=8,
+        member_factory=lambda c: QuantizedKVConnector(c, SPEC, "demo-q8", 8),
+    )
+    tokens = _prompt_owned_by(cluster, 0)
+    rng = np.random.default_rng(8)
+    float_caches = [
+        (jnp.asarray(rng.standard_normal(SPEC.cache_shape), jnp.float32),
+         jnp.asarray(rng.standard_normal(SPEC.cache_shape), jnp.float32))
+        for _ in range(SPEC.num_layers)
+    ]
+    quant = [(quantize_kv(k), quantize_kv(v)) for k, v in float_caches]
+    src = np.array([1, 2], np.int32)
+    assert asyncio.run(cluster.save(tokens, quant, src)) == 2 * 2 * SPEC.num_layers
+    assert cluster.lookup(tokens) == 2
+
+    fresh = [
+        (
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros(SPEC.cache_shape[:-1], jnp.float32)),
+            (jnp.zeros(SPEC.cache_shape, jnp.int8),
+             jnp.zeros(SPEC.cache_shape[:-1], jnp.float32)),
+        )
+        for _ in range(SPEC.num_layers)
+    ]
+    dst = np.array([4, 6], np.int32)
+    loaded, n = asyncio.run(cluster.load(tokens, fresh, dst))
+    assert n == 2
+    got = np.asarray(dequantize_kv(*loaded[0][0]))[dst]
+    want = np.asarray(dequantize_kv(*quant[0][0]))[src]
+    np.testing.assert_array_equal(got, want)
+    assert "member_id" in cluster.stats()[0]
+
+
 def test_engine_harness_runs_over_cluster(cluster3):
     """The continuous-batching harness (BASELINE config 4 shape) over a
     2-member cluster pool: concurrent requests, full verification against
