@@ -17,6 +17,7 @@ from .llama import (
     loss_fn,
     prefill,
     prefill_continue,
+    speculative_verify,
     train_step,
 )
 
@@ -25,6 +26,7 @@ __all__ = [
     "init_params",
     "prefill",
     "prefill_continue",
+    "speculative_verify",
     "decode_step",
     "decode_step_batched",
     "loss_fn",

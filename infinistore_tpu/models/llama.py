@@ -345,6 +345,50 @@ def prefill_continue(
     return logits[0], new_caches
 
 
+def speculative_verify(
+    params: Params,
+    draft: jax.Array,  # [D] int32 draft tokens (draft[0] already validated
+    #                    by the caller against its previous step's logits)
+    start_pos,  # int, absolute position of draft[0]
+    caches: Caches,
+    block_table: jax.Array,  # [max_blocks] int32 (padded)
+    config: LlamaConfig,
+    max_blocks: int,
+):
+    """Score a whole speculative draft in ONE chunked pass and accept its
+    longest greedy-consistent prefix.
+
+    ``prefill_continue`` processes all D draft tokens at once (each row
+    attends its own prefix); row i's argmax is the target model's next
+    token after ``draft[:i+1]``, so ``draft[i+1]`` is accepted iff it
+    equals that argmax. Returns ``(n_accepted, next_token, caches)`` where
+    ``next_token`` is the target model's continuation after the accepted
+    prefix — the token the engine emits alongside the accepted draft.
+
+    Rollback is free by construction: rejected draft positions DID insert
+    K/V into their slots, but every later decode masks attention by
+    ``position + 1`` (tpu/paged_attention.py), so stale slots beyond the
+    accepted point are never attended and are overwritten when real tokens
+    reach those positions. The caller only rewinds its position counter.
+    Cites the reference's cache-semantics stance (SURVEY.md §5.3): wrong
+    speculation costs recompute, never correctness."""
+    d = draft.shape[0]
+    if d == 0:
+        raise ValueError("speculative_verify needs a non-empty draft")
+    logits, caches = prefill_continue(
+        params, draft, jnp.int32(start_pos), caches, block_table, config,
+        max_blocks,
+    )
+    # One [D]-sized transfer: this runs every speculation round on the
+    # decode hot path, so don't pay three separate device->host syncs.
+    preds = np.asarray(jnp.argmax(logits, axis=-1))  # preds[i] follows draft[:i+1]
+    draft_host = np.asarray(draft)
+    ok = preds[:-1] == draft_host[1:]  # draft[i+1] consistent with the target?
+    n_accepted = 1 + int(np.argmin(ok)) if not ok.all() else d
+    next_token = int(preds[n_accepted - 1])
+    return n_accepted, next_token, caches
+
+
 @functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
 def decode_step_batched(
     params: Params,

@@ -135,6 +135,61 @@ def test_prefill_continue_matches_decode_loop_logits(params):
             )
 
 
+def test_speculative_verify_accepts_greedy_prefix(params):
+    """The accepted draft prefix + emitted token must exactly reproduce
+    token-by-token greedy decoding; a corrupted draft tail is rejected at
+    the first divergence, and continuing from the accepted point (stale
+    slots beyond it never attended) still matches greedy."""
+    from infinistore_tpu.models import speculative_verify
+
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (16,), 0, CFG.vocab)
+    table = jnp.asarray([0, 1, 2, 3], jnp.int32)
+
+    # Greedy oracle: greedy[i] = token at position 16 + i.
+    logits, oracle_caches = prefill(params, prompt, _fresh_caches(), table[:2], CFG)
+    greedy = []
+    tok = int(jnp.argmax(logits))  # token at position 16
+    pos = 16
+    for _ in range(9):
+        greedy.append(tok)
+        logits, oracle_caches = decode_step(
+            params, jnp.int32(tok), jnp.int32(pos), oracle_caches, table, CFG,
+            MAX_BLOCKS,
+        )
+        tok = int(jnp.argmax(logits))
+        pos += 1
+
+    # A PERFECT draft (the greedy continuation itself) is fully accepted
+    # and the emitted next_token continues it.
+    _, caches = prefill(params, prompt, _fresh_caches(), table[:2], CFG)
+    draft = jnp.asarray(greedy[:6], jnp.int32)
+    n, nxt, caches = speculative_verify(
+        params, draft, 16, caches, table, CFG, MAX_BLOCKS
+    )
+    assert n == 6, f"perfect draft should fully accept, got {n}"
+    assert nxt == greedy[6]
+
+    # A draft corrupted at index 3 accepts exactly 3 and emits the greedy
+    # token for that position instead.
+    _, caches2 = prefill(params, prompt, _fresh_caches(), table[:2], CFG)
+    bad = list(greedy[:6])
+    bad[3] = (bad[3] + 1) % CFG.vocab
+    n2, nxt2, caches2 = speculative_verify(
+        params, jnp.asarray(bad, jnp.int32), 16, caches2, table, CFG, MAX_BLOCKS
+    )
+    assert n2 == 3, f"should reject at the corruption, got {n2}"
+    assert nxt2 == greedy[3]
+
+    # Continue from the accepted point over the same caches (stale slots
+    # beyond position 16+3 are present but masked): next greedy step
+    # matches the oracle.
+    logits3, _ = decode_step(
+        params, jnp.int32(nxt2), jnp.int32(16 + n2), caches2, table, CFG,
+        MAX_BLOCKS,
+    )
+    assert int(jnp.argmax(logits3)) == greedy[4]
+
+
 def test_train_step_runs(params):
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 32), 0, CFG.vocab)
     import copy
