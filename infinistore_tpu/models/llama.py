@@ -347,13 +347,14 @@ def prefill_continue(
 
 def speculative_verify(
     params: Params,
-    draft: jax.Array,  # [D] int32 draft tokens (draft[0] already validated
-    #                    by the caller against its previous step's logits)
+    draft,  # [D] int sequence/array of draft tokens (draft[0] already
+    #         validated by the caller against its previous step's logits)
     start_pos,  # int, absolute position of draft[0]
     caches: Caches,
     block_table: jax.Array,  # [max_blocks] int32 (padded)
     config: LlamaConfig,
     max_blocks: int,
+    pad_to: int = 0,
 ):
     """Score a whole speculative draft in ONE chunked pass and accept its
     longest greedy-consistent prefix.
@@ -371,19 +372,41 @@ def speculative_verify(
     accepted point are never attended and are overwritten when real tokens
     reach those positions. The caller only rewinds its position counter.
     Cites the reference's cache-semantics stance (SURVEY.md §5.3): wrong
-    speculation costs recompute, never correctness."""
-    d = draft.shape[0]
+    speculation costs recompute, never correctness.
+
+    ``pad_to``: prefill_continue is jitted, so every DISTINCT draft length
+    recompiles. Engines with variable-length drafts pass a fixed
+    ``pad_to`` >= D: the draft is padded (with its last token — the pad
+    rows' K/V land beyond the accepted point and are masked/overwritten
+    like any rejection) and acceptance is computed over the true D only,
+    so one compiled shape serves every round."""
+    draft_host = np.asarray(draft, dtype=np.int32)
+    d = int(draft_host.shape[0])
     if d == 0:
         raise ValueError("speculative_verify needs a non-empty draft")
+    span = pad_to or d
+    if int(start_pos) + span > max_blocks * config.block_tokens:
+        # jnp.take would CLIP out-of-table block indices and silently
+        # overwrite the last block's slots — fail loudly instead.
+        raise ValueError(
+            f"draft span [{int(start_pos)}, {int(start_pos) + span}) exceeds "
+            f"the table's {max_blocks * config.block_tokens}-token capacity"
+        )
+    if pad_to:
+        if pad_to < d:
+            raise ValueError(f"pad_to={pad_to} < draft length {d}")
+        draft_host = np.concatenate(
+            [draft_host, np.full(pad_to - d, draft_host[-1], np.int32)]
+        )
     logits, caches = prefill_continue(
-        params, draft, jnp.int32(start_pos), caches, block_table, config,
-        max_blocks,
+        params, jnp.asarray(draft_host), jnp.int32(start_pos), caches,
+        block_table, config, max_blocks,
     )
-    # One [D]-sized transfer: this runs every speculation round on the
-    # decode hot path, so don't pay three separate device->host syncs.
+    # ONE device->host transfer per round (the [D]-sized argmaxes; the
+    # draft comparison side stays host-resident) — this runs every
+    # speculation round on the decode hot path.
     preds = np.asarray(jnp.argmax(logits, axis=-1))  # preds[i] follows draft[:i+1]
-    draft_host = np.asarray(draft)
-    ok = preds[:-1] == draft_host[1:]  # draft[i+1] consistent with the target?
+    ok = preds[: d - 1] == draft_host[1:d]  # draft[i+1] consistent?
     n_accepted = 1 + int(np.argmin(ok)) if not ok.all() else d
     next_token = int(preds[n_accepted - 1])
     return n_accepted, next_token, caches

@@ -189,6 +189,19 @@ def test_speculative_verify_accepts_greedy_prefix(params):
     )
     assert int(jnp.argmax(logits3)) == greedy[4]
 
+    # pad_to: one compiled shape for variable-length drafts — results equal
+    # the unpadded call, and a span past the table's capacity fails loudly
+    # (jnp.take would otherwise clip and corrupt the last block).
+    _, caches3 = prefill(params, prompt, _fresh_caches(), table[:2], CFG)
+    n3, nxt3, _ = speculative_verify(
+        params, bad, 16, caches3, table, CFG, MAX_BLOCKS, pad_to=12
+    )
+    assert (n3, nxt3) == (n2, nxt2)
+    with pytest.raises(ValueError, match="capacity"):
+        speculative_verify(
+            params, bad, 16, _fresh_caches(), table, CFG, MAX_BLOCKS, pad_to=20
+        )
+
 
 def test_train_step_runs(params):
     tokens = jax.random.randint(jax.random.PRNGKey(4), (4, 32), 0, CFG.vocab)

@@ -136,6 +136,54 @@ def main() -> int:
         assert errb < 3e-2, f"batched decode attention diverged: {errb}"
         assert float(jnp.abs(gotb[2].astype(jnp.float32)).max()) == 0.0, "empty row not zero"
         print(f"5. fused decode attention matches XLA (err {err:.1e}, wave err {errb:.1e})")
+
+        # 6. int8 decode attention: the quantized kernel on THIS backend
+        # against the dequantize-then-float fallback.
+        from infinistore_tpu.tpu.kv_quant import (
+            _quant_decode_xla,
+            paged_decode_attention_quantized,
+            quantize_kv,
+        )
+
+        kq, ksc = quantize_kv(mcaches[0][0])
+        vq, vsc = quantize_kv(mcaches[0][1])
+        gotq = paged_decode_attention_quantized(qb, kq, ksc, vq, vsc, tbls, sls)
+        wantq = _quant_decode_xla(qb, kq, ksc, vq, vsc, tbls, sls)
+        errq = float(
+            jnp.max(jnp.abs(gotq.astype(jnp.float32) - wantq.astype(jnp.float32)))
+        )
+        assert errq < 3e-2, f"quantized decode attention diverged: {errq}"
+        print(f"6. int8 decode attention matches dequantized fallback (err {errq:.1e})")
+
+        # 7. Chunked continuation + speculative verify on this backend: a
+        # perfect greedy draft must fully accept. f32 model: exact argmax
+        # agreement between the chunked and token-by-token paths is only
+        # guaranteed at f32 (a bf16 near-tie can round differently between
+        # the two accumulation orders — the pytest pins f32 for the same
+        # reason).
+        from infinistore_tpu.models import speculative_verify
+
+        f32 = LlamaConfig(
+            vocab=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2,
+            ffn_dim=256, block_tokens=16, dtype=jnp.float32,
+        )
+        fparams = init_params(f32, jax.random.PRNGKey(1))
+        fcaches = f32.kv_spec(32).make_caches()
+        logits0, fcaches = prefill(fparams, prompt, fcaches, table[:1], f32)
+        tok, pos, greedy = int(jnp.argmax(logits0)), 16, []
+        sc = fcaches
+        for _ in range(5):
+            greedy.append(tok)
+            lg, sc = decode_step(
+                fparams, jnp.int32(tok), jnp.int32(pos), sc, table, f32, 4
+            )
+            tok, pos = int(jnp.argmax(lg)), pos + 1
+        n_acc, nxt, _ = speculative_verify(
+            fparams, greedy, 16, fcaches, table, f32, 4
+        )
+        assert n_acc == 5, f"perfect draft should fully accept, got {n_acc}"
+        assert nxt == tok
+        print("7. speculative verify accepts a perfect greedy draft on this backend")
     finally:
         conn.close()
         srv.stop()
