@@ -118,10 +118,11 @@ def test_engine_harness_over_quantizing_adapter(conn):
         vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_dim=128,
         block_tokens=8, dtype=jnp.float32,
     )
-    qc = QuantizedKVConnector(conn, cfg.kv_spec(4), "quant-engine", max_blocks=4)
+    # 4 prompt blocks + 1 generated block per request.
+    qc = QuantizedKVConnector(conn, cfg.kv_spec(5), "quant-engine", max_blocks=5)
     params = init_params(cfg, jax.random.PRNGKey(1))
     h = ContinuousBatchingHarness(
-        QuantizingKVAdapter(qc), params, cfg, num_blocks=16, max_req_blocks=4,
+        QuantizingKVAdapter(qc), params, cfg, num_blocks=16, max_req_blocks=5,
         verify=True, verify_tol=5e-2,
     )
     rng = np.random.default_rng(6)
@@ -133,13 +134,17 @@ def test_engine_harness_over_quantizing_adapter(conn):
     async def drive():
         m1 = await h.run(prompts, concurrency=3)
         h.stats.clear()
-        m2 = await h.run(prompts, concurrency=3)
+        # Second wave also GENERATES: full hits + lockstep decode waves over
+        # dequantized prefixes in one flow.
+        m2 = await h.run(prompts, concurrency=3, gen_tokens=cfg.block_tokens)
         return m1, m2
 
     m1, m2 = asyncio.run(drive())
     assert m1["all_verified"], "first wave (compute + quantized save) diverged"
     assert m2["hit_rate"] == 1.0, "second wave should be served from the store"
     assert m2["all_verified"], "dequantized blocks exceeded the int8 tolerance"
+    assert m2["generated_tokens"] == 3 * cfg.block_tokens
+    assert m2["max_wave_size"] >= 2
 
 
 def test_scales_race_degrades_to_miss(conn):
