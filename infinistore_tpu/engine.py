@@ -140,21 +140,31 @@ class WaveDecoder:
     call (under the device gate's exclusive phase — it mutates the shared
     cache) advances the whole wave and resolves each request's logits.
 
-    Wave sizes vary with load, so the jitted batched step compiles once per
-    distinct B it sees (an engine would pad to fixed buckets; at harness
-    scale the handful of compilations is cheaper than the padding logic).
+    Wave sizes vary with load, but the jitted batched step compiles once per
+    PADDED size, not per size seen: waves are padded to power-of-two buckets
+    by repeating the last real entry. A repeated row scatters the SAME K/V
+    bytes to the same (block, slot) as the row it copies — duplicate-index
+    scatters with identical payloads are value-deterministic, so pad rows
+    cannot corrupt the shared cache — and its logits row is simply never
+    awaited. Steady-state serving therefore compiles ceil(log2(max_wave))+1
+    shapes total, however the wave sizes wander (``bucket_sizes`` records
+    them; the harness test pins the count).
     """
 
     def __init__(self, harness: "ContinuousBatchingHarness"):
         self.h = harness
         self._pending: List[tuple] = []
         self._flush_scheduled = False
-        # Strong reference: the event loop holds only weak refs to tasks, so
-        # a fire-and-forget flush could be GC'd mid-flight and strand every
-        # waiter with _flush_scheduled stuck True.
-        self._flush_task = None
+        # Strong references: the event loop holds only weak refs to tasks,
+        # so a fire-and-forget flush could be GC'd mid-flight and strand
+        # every waiter with _flush_scheduled stuck True. A SET, not a slot:
+        # _flush clears _flush_scheduled before awaiting the gate, so a new
+        # step() can legally start a second flush while the first is still
+        # in flight — a single slot would drop the older task's reference.
+        self._flush_tasks = set()
         self.waves = 0
         self.max_wave = 0
+        self.bucket_sizes = set()  # distinct PADDED batch sizes (= compiles)
 
     async def step(self, token: int, position: int, padded_table) -> jax.Array:
         """Advance this request by one token; returns its logits row."""
@@ -162,7 +172,9 @@ class WaveDecoder:
         self._pending.append((token, position, padded_table, fut))
         if not self._flush_scheduled:
             self._flush_scheduled = True
-            self._flush_task = asyncio.ensure_future(self._flush())
+            task = asyncio.ensure_future(self._flush())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_tasks.discard)
         return await fut
 
     async def _flush(self):
@@ -177,10 +189,16 @@ class WaveDecoder:
             self._flush_scheduled = False
             if not batch:
                 return
+            # Pad to the power-of-two bucket by repeating the last entry
+            # (see class docstring: duplicate rows re-write identical bytes,
+            # so padding is cache-safe); only real rows' futures resolve.
+            bucket = 1 << (len(batch) - 1).bit_length()
+            padded = batch + [batch[-1]] * (bucket - len(batch))
+            self.bucket_sizes.add(bucket)
             async with self.h.gate.exclusive():
-                tokens = jnp.asarray([b[0] for b in batch], jnp.int32)
-                positions = jnp.asarray([b[1] for b in batch], jnp.int32)
-                tables = jnp.stack([b[2] for b in batch])
+                tokens = jnp.asarray([b[0] for b in padded], jnp.int32)
+                positions = jnp.asarray([b[1] for b in padded], jnp.int32)
+                tables = jnp.stack([b[2] for b in padded])
                 logits, self.h.caches = decode_step_batched(
                     self.h.params,
                     tokens,
@@ -209,8 +227,6 @@ class WaveDecoder:
                     fut.set_exception(exc)
             if not isinstance(e, Exception):
                 raise
-        finally:
-            self._flush_task = None
 
 
 class EngineKVAdapter:
@@ -554,6 +570,9 @@ class ContinuousBatchingHarness:
             "max_concurrent_saves": self.max_concurrent_saves,
             "decode_waves": self.wave.waves,
             "max_wave_size": self.wave.max_wave,
+            # Distinct PADDED sizes == jit cache entries for the batched
+            # step (jit keys on shape): the compile-count story.
+            "wave_buckets": sorted(self.wave.bucket_sizes),
             "generated_tokens": sum(
                 len(s.generated) for s in self.stats if s.generated
             ),

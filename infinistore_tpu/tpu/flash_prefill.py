@@ -243,7 +243,19 @@ def flash_prefill_attention(q, k, v, *, causal=True, block_q=256, block_k=256):
     the probabilities to bf16 for the PV pass (one exact-accumulation pass
     per dot — the standard flash-on-TPU choice), so TPU and CPU outputs
     agree at the model dtype's rounding scale, not f32's. Forward-only
-    (use the dense path for differentiable training losses)."""
+    (use the dense path for differentiable training losses).
+
+    ``causal=True`` masks by GLOBAL position assuming q and k both start at
+    position 0, so it requires S == T; a suffix chunk attending a longer
+    context (S < T with q offset T-S) would be silently over-masked —
+    rejected loudly instead (use prefill_continue's explicit-offset path
+    for chunked continuation)."""
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"causal=True assumes q and k start at position 0, so S must "
+            f"equal T (got S={q.shape[1]}, T={k.shape[1]}); offset suffix "
+            "chunks would be over-masked"
+        )
     if _use_pallas():
         return _flash_prefill_pallas(
             q, k, v, causal=causal, block_q=block_q, block_k=block_k,

@@ -232,6 +232,43 @@ def test_multi_turn_conversation_hits_generated_blocks(conn, params):
     assert s2.verified
 
 
+def test_wave_sizes_bucket_to_powers_of_two(conn, params, monkeypatch):
+    """Varied wave sizes must reach the jitted batched step only at
+    power-of-two PADDED sizes (jit keys its cache on shape, so distinct
+    shapes == compiles): a run whose natural wave sizes wander over
+    1..5 compiles at most the 1/2/4/8 buckets, and padding rows must not
+    perturb any request's output (all verified)."""
+    import infinistore_tpu.engine as engine_mod
+
+    shapes_seen = set()
+    real = engine_mod.decode_step_batched
+
+    def recording(params_, tokens, *a, **kw):
+        shapes_seen.add(int(tokens.shape[0]))
+        return real(params_, tokens, *a, **kw)
+
+    monkeypatch.setattr(engine_mod, "decode_step_batched", recording)
+
+    async def drive():
+        h = _harness(conn, params, "engine-buckets")
+        # 5 requests, staggered admission via concurrency 5 but different
+        # prompt lengths -> wave sizes vary as requests finish prefill at
+        # different times and drain at different steps.
+        prompts = _prompts(5, shared_blocks=1, total_blocks=2, seed=29)
+        return await h.run(prompts, concurrency=5, gen_tokens=6)
+
+    m = asyncio.run(drive())
+    assert m["all_verified"], "padding rows corrupted a request's blocks"
+    assert m["generated_tokens"] == 5 * 6
+    assert shapes_seen, "no waves decoded"
+    for b in shapes_seen:
+        assert b & (b - 1) == 0, f"non-power-of-two batched-step shape {b}"
+    # Compile count is bounded by the bucket ladder, not by how many
+    # distinct natural sizes occurred.
+    assert shapes_seen == set(m["wave_buckets"])
+    assert len(shapes_seen) <= 4
+
+
 def test_wave_decoder_failure_fails_all_waiters(params):
     """A flush that dies (model error) must fail every waiter — taken batch
     AND still-pending — and leave the decoder usable for the next wave, not

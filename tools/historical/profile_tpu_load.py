@@ -17,13 +17,13 @@ The configs isolate each axis:
   e. reader-shaped: dispatch k,v + scatter per layer, barrier on out[l-R]
 
 Run on the real chip (no JAX_PLATFORMS override), from the repo root:
-    python tools/profile_tpu_load.py
+    python tools/historical/profile_tpu_load.py
 """
 import os
 import sys
 import time
 
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 import jax
 import jax.numpy as jnp
