@@ -51,6 +51,14 @@ def __getattr__(name):
         from . import cluster
 
         return getattr(cluster, name)
+    if name in (
+        "InfiniStoreKVConnectorV1",
+        "KVConnectorRole",
+        "KVConnectorMetadata",
+    ):
+        from . import vllm_v1
+
+        return getattr(vllm_v1, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
@@ -62,6 +70,9 @@ __all__ = [
     "EngineKVAdapter",
     "ContinuousBatchingHarness",
     "BlockPool",
+    "InfiniStoreKVConnectorV1",
+    "KVConnectorRole",
+    "KVConnectorMetadata",
     "InfinityConnection",
     "StripedConnection",
     "register_server",

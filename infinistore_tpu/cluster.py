@@ -134,12 +134,18 @@ class ClusterKVConnector:
             self._absorb(e)
             return 0
 
-    async def load(self, token_ids, caches, block_ids: np.ndarray):
+    async def load(
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
+        on_layer=None,
+    ):
         member = self._owner(token_ids)
         if member is None:
             return list(caches), 0
         try:
-            return await member.load(token_ids, caches, block_ids)
+            return await member.load(
+                token_ids, caches, block_ids, first_block=first_block,
+                on_layer=on_layer,
+            )
         except PartialReadError as e:
             # The member died mid-read AFTER some layers' scatters donated
             # their input buffers: the partial list is the only live one.
@@ -162,6 +168,34 @@ class ClusterKVConnector:
         except InfiniStoreException as e:
             self._absorb(e)
             return 0
+
+    def stage_layer_save(
+        self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
+        first_block: int = 0,
+    ):
+        """Layer-granular save, routed: the whole request's blocks share a
+        chain root, so every layer's put lands on the SAME owner — routing
+        composes with layer-by-layer streaming for free. The returned
+        ``ship`` applies the cluster's failure policy (degrade mode turns a
+        dead owner into 0 blocks written)."""
+        member = self._owner(token_ids)
+        if member is None:
+            async def noop() -> int:
+                return 0
+
+            return noop
+        ship = member.stage_layer_save(
+            token_ids, layer, kv_pair, block_ids, first_block=first_block
+        )
+
+        async def routed() -> int:
+            try:
+                return await ship()
+            except InfiniStoreException as e:
+                self._absorb(e)
+                return 0
+
+        return routed
 
     def drop(self, token_ids) -> int:
         member = self._owner(token_ids)

@@ -16,6 +16,7 @@ to the *entire prefix*, so two prompts share keys exactly for their common
 block-aligned prefix — and the store's binary-search prefix match applies.
 """
 
+import asyncio
 import hashlib
 from typing import List, Optional, Sequence, Tuple
 
@@ -171,26 +172,44 @@ class KVConnector:
             caches, np.asarray(block_ids[:n]), self._key_fn(chains)
         )
 
-    async def load(self, token_ids, caches, block_ids: np.ndarray):
+    async def load(
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
+        on_layer=None,
+    ):
         """Fetch this prompt's cached prefix into the engine's paged cache.
 
-        Fetches ``lookup(tokens)`` blocks (capped by len(block_ids)) and
-        scatters them; returns (updated caches, blocks_loaded).
+        Fetches up to ``lookup(tokens) - first_block`` blocks (capped by
+        len(block_ids)) and scatters them; returns (updated caches,
+        blocks_loaded). ``first_block`` skips a prefix the engine already
+        holds (its own prefix cache / computed tokens): ``block_ids[i]``
+        then receives logical block ``first_block + i`` — symmetric with
+        ``save``'s ``first_block``.
 
         DONATION: the input ``caches`` are consumed (scatter_blocks donates
         the cache buffer on TPU so the update is in-place in HBM). Use the
         returned caches; do not touch the inputs again — on a real chip they
         are deleted buffers after this call.
+
+        ``on_layer(layer, (k, v))``: optional per-layer progress hook
+        (layers complete in order — see LayerwiseKVReader.read), the seam
+        the vLLM-v1 worker's ``wait_for_layer_load`` gates on.
         """
         self._require_store("load")
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if first_block < 0 or first_block > len(chains):
+            raise ValueError(
+                f"first_block={first_block} outside the prompt's "
+                f"{len(chains)} complete blocks"
+            )
         hit = self._lookup_chains(chains)
-        n = min(hit, len(block_ids))
-        if n == 0:
+        n = min(hit - first_block, len(block_ids))
+        if n <= 0:
             return list(caches), 0
+        span = chains[first_block : first_block + n]
         try:
             out = await self._reader.read(
-                caches, np.asarray(block_ids[:n]), self._key_fn(chains[:n])
+                caches, np.asarray(block_ids[:n]), self._key_fn(span),
+                on_layer=on_layer,
             )
         except PartialReadError as e:
             # e.caches, not the original list: layers scattered before the
@@ -207,6 +226,65 @@ class KVConnector:
                 return e.caches, 0
             raise
         return out, n
+
+    def stage_layer_save(
+        self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
+        first_block: int = 0,
+    ):
+        """Stage ONE layer's computed blocks for saving; returns ``ship``,
+        an async callable performing the network puts (2*n blocks written).
+
+        The gather + async D2H start NOW, on the caller's thread — the
+        bytes are snapshotted before later compute (or the next step) can
+        perturb the cache — while ``ship()`` does only awaits (the D2H
+        wait runs in an executor so it never stalls the caller's event
+        loop). This is the layer-granular half of ``save()`` for engines
+        that stream saves as each layer's forward completes (the vLLM v1
+        worker, vllm_v1.py): such callers MUST ship layer 0 last — its
+        keys are the whole-block presence sentinel (``lookup``), so
+        shipping it before deeper layers commit would publish a half-saved
+        block. Whole-request saves should use ``save()``, whose writer
+        enforces that ordering internally."""
+        self._require_store("stage_layer_save")
+        import jax.numpy as jnp
+
+        from .tpu.paged import gather_blocks
+
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)[first_block:]
+        n = min(len(chains), len(block_ids))
+        if n == 0:
+            async def noop() -> int:
+                return 0
+
+            return noop
+        k_cache, v_cache = kv_pair
+        bn = self.spec.block_nbytes
+        ids_dev = jnp.asarray(np.asarray(block_ids[:n]), dtype=jnp.int32)
+        # One packed [K blocks | V blocks] span -> one D2H transfer (the
+        # writer's shape, tpu/layerwise.py).
+        tr = self.pool.stage_out([
+            jnp.concatenate([
+                gather_blocks(k_cache, ids_dev),
+                gather_blocks(v_cache, ids_dev),
+            ])
+        ])
+        keys_k = [(self.block_key(layer, "k", chains[i]), i * bn) for i in range(n)]
+        keys_v = [(self.block_key(layer, "v", chains[i]), (n + i) * bn) for i in range(n)]
+
+        async def ship() -> int:
+            loop = asyncio.get_running_loop()
+            (kv_host,) = await loop.run_in_executor(None, tr.wait)
+            base = kv_host.ctypes.data
+            try:
+                await asyncio.gather(
+                    self.conn.write_cache_async(keys_k, bn, base),
+                    self.conn.write_cache_async(keys_v, bn, base),
+                )
+            finally:
+                tr.release()
+            return 2 * n
+
+        return ship
 
     async def handoff(
         self,

@@ -214,13 +214,22 @@ class QuantizedKVConnector:
             token_ids, data_caches, block_ids, first_block=first_block
         )
 
-    async def load(self, token_ids, quant_caches, block_ids):
+    async def load(
+        self, token_ids, quant_caches, block_ids, first_block: int = 0,
+        on_layer=None,
+    ):
         """Fetch the cached prefix into (data, scales) caches. Returns
         (updated quant_caches, blocks_loaded); a scales race degrades to a
         miss. Data/scale caches are donated — use the returned ones. A
         transport error mid-read re-raises PartialReadError whose
         ``caches`` carry the ZIPPED quantized structure (the donated-buffer
-        contract the base connector has, tpu/layerwise.py)."""
+        contract the base connector has, tpu/layerwise.py).
+
+        ``first_block``/``on_layer``: same contract as KVConnector.load.
+        A quantized layer is usable only once BOTH its data and scales
+        landed, so the hook fires during the scales pass (the data pass
+        completed first) with the zipped ((k_int8, k_scales), (v_int8,
+        v_scales)) pair."""
         from .layerwise import PartialReadError
 
         data_caches = [(kq, vq) for (kq, _), (vq, _) in quant_caches]
@@ -228,16 +237,27 @@ class QuantizedKVConnector:
             (ks[..., None], vs[..., None]) for (_, ks), (_, vs) in quant_caches
         ]
         try:
-            data_out, n = await self.data.load(token_ids, data_caches, block_ids)
+            data_out, n = await self.data.load(
+                token_ids, data_caches, block_ids, first_block=first_block
+            )
         except PartialReadError as e:
             raise PartialReadError(
                 self._zip(e.caches, scale_caches), e.cause
             ) from e.cause
         if n == 0:
             return self._zip(data_out, scale_caches), 0
+
+        def scale_hook(layer, pair):
+            (ks, vs) = pair
+            on_layer(
+                layer,
+                ((data_out[layer][0], ks[..., 0]), (data_out[layer][1], vs[..., 0])),
+            )
+
         try:
             scale_out, ns = await self.scales.load(
-                token_ids, scale_caches, block_ids
+                token_ids, scale_caches, block_ids, first_block=first_block,
+                on_layer=scale_hook if on_layer is not None else None,
             )
         except PartialReadError as e:
             # The already-donated data caches must travel with the error or
@@ -250,6 +270,30 @@ class QuantizedKVConnector:
             # useless — report a miss (cache semantics; engine recomputes).
             return self._zip(data_out, scale_out), 0
         return self._zip(data_out, scale_out), n
+
+    def stage_layer_save(
+        self, token_ids, layer: int, kv_pair, block_ids, first_block: int = 0
+    ):
+        """Layer-granular save (KVConnector.stage_layer_save contract) for
+        a quantized layer ``((k_int8, k_scales), (v_int8, v_scales))``.
+        The returned ship puts scales BEFORE data, preserving the commit
+        order the class relies on; layer-by-layer callers (vllm_v1) defer
+        layer 0's ship to last, so the data sentinel still commits after
+        everything — scales layers 1+, data layers 1+, scales 0, data 0."""
+        (kq, ks), (vq, vs) = kv_pair
+        ship_scales = self.scales.stage_layer_save(
+            token_ids, layer, (ks[..., None], vs[..., None]), block_ids,
+            first_block=first_block,
+        )
+        ship_data = self.data.stage_layer_save(
+            token_ids, layer, (kq, vq), block_ids, first_block=first_block
+        )
+
+        async def ship() -> int:
+            await ship_scales()
+            return await ship_data()
+
+        return ship
 
     @staticmethod
     def _zip(data_caches, scale_caches):

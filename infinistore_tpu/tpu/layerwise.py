@@ -238,8 +238,16 @@ class LayerwiseKVReader:
         caches: Sequence[Tuple[jax.Array, jax.Array]],
         block_ids: np.ndarray,
         key_fn: KeyFn,
+        on_layer=None,
     ) -> List[Tuple[jax.Array, jax.Array]]:
-        """Returns the updated per-layer (K, V) cache list."""
+        """Returns the updated per-layer (K, V) cache list.
+
+        ``on_layer(layer, (k, v))``: optional hook invoked as each layer's
+        scatter is ISSUED (layers complete in order 0..L-1) with that
+        layer's updated cache arrays — the seam a layer-by-layer engine
+        contract (vllm_v1.wait_for_layer_load) gates on. The arrays are
+        dispatched, not necessarily materialized; callers that hand them to
+        compute get correct results via jax's program order."""
         n = len(block_ids)
         num_layers = len(caches)
         if n == 0:
@@ -310,6 +318,8 @@ class LayerwiseKVReader:
                     scatter_blocks(k_cache, ids_dev, kv_dev[:n]),
                     scatter_blocks(v_cache, ids_dev, kv_dev[n:]),
                 )
+                if on_layer is not None:
+                    on_layer(layer, out[layer])
                 start(layer + W)
         except Exception as exc:
             # Already-scattered layers donated their input buffers; the

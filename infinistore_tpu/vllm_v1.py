@@ -1,0 +1,544 @@
+"""vLLM v1 ``KVConnectorBase_V1`` implementation over the InfiniStore-TPU store.
+
+The reference's entire reason to exist is serving vLLM through LMCache
+(reference README.md:22, docs/source/design.rst:33-37). vLLM v1 made that
+seam a first-class plugin: ``KVConnectorBase_V1``
+(vllm/distributed/kv_transfer/kv_connector/v1/base.py) with a scheduler-side
+half that decides WHAT to transfer and a worker-side half that moves bytes
+during the forward pass, connected by an opaque metadata object the scheduler
+builds each step and the runner binds before the model runs. This module
+implements that published contract — same method names, signatures, call
+order, and role split — so attaching this store to a vLLM-TPU engine is
+``--kv-connector InfiniStoreKVConnectorV1`` configuration, not engine code.
+
+Published call order (the contract tests in tests/test_vllm_v1.py drive
+exactly this):
+
+  scheduler, per request:  get_num_new_matched_tokens -> (engine allocates)
+                           -> update_state_after_alloc
+  scheduler, per step:     build_connector_meta  (ships to the worker)
+  worker, per step:        bind_connector_metadata -> start_load_kv
+                           -> [per layer: wait_for_layer_load BEFORE the
+                               layer's attention reads the cache;
+                               save_kv_layer AFTER the layer's KV insert]
+                           -> wait_for_save -> clear_connector_metadata
+  scheduler, at finish:    request_finished
+
+Two deliberate TPU-native adaptations, both documented on the methods:
+
+- **Functional caches.** vLLM's torch connectors mutate the worker's paged
+  KV tensors in place; jax arrays are immutable and our scatters DONATE
+  their inputs (tpu/paged.py). The worker half therefore owns the
+  authoritative per-layer cache references between ``register_kv_caches``
+  and the end of the step: loads swap refs layer by layer, and the engine
+  reads the current arrays with ``kv_cache(layer_name)`` after each
+  ``wait_for_layer_load`` — the functional spelling of "the tensor the
+  engine handed us got filled".
+
+- **Sentinel-honoring layer-wise save.** ``save_kv_layer`` streams each
+  layer out as its forward completes (the reference's layer-wise overlap,
+  design.rst:54-63) — except layer 0, whose store keys are the
+  whole-block presence sentinel (connector.py lookup): its bytes are
+  staged immediately but its PUT is deferred to ``wait_for_save``, after
+  every deeper layer committed. A concurrent lookup therefore never sees
+  a half-saved block as a hit.
+
+The scheduler and worker halves are separate instances (vLLM runs them in
+separate processes); each builds its own store connection. Loads run on a
+private background event loop owned by the worker half — the store's
+asyncio ops bind to the loop that awaits them (lib.py), and vLLM's runner
+calls are synchronous.
+"""
+
+import asyncio
+import enum
+import threading
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from .connector import KVConnector  # noqa: F401 - the canonical surface
+
+
+class KVConnectorRole(enum.Enum):
+    """Which half of the engine this instance serves (published enum)."""
+
+    SCHEDULER = 0
+    WORKER = 1
+
+
+class KVConnectorMetadata:
+    """Opaque scheduler->worker payload (published base: an empty marker
+    class; concrete connectors subclass it)."""
+
+
+@dataclass
+class _LoadSpec:
+    """One request's prefix load, decided scheduler-side."""
+
+    req_id: str
+    token_ids: List[int]
+    block_ids: np.ndarray  # engine physical blocks for the loaded span
+    num_tokens: int  # external tokens to load (block-aligned)
+    first_block: int  # logical block where the external span starts
+
+
+@dataclass
+class _SaveSpec:
+    """One request's computed-suffix save."""
+
+    req_id: str
+    token_ids: List[int]
+    block_ids: np.ndarray  # physical blocks holding the computed suffix
+    first_block: int  # logical index of block_ids[0] within the prompt
+
+
+@dataclass
+class InfiniStoreConnectorMetadata(KVConnectorMetadata):
+    """Per-step transfer plan: built by the scheduler half, consumed by the
+    worker half."""
+
+    loads: List[_LoadSpec] = field(default_factory=list)
+    saves: List[_SaveSpec] = field(default_factory=list)
+
+
+class KVConnectorBase_V1(ABC):
+    """The published vLLM v1 connector contract, mirrored method-for-method
+    (vllm/distributed/kv_transfer/kv_connector/v1/base.py). vLLM is not a
+    dependency of this package, so the ABC is restated here; the signatures
+    and the scheduler/worker role split are the published ones — a vLLM tree
+    can subclass its own base instead and reuse ``InfiniStoreKVConnectorV1``
+    unchanged."""
+
+    def __init__(self, vllm_config, role: "KVConnectorRole"):
+        self._connector_metadata: Optional[KVConnectorMetadata] = None
+        self.role = role
+
+    # -- worker-side ---------------------------------------------------------
+
+    def bind_connector_metadata(self, connector_metadata: KVConnectorMetadata):
+        """Runner installs this step's metadata before the forward pass."""
+        self._connector_metadata = connector_metadata
+        self._reset_step_state()
+
+    def _reset_step_state(self):
+        """Hook for subclasses with per-step worker state (overridden by
+        the concrete connector; the base has none)."""
+
+    def clear_connector_metadata(self):
+        """Runner clears it after the step."""
+        self._connector_metadata = None
+
+    @abstractmethod
+    def start_load_kv(self, forward_context, **kwargs) -> None:
+        """Begin loading external KV for the bound metadata's requests."""
+
+    @abstractmethod
+    def wait_for_layer_load(self, layer_name: str) -> None:
+        """Block until ``layer_name``'s load landed (called before that
+        layer's attention)."""
+
+    @abstractmethod
+    def save_kv_layer(self, layer_name: str, kv_layer, attn_metadata, **kwargs) -> None:
+        """Start saving ``layer_name`` (called after that layer's forward)."""
+
+    @abstractmethod
+    def wait_for_save(self) -> None:
+        """Block until every save issued this step is durable."""
+
+    def get_finished(self, finished_req_ids) -> Tuple[Optional[set], Optional[set]]:
+        """(sending-finished, recving-finished) request ids for ASYNC
+        transfer connectors. Ours completes synchronously within the step
+        (wait_for_save / wait_for_layer_load), so there is never a deferred
+        set: (None, None) — the published 'nothing outstanding' answer."""
+        return None, None
+
+    # -- scheduler-side ------------------------------------------------------
+
+    @abstractmethod
+    def get_num_new_matched_tokens(
+        self, request, num_computed_tokens: int
+    ) -> Tuple[int, bool]:
+        """(tokens available externally BEYOND num_computed_tokens,
+        load_is_async)."""
+
+    @abstractmethod
+    def update_state_after_alloc(self, request, blocks, num_external_tokens: int):
+        """Engine allocated blocks for the promised external tokens."""
+
+    @abstractmethod
+    def build_connector_meta(self, scheduler_output) -> KVConnectorMetadata:
+        """Assemble this step's metadata and RESET per-step scheduler state."""
+
+    def request_finished(self, request, block_ids) -> Tuple[bool, Optional[dict]]:
+        """Request left the engine. Returns (delay_block_free, transfer
+        params for the response). Saves here are synchronous within the
+        step, so blocks never need delayed freeing."""
+        return False, None
+
+
+def _block_ids_of(blocks) -> np.ndarray:
+    """Accept vLLM's KVCacheBlocks (``get_block_ids()`` -> [[ids]]), its
+    per-group nested lists ([[ids]], one entry per KV cache group — we
+    serve group 0, the standard full-attention group), or a plain id
+    sequence."""
+    if hasattr(blocks, "get_block_ids"):
+        return np.asarray(blocks.get_block_ids()[0], dtype=np.int32)
+    seq = list(blocks)
+    if seq and isinstance(seq[0], (list, tuple, np.ndarray)):
+        seq = list(seq[0])
+    return np.asarray(seq, dtype=np.int32)
+
+
+class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
+    """The store's vLLM v1 connector.
+
+    ``vllm_config`` duck-types vLLM's config object: the connector reads
+    ``vllm_config.kv_transfer_config.kv_connector_extra_config`` (falling
+    back to ``vllm_config`` itself being that dict) and expects one key,
+    ``"kv_connector"``: a built :class:`~infinistore_tpu.connector.KVConnector`
+    binding the model's cache spec to a store connection. Each role builds
+    its own (scheduler and worker live in different processes in vLLM).
+    """
+
+    def __init__(self, vllm_config, role: KVConnectorRole):
+        super().__init__(vllm_config, role)
+        extra = vllm_config
+        ktc = getattr(vllm_config, "kv_transfer_config", None)
+        if ktc is not None:
+            extra = getattr(ktc, "kv_connector_extra_config", ktc)
+        if isinstance(extra, dict):
+            kv = extra.get("kv_connector")
+        else:
+            kv = getattr(extra, "kv_connector", None)
+        # Duck-typed, not isinstance: ClusterKVConnector (cluster.py) and
+        # any KVConnector-shaped member expose the same surface, so a
+        # pooled store drops in here with no engine-side change.
+        needed = ("spec", "lookup", "load", "stage_layer_save")
+        missing = [a for a in needed if not hasattr(kv, a)]
+        if missing:
+            raise ValueError(
+                "kv_connector_extra_config['kv_connector'] must expose the "
+                f"KVConnector surface ({', '.join(needed)}); "
+                f"{type(kv).__name__} lacks {', '.join(missing)}"
+            )
+        self.kv = kv
+        self.block_tokens = kv.spec.block_tokens
+        # scheduler-side per-step state
+        self._pending_loads: Dict[str, _LoadSpec] = {}
+        self._probed_tokens: Dict[str, int] = {}  # req -> engine-computed blocks
+        self._store_hits: Dict[str, int] = {}  # req -> store's hit blocks
+        # worker-side state
+        self._layer_names: List[str] = []
+        self._layer_index: Dict[str, int] = {}
+        self._kv_caches: List[Tuple[jax.Array, jax.Array]] = []
+        self._kv_lock = threading.Lock()
+        self._load_done: List[threading.Event] = []
+        self._load_error: Optional[BaseException] = None
+        self._loaded_tokens: Dict[str, int] = {}
+        self._save_futures: list = []
+        self._deferred_sentinels: list = []
+        self._load_future = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._loop_thread: Optional[threading.Thread] = None
+
+    # ======================================================================
+    # scheduler side
+    # ======================================================================
+
+    def get_num_new_matched_tokens(self, request, num_computed_tokens: int):
+        """Probe the store for this prompt's longest cached prefix (one
+        control round trip — connector.py lookup) and promise the tokens
+        the engine does NOT already have locally. Block-aligned both ways:
+        ``num_computed_tokens`` is floored to the block grid before
+        subtracting, so a partially-computed block never double-counts.
+        The promise is capped so AT LEAST ONE prompt token remains for the
+        engine to compute — vLLM's scheduler requires a non-empty local
+        step per request (the same cap every published connector applies);
+        the cap drops whole blocks, keeping loads block-granular.
+        Returns (count, False): loads complete inside the step via
+        wait_for_layer_load, never asynchronously across steps."""
+        hit_blocks = self.kv.lookup(request.prompt_token_ids)
+        computed_blocks = num_computed_tokens // self.block_tokens
+        external = max(0, (hit_blocks - computed_blocks) * self.block_tokens)
+        cap = len(request.prompt_token_ids) - num_computed_tokens - 1
+        if external > cap:
+            external = max(0, (cap // self.block_tokens) * self.block_tokens)
+        self._probed_tokens[request.request_id] = computed_blocks
+        self._store_hits[request.request_id] = hit_blocks
+        return external, False
+
+    def update_state_after_alloc(self, request, blocks, num_external_tokens: int):
+        """Record the engine's physical placement for the promised tokens.
+        ``blocks`` covers the whole request; the external span occupies the
+        entries just after the engine's locally-computed prefix, so the
+        load targets ``blocks[computed : computed + external]`` and fetches
+        exactly the chain span it promised (KVConnector.load first_block)."""
+        if num_external_tokens <= 0:
+            return
+        ids = _block_ids_of(blocks)
+        skip = self._probed_tokens.get(request.request_id, 0)
+        n_blocks = num_external_tokens // self.block_tokens
+        self._pending_loads[request.request_id] = _LoadSpec(
+            req_id=request.request_id,
+            token_ids=list(request.prompt_token_ids),
+            block_ids=ids[skip : skip + n_blocks],
+            num_tokens=n_blocks * self.block_tokens,
+            first_block=skip,
+        )
+
+    def build_connector_meta(self, scheduler_output) -> InfiniStoreConnectorMetadata:
+        """Assemble this step's plan: the loads recorded since the last
+        build, plus a save of every newly scheduled request's computed
+        suffix (the loaded prefix is already stored — re-saving it would
+        double write traffic on every hit). Scheduler state resets here:
+        metadata is rebuilt from scratch each step (the published
+        contract's lifecycle)."""
+        meta = InfiniStoreConnectorMetadata(loads=list(self._pending_loads.values()))
+        # Chunked prefill: scheduler_output.num_scheduled_tokens (vLLM's
+        # per-request dict) bounds what this step actually computes; only
+        # blocks COMPLETE by end of step may be saved — committing an
+        # unscheduled block would publish garbage under a valid chain key.
+        # Absent the attribute, the whole prompt runs this step.
+        num_sched = getattr(scheduler_output, "num_scheduled_tokens", None) or {}
+        for req in getattr(scheduler_output, "scheduled_new_reqs", []):
+            rid = req.req_id
+            ids = _block_ids_of(req.block_ids)
+            end_tokens = len(req.prompt_token_ids)
+            if rid in num_sched:
+                end_tokens = min(
+                    end_tokens, req.num_computed_tokens + num_sched[rid]
+                )
+            end_blocks = end_tokens // self.block_tokens
+            # Everything the store already holds — the probed hit prefix —
+            # is skipped; blocks the engine computed LOCALLY beyond the
+            # store's hit (its own prefix cache outran the store) are saved
+            # too, or the store could never learn them.
+            in_store = min(self._store_hits.get(rid, 0), end_blocks)
+            if end_blocks > in_store:
+                meta.saves.append(
+                    _SaveSpec(
+                        req_id=rid,
+                        token_ids=list(req.prompt_token_ids),
+                        block_ids=ids[in_store:end_blocks],
+                        first_block=in_store,
+                    )
+                )
+        self._pending_loads.clear()
+        self._probed_tokens.clear()
+        self._store_hits.clear()
+        return meta
+
+    # ======================================================================
+    # worker side
+    # ======================================================================
+
+    def register_kv_caches(self, kv_caches: Dict[str, Tuple[jax.Array, jax.Array]]):
+        """Install the engine's paged caches, one (K, V) pair per layer, in
+        FORWARD ORDER (dict order = layer order, as vLLM's runner builds
+        it). The connector holds the authoritative refs from here on —
+        jax's functional updates mean loads produce NEW arrays; read the
+        current ones back with ``kv_cache``/``kv_caches``."""
+        self._layer_names = list(kv_caches.keys())
+        self._layer_index = {n: i for i, n in enumerate(self._layer_names)}
+        self._kv_caches = [kv_caches[n] for n in self._layer_names]
+
+    def kv_cache(self, layer_name: str) -> Tuple[jax.Array, jax.Array]:
+        """Current (K, V) arrays for a layer — call after
+        ``wait_for_layer_load`` to get the load's output (TPU-functional
+        reading of vLLM's in-place tensor fill)."""
+        with self._kv_lock:
+            return self._kv_caches[self._layer_index[layer_name]]
+
+    def kv_caches(self) -> List[Tuple[jax.Array, jax.Array]]:
+        """Current per-layer cache list (forward order)."""
+        with self._kv_lock:
+            return list(self._kv_caches)
+
+    def loaded_tokens(self, req_id: str) -> int:
+        """Tokens actually delivered for a request this step (== the
+        promise unless a store-side eviction raced the load; cache
+        semantics — the engine recomputes the difference)."""
+        return self._loaded_tokens.get(req_id, 0)
+
+    def _reset_step_state(self):
+        """A step aborted mid-forward (load error, engine preemption) must
+        not leak its staged saves into the next step: a stale layer-0
+        sentinel shipping later would publish presence for blocks whose
+        deeper layers never committed — a poisoned prefix every consumer
+        would hit. Dropping the sentinels keeps the aborted step invisible
+        (deeper-layer puts that already landed are unreachable without the
+        sentinel, and get overwritten on the retry)."""
+        self._deferred_sentinels = []
+        self._save_futures = []
+
+    def _ensure_loop(self) -> asyncio.AbstractEventLoop:
+        if self._loop is None:
+            loop = asyncio.new_event_loop()
+
+            def run():
+                asyncio.set_event_loop(loop)
+                loop.run_forever()
+
+            t = threading.Thread(target=run, name="infinistore-v1-io", daemon=True)
+            t.start()
+            self._loop, self._loop_thread = loop, t
+        return self._loop
+
+    def start_load_kv(self, forward_context, **kwargs) -> None:
+        """Kick off this step's loads on the background I/O loop.
+
+        Requests load SEQUENTIALLY (each load donates and replaces the
+        shared cache arrays — two concurrent loads would scatter into
+        deleted buffers; the engine-harness DeviceGate exists for the same
+        reason), but each request's layers pipeline internally
+        (LayerwiseKVReader overlaps fetch/H2D/scatter). Per-layer progress
+        feeds ``wait_for_layer_load``: layer L's event fires once EVERY
+        request's layer L landed."""
+        meta = self._connector_metadata
+        if not isinstance(meta, InfiniStoreConnectorMetadata):
+            raise RuntimeError(
+                "start_load_kv before bind_connector_metadata (the runner "
+                "must bind this step's metadata first)"
+            )
+        num_layers = len(self._kv_caches)
+        if num_layers == 0:
+            raise RuntimeError("register_kv_caches was never called")
+        self._load_error = None
+        self._loaded_tokens = {}
+        self._load_future = None
+        self._load_done = [threading.Event() for _ in range(num_layers)]
+        loads = list(meta.loads)
+        if not loads:
+            for ev in self._load_done:
+                ev.set()
+            return
+        remaining = [len(loads)] * num_layers
+
+        async def run_loads():
+            try:
+                for spec in loads:
+                    # Per-layer installs happen ONLY here: the runner
+                    # thread may concurrently install computed layers via
+                    # save_kv_layer, and a wholesale post-load assignment
+                    # would clobber them with the load-time snapshot. The
+                    # runner's own ordering (wait_for_layer_load(L) before
+                    # computing/saving L) keeps per-layer install order
+                    # consistent.
+                    fired = set()
+
+                    def on_layer(layer, kv, fired=fired):
+                        fired.add(layer)
+                        with self._kv_lock:
+                            self._kv_caches[layer] = kv
+                        remaining[layer] -= 1
+                        if remaining[layer] == 0:
+                            self._load_done[layer].set()
+
+                    with self._kv_lock:
+                        caches = list(self._kv_caches)
+                    _out, loaded = await self.kv.load(
+                        spec.token_ids,
+                        caches,
+                        spec.block_ids,
+                        first_block=spec.first_block,
+                        on_layer=on_layer,
+                    )
+                    self._loaded_tokens[spec.req_id] = loaded * self.block_tokens
+                    # Settle layers on_layer never reached for THIS spec
+                    # (no read at all, or a partial read that failed after
+                    # some layers) — decrementing all layers again would
+                    # release waits while a later spec's load is still
+                    # scattering into the same arrays.
+                    for layer in range(num_layers):
+                        if layer not in fired:
+                            remaining[layer] -= 1
+                            if remaining[layer] == 0:
+                                self._load_done[layer].set()
+            except BaseException as e:  # noqa: BLE001 - surfaced by waits
+                self._load_error = e
+            finally:
+                for ev in self._load_done:
+                    ev.set()
+
+        self._load_future = asyncio.run_coroutine_threadsafe(
+            run_loads(), self._ensure_loop()
+        )
+
+    def wait_for_layer_load(self, layer_name: str) -> None:
+        """Block until every bound load delivered ``layer_name``. The
+        runner calls this immediately before the layer's attention; layers
+        complete in forward order, so by construction the wait for layer L
+        overlaps the network/H2D work of layers > L (the reference's
+        layer-wise streaming contract, design.rst:54-63)."""
+        self._load_done[self._layer_index[layer_name]].wait()
+        if self._load_error is not None:
+            raise RuntimeError(
+                f"KV load failed before {layer_name!r}"
+            ) from self._load_error
+
+    def save_kv_layer(self, layer_name: str, kv_layer, attn_metadata, **kwargs) -> None:
+        """Stream one layer's computed blocks to the store, overlapping the
+        remaining layers' forward. ``kv_layer`` is the layer's (K, V) pair
+        AFTER its KV insert (pass None to use the connector's current ref).
+        Layer 0's bytes are gathered and staged NOW but its put is deferred
+        to ``wait_for_save`` — layer-0 keys are the whole-block presence
+        sentinel and must commit last (connector.py lookup)."""
+        meta = self._connector_metadata
+        if not isinstance(meta, InfiniStoreConnectorMetadata):
+            raise RuntimeError("save_kv_layer before bind_connector_metadata")
+        layer = self._layer_index[layer_name]
+        if kv_layer is None:
+            kv_layer = self.kv_cache(layer_name)
+        with self._kv_lock:
+            self._kv_caches[layer] = tuple(kv_layer)
+        loop = self._ensure_loop()
+        for spec in meta.saves:
+            # Gather + D2H start here (runner thread) so later compute
+            # cannot perturb the shipped bytes; the network put is a pure-
+            # await callable (KVConnector.stage_layer_save — also the seam
+            # where ClusterKVConnector routes by chain root).
+            ship = self.kv.stage_layer_save(
+                spec.token_ids, layer, kv_layer, spec.block_ids,
+                first_block=spec.first_block,
+            )
+            if layer == 0:
+                self._deferred_sentinels.append(ship)
+            else:
+                self._save_futures.append(
+                    asyncio.run_coroutine_threadsafe(ship(), loop)
+                )
+
+    def wait_for_save(self) -> None:
+        """Drain every non-sentinel save, then ship the deferred layer-0
+        sentinel puts and drain those — after this returns, every block
+        saved this step is durably visible, and only then does its
+        presence sentinel exist. Also joins the step's LOAD pipeline:
+        per-layer waits return at each layer's scatter, so the end-of-step
+        accounting (``loaded_tokens``) settles here."""
+        if self._load_future is not None:
+            self._load_future.result()
+            self._load_future = None
+        try:
+            for f in self._save_futures:
+                f.result()
+        finally:
+            self._save_futures = []
+        sentinels, self._deferred_sentinels = self._deferred_sentinels, []
+        if sentinels:
+            loop = self._ensure_loop()
+
+            async def run_all():
+                await asyncio.gather(*(p() for p in sentinels))
+
+            asyncio.run_coroutine_threadsafe(run_all(), loop).result()
+
+    def close(self):
+        """Stop the background I/O loop (worker teardown)."""
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._loop_thread.join(timeout=5)
+            self._loop = None
