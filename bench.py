@@ -756,17 +756,32 @@ def _tpu_decode_attention_us(np) -> dict:
 def _engine_harness_metrics(its, np) -> dict:
     """BASELINE config 4, engine-shaped: the continuous-batching harness
     drives the connector like a vLLM-TPU-style engine — concurrent requests
-    with shared prefixes through lookup/load/save against the demo Llama on
-    the default backend. Three prompt families are seeded sequentially, then
-    9 admissions run 4-way concurrent and should all be full prefix hits;
-    reported: hit rate, admission p50/p99, and recompute seconds saved
-    (loaded blocks x measured per-block prefill cost)."""
+    through lookup/load/save against the demo Llama on the default backend.
+
+    Two phases at engine scale (not the r4 toy leg):
+    - Admission: 32 requests, 8-way concurrent, under a MIXED hit/miss
+      schedule (16 repeats of seeded families interleaved with 16 cold
+      prompts), so the hit rate is a property of the workload, not
+      engineered to 1.0. Admission latency is DECOMPOSED per request into
+      the store's own cost (lookup + load pipeline, ``store_io``) and the
+      time queued behind other requests' compute for the device gate
+      (``gate_stall``) — the split that tells a store optimizer which
+      number is theirs to move.
+    - Generation: 8 requests, 8-way concurrent, 32 greedy tokens each
+      through lockstep waves, with speculative decoding active (n-gram
+      prompt-lookup drafts verified in mixed waves): reports
+      tokens-per-verify-round and draft acceptance.
+    """
     import asyncio
 
     import jax.numpy as jnp
 
     from infinistore_tpu.connector import KVConnector
-    from infinistore_tpu.engine import ContinuousBatchingHarness, EngineKVAdapter
+    from infinistore_tpu.engine import (
+        ContinuousBatchingHarness,
+        EngineKVAdapter,
+        NGramDrafter,
+    )
     from infinistore_tpu.models import LlamaConfig, init_params
     import jax
 
@@ -774,9 +789,9 @@ def _engine_harness_metrics(its, np) -> dict:
         vocab=256, dim=128, n_layers=4, n_heads=4, n_kv_heads=2, ffn_dim=256,
         block_tokens=16, dtype=jnp.float32,
     )
-    num_blocks, req_blocks = 32, 4
+    num_blocks, req_blocks = 96, 4
     srv = its.start_local_server(
-        prealloc_bytes=256 << 20, block_bytes=max(64 << 10, cfg.kv_spec(1).block_nbytes)
+        prealloc_bytes=512 << 20, block_bytes=max(64 << 10, cfg.kv_spec(1).block_nbytes)
     )
     conn = its.InfinityConnection(
         its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
@@ -787,36 +802,42 @@ def _engine_harness_metrics(its, np) -> dict:
         kvc = KVConnector(conn, cfg.kv_spec(num_blocks), "bench-engine",
                           max_blocks=req_blocks)
         h = ContinuousBatchingHarness(
-            EngineKVAdapter(kvc), params, cfg, num_blocks, req_blocks
+            EngineKVAdapter(kvc), params, cfg, num_blocks, req_blocks,
+            drafter=NGramDrafter(max_draft=4),
         )
         rng = np.random.default_rng(3)
+        plen = req_blocks * cfg.block_tokens
         fams = [
-            rng.integers(0, cfg.vocab, size=req_blocks * cfg.block_tokens).tolist()
-            for _ in range(3)
+            rng.integers(0, cfg.vocab, size=plen).tolist() for _ in range(4)
         ]
         # ONE event loop for the whole leg: the harness's asyncio
         # primitives (pool/gate conditions, wave futures) bind to the loop
         # that first awaits them.
         async def drive():
-            # Seed sequentially (these 3 prefill+save), then 9 concurrent
-            # admissions — every one a full hit if lookup/load work.
+            # Seed the families (4 prefill+save), then the measured phase.
             for f in fams:
                 await h.run_request(f)
             h.stats.clear()
-            m = await h.run([fams[i % 3] for i in range(9)], concurrency=4)
-            assert m["max_live_requests"] >= 2
-            # Generation round: 3 partial-hit prompts resume via chunked
-            # continuation (one prefill_continue call each) and then
-            # generate in lockstep waves through the WaveDecoder (the
-            # continuous-batching inner loop).
-            half = 2 * cfg.block_tokens
-            partial = [
-                fams[i][:half]
-                + rng.integers(0, cfg.vocab, size=cfg.block_tokens).tolist()
-                for i in range(3)
-            ]
-            m2 = await h.run(partial, concurrency=3, gen_tokens=8)
-            for key in ("decode_waves", "max_wave_size", "generated_tokens"):
+            # Mixed schedule: repeats (hits) interleaved with cold prompts
+            # (misses) -> expected hit rate ~0.5 of blocks.
+            sched = []
+            for i in range(16):
+                sched.append(fams[i % 4])
+                sched.append(rng.integers(0, cfg.vocab, size=plen).tolist())
+            m = await h.run(sched[:32], concurrency=8)
+            assert m["requests"] == 32 and m["max_live_requests"] >= 4
+            # Generation at wave scale: 2-block repetitive prompts (the
+            # drafter's home turf) + 2 blocks of generation each, lockstep.
+            gen_prompts = []
+            for i in range(8):
+                pat = rng.integers(0, cfg.vocab, size=3).tolist()
+                gen_prompts.append((pat * (2 * cfg.block_tokens))[: 2 * cfg.block_tokens])
+            m2 = await h.run(gen_prompts, concurrency=8, gen_tokens=2 * cfg.block_tokens)
+            assert m2["decode_waves"] >= 6, m2["decode_waves"]
+            for key in (
+                "decode_waves", "max_wave_size", "generated_tokens",
+                "spec_tokens_per_step", "spec_acceptance_rate",
+            ):
                 m[key] = m2[key]
             return m
 
@@ -936,17 +957,33 @@ def main() -> int:
         # floor any concurrent batched client costs).
         **contended,
         # Engine-shaped connector proof (BASELINE config 4 in spirit): the
-        # continuous-batching harness, concurrent admissions, demo Llama.
+        # continuous-batching harness at engine scale — 32 requests 8-way
+        # concurrent under a MIXED hit/miss schedule (expected ~0.5), demo
+        # Llama.
         "engine_hit_rate": round(engine["hit_rate"], 3),
         "engine_p50_admission_us": round(engine["p50_admission_us"], 1),
         "engine_p99_admission_us": round(engine["p99_admission_us"], 1),
+        # Admission decomposed: the store's own cost (lookup + load
+        # pipeline) vs time queued for the device gate behind other
+        # requests' compute — optimizing the store moves the first; only
+        # engine scheduling moves the second.
+        "engine_store_io_p50_us": round(engine["p50_store_io_us"], 1),
+        "engine_store_io_p99_us": round(engine["p99_store_io_us"], 1),
+        "engine_store_io_hit_p50_us": round(engine["p50_store_io_hit_us"], 1),
+        "engine_store_io_miss_p50_us": round(engine["p50_store_io_miss_us"], 1),
+        "engine_gate_stall_p50_us": round(engine["p50_gate_stall_us"], 1),
+        "engine_gate_stall_p99_us": round(engine["p99_gate_stall_us"], 1),
         "engine_recompute_saved_s": round(engine["recompute_saved_s"], 4),
         "engine_max_live_requests": engine["max_live_requests"],
-        # Partial-hit resumes decode their suffixes in lockstep batched
-        # waves (engine.py WaveDecoder; one decode_step_batched per wave).
+        # Generation rides lockstep batched waves (engine.py WaveDecoder;
+        # one verify_step_batched per wave) with speculative decoding in
+        # the loop: n-gram drafts verified in mixed waves. tokens/step > 1
+        # = speculation is paying; output is greedy-identical (tested).
         "engine_decode_waves": engine["decode_waves"],
         "engine_max_wave_size": engine["max_wave_size"],
         "engine_generated_tokens": engine["generated_tokens"],
+        "engine_spec_tokens_per_step": round(engine["spec_tokens_per_step"], 3),
+        "engine_spec_acceptance_rate": round(engine["spec_acceptance_rate"], 3),
         "tpu_backend": backend,
     }
     if tpu is not None:

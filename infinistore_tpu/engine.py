@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.llama import decode_step_batched, prefill, prefill_continue
+from .models.llama import prefill, prefill_continue, verify_step_batched
 from .tpu.paged import gather_blocks
 
 
@@ -130,25 +130,31 @@ class DeviceGate:
 
 
 class WaveDecoder:
-    """Coalesce decode steps from concurrent requests into lockstep waves.
+    """Coalesce decode AND verify steps from concurrent requests into
+    lockstep waves.
 
-    A real continuous-batching engine advances EVERY live request one token
-    per step with one batched model call; per-request sequential decode
-    forfeits that. Each request awaits ``step(token, position, table)``;
-    the first arrival schedules a flush, the flush yields to the event loop
-    so every decode-ready request joins, then ONE ``decode_step_batched``
-    call (under the device gate's exclusive phase — it mutates the shared
-    cache) advances the whole wave and resolves each request's logits.
+    A real continuous-batching engine advances EVERY live request one step
+    per wave with one batched model call; per-request sequential decode
+    forfeits that. Each request awaits ``step(token, position, table)``
+    (one decode token) or ``step_chunk(tokens, positions, table)`` (a
+    speculative-verification chunk — the committed token plus drafted
+    continuations); the first arrival schedules a flush, the flush yields
+    to the event loop so every ready request joins, then ONE
+    ``verify_step_batched`` call (under the device gate's exclusive phase —
+    it mutates the shared cache) advances the whole MIXED wave: decoding
+    requests ride as 1-token chunks beside verifying requests' K-token
+    chunks, so speculation never leaves the lockstep batch.
 
-    Wave sizes vary with load, but the jitted batched step compiles once per
-    PADDED size, not per size seen: waves are padded to power-of-two buckets
-    by repeating the last real entry. A repeated row scatters the SAME K/V
-    bytes to the same (block, slot) as the row it copies — duplicate-index
-    scatters with identical payloads are value-deterministic, so pad rows
-    cannot corrupt the shared cache — and its logits row is simply never
-    awaited. Steady-state serving therefore compiles ceil(log2(max_wave))+1
-    shapes total, however the wave sizes wander (``bucket_sizes`` records
-    them; the harness test pins the count).
+    Wave shapes vary with load, but the jitted batched step compiles once
+    per PADDED (B, K) bucket, not per shape seen: the batch pads to a
+    power-of-two B by repeating the last request's entry, and every chunk
+    pads to the wave's power-of-two K by repeating its own last
+    (token, position) row. A repeated row scatters the SAME K/V bytes to
+    the same (block, slot) as the row it copies — duplicate-index scatters
+    with identical payloads are value-deterministic, so padding cannot
+    corrupt the shared cache — and padded logits rows are simply never
+    awaited. ``bucket_sizes`` records the distinct (B, K) buckets (= jit
+    cache entries); the harness test pins the count.
     """
 
     def __init__(self, harness: "ContinuousBatchingHarness"):
@@ -164,12 +170,23 @@ class WaveDecoder:
         self._flush_tasks = set()
         self.waves = 0
         self.max_wave = 0
-        self.bucket_sizes = set()  # distinct PADDED batch sizes (= compiles)
+        self.bucket_sizes = set()  # distinct PADDED (B, K) buckets (= compiles)
 
     async def step(self, token: int, position: int, padded_table) -> jax.Array:
         """Advance this request by one token; returns its logits row."""
+        rows = await self.step_chunk([token], [position], padded_table)
+        return rows[0]
+
+    async def step_chunk(
+        self, tokens: Sequence[int], positions: Sequence[int], padded_table
+    ) -> jax.Array:
+        """Advance this request by a token chunk (tokens[0] committed,
+        tokens[1:] speculative); returns its [len(tokens), vocab] logits
+        rows — row j follows tokens[:j+1]."""
+        if not tokens or len(tokens) != len(positions):
+            raise ValueError("need non-empty tokens with matching positions")
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((token, position, padded_table, fut))
+        self._pending.append((list(tokens), list(positions), padded_table, fut))
         if not self._flush_scheduled:
             self._flush_scheduled = True
             task = asyncio.ensure_future(self._flush())
@@ -189,17 +206,27 @@ class WaveDecoder:
             self._flush_scheduled = False
             if not batch:
                 return
-            # Pad to the power-of-two bucket by repeating the last entry
-            # (see class docstring: duplicate rows re-write identical bytes,
-            # so padding is cache-safe); only real rows' futures resolve.
-            bucket = 1 << (len(batch) - 1).bit_length()
-            padded = batch + [batch[-1]] * (bucket - len(batch))
-            self.bucket_sizes.add(bucket)
+            # Pad to the power-of-two (B, K) bucket (see class docstring:
+            # duplicate rows re-write identical bytes, so padding is
+            # cache-safe); only real rows' futures resolve.
+            b_bucket = 1 << (len(batch) - 1).bit_length()
+            k_max = max(len(toks) for toks, _, _, _ in batch)
+            k_bucket = 1 << (k_max - 1).bit_length()
+            padded = batch + [batch[-1]] * (b_bucket - len(batch))
+            self.bucket_sizes.add((b_bucket, k_bucket))
+
+            def pad_chunk(vals):
+                return list(vals) + [vals[-1]] * (k_bucket - len(vals))
+
             async with self.h.gate.exclusive():
-                tokens = jnp.asarray([b[0] for b in padded], jnp.int32)
-                positions = jnp.asarray([b[1] for b in padded], jnp.int32)
+                tokens = jnp.asarray(
+                    [pad_chunk(toks) for toks, _, _, _ in padded], jnp.int32
+                )
+                positions = jnp.asarray(
+                    [pad_chunk(pos) for _, pos, _, _ in padded], jnp.int32
+                )
                 tables = jnp.stack([b[2] for b in padded])
-                logits, self.h.caches = decode_step_batched(
+                logits, self.h.caches = verify_step_batched(
                     self.h.params,
                     tokens,
                     positions,
@@ -210,9 +237,9 @@ class WaveDecoder:
                 )
             self.waves += 1
             self.max_wave = max(self.max_wave, len(batch))
-            for i, (_, _, _, fut) in enumerate(batch):
+            for i, (toks, _, _, fut) in enumerate(batch):
                 if not fut.done():
-                    fut.set_result(logits[i])
+                    fut.set_result(logits[i, : len(toks)])
         except BaseException as e:  # noqa: BLE001 - must fail the waiters
             # A dead flush (model error, or cancellation/GC at shutdown)
             # must strand NO waiter: fail the taken batch and anything still
@@ -227,6 +254,39 @@ class WaveDecoder:
                     fut.set_exception(exc)
             if not isinstance(e, Exception):
                 raise
+
+
+class NGramDrafter:
+    """Prompt-lookup drafting: propose the tokens that FOLLOWED the most
+    recent earlier occurrence of the request's current suffix n-gram in its
+    own history (prompt + generated so far). Free speculation — no draft
+    model, no device work — that wins exactly where serving workloads
+    repeat themselves (quoting the prompt, code identifiers, templated
+    text), and greedy verification makes output token-for-token identical
+    to plain decode regardless of draft quality (tested). The same
+    self-drafting idea as published prompt-lookup / LLMA decoding.
+    """
+
+    def __init__(self, max_draft: int = 7, ngram: int = 2):
+        if max_draft < 1 or ngram < 1:
+            raise ValueError("max_draft and ngram must be >= 1")
+        self.max_draft = max_draft
+        self.ngram = ngram
+
+    def draft(self, history: Sequence[int]) -> List[int]:
+        """Up to ``max_draft`` proposed continuations of ``history`` (empty
+        when no suffix n-gram recurs — the caller then runs a plain decode
+        step). Longest n first: a longer matched context drafts better."""
+        h = list(history)
+        for n in range(min(self.ngram, len(h) - 1), 0, -1):
+            pattern = h[-n:]
+            # Most recent earlier occurrence: scan right to left, excluding
+            # the suffix occurrence itself (i + n <= len(h) - 1, so the
+            # continuation is never empty).
+            for i in range(len(h) - n - 1, -1, -1):
+                if h[i : i + n] == pattern:
+                    return h[i + n : i + n + self.max_draft]
+        return []
 
 
 class EngineKVAdapter:
@@ -277,6 +337,15 @@ class RequestStats:
     raced_eviction: bool  # lookup hit but blocks evicted before the read
     verified: Optional[bool]  # None when verification is off
     generated: Optional[List[int]] = None  # wave-decoded tokens (greedy)
+    # Decomposition of admission_us: what the STORE cost (admission lookup
+    # + the load pipeline: fetch/H2D/scatter) vs what was spent WAITING for
+    # the exclusive device gate behind other requests' loads and computes.
+    # The two do not sum to admission_us (event-loop scheduling and future
+    # plumbing fill the gap) but each is individually honest — a fat
+    # gate_stall with a thin store_io means the engine is compute-bound,
+    # not store-bound.
+    store_io_us: float = 0.0
+    gate_stall_us: float = 0.0
 
 
 class ContinuousBatchingHarness:
@@ -306,10 +375,20 @@ class ContinuousBatchingHarness:
         max_req_blocks: int,
         verify: bool = False,
         verify_tol: float = 2e-4,
+        drafter: Optional[NGramDrafter] = None,
     ):
+        """``drafter``: enables speculative decoding in the serving loop —
+        each generation round verifies the drafted chunk in one wave row
+        (verify_step_batched), emitting every greedy-accepted token plus
+        the model's continuation, so tokens/round can exceed 1 with output
+        identical to plain greedy decode."""
         self.adapter = adapter
         self.params = params
         self.config = config
+        self.drafter = drafter
+        self.spec_rounds = 0  # generation waves a request participated in
+        self.spec_drafted = 0  # draft tokens proposed
+        self.spec_accepted = 0  # draft tokens accepted
         self.caches = config.kv_spec(num_blocks).make_caches()
         self.pool = BlockPool(num_blocks)
         self.gate = DeviceGate()
@@ -407,23 +486,51 @@ class ContinuousBatchingHarness:
 
     async def _generate(self, token_ids, table: np.ndarray, gen_tokens: int):
         """Greedy generation through the shared WaveDecoder: every live
-        request advances one token per lockstep wave (the continuous-
-        batching inner loop). The first step re-decodes the last prompt
+        request advances one round per lockstep wave (the continuous-
+        batching inner loop). The first round re-decodes the last prompt
         token — its K/V insert rewrites identical bytes (the decode ==
-        prefill invariant) and yields the logits that choose token one."""
+        prefill invariant) and yields the logits that choose token one.
+
+        With a ``drafter``, each round's wave row is a CHUNK: the committed
+        token plus drafted continuations, verified in one pass (row j's
+        argmax follows chunk[:j+1], so chunk[j+1] is accepted iff it equals
+        that argmax — the speculative_verify recurrence, models/llama.py).
+        Every accepted token plus the model's own continuation is emitted:
+        tokens/round > 1 whenever drafts land, and rejected rows cost
+        nothing (their K/V is masked by position until real tokens
+        overwrite it). The chunk is capped to the tokens still wanted, so
+        a round never overshoots ``gen_tokens``."""
         padded = self._padded_table(table)
         pos = len(token_ids) - 1
         tok = int(token_ids[-1])
-        out = []
-        for _ in range(gen_tokens):
-            logits = await self.wave.step(tok, pos, padded)
-            tok = int(jnp.argmax(logits))
-            out.append(tok)
-            pos += 1
-        # Each step inserts the PREVIOUS token's K/V. When the final
-        # generated token completes a block (which the extended-chain save
-        # below persists), one more step lands it; otherwise its block is
-        # an incomplete tail with no chain key — skip the wasted wave.
+        history = list(token_ids)
+        out: List[int] = []
+        while len(out) < gen_tokens:
+            chunk = [tok]
+            if self.drafter is not None:
+                remaining = gen_tokens - len(out)
+                chunk += self.drafter.draft(history)[: remaining - 1]
+            rows = await self.wave.step_chunk(
+                chunk, list(range(pos, pos + len(chunk))), padded
+            )
+            # ONE device->host transfer per round (the [K] argmaxes).
+            preds = np.asarray(jnp.argmax(rows, axis=-1))
+            n_acc = 1
+            while n_acc < len(chunk) and chunk[n_acc] == int(preds[n_acc - 1]):
+                n_acc += 1
+            emitted = chunk[1:n_acc] + [int(preds[n_acc - 1])]
+            out.extend(emitted)
+            history.extend(emitted)
+            self.spec_rounds += 1
+            self.spec_drafted += len(chunk) - 1
+            self.spec_accepted += n_acc - 1
+            pos += n_acc
+            tok = emitted[-1]
+        # Each round inserts its CHUNK's K/V; the final emitted token's
+        # insert only happens as the next round's committed token. When it
+        # completes a block (which the extended-chain save below persists),
+        # one more step lands it; otherwise its block is an incomplete tail
+        # with no chain key — skip the wasted wave.
         if (len(token_ids) + gen_tokens) % self.config.block_tokens == 0:
             await self.wave.step(tok, pos, padded)
         return out
@@ -474,10 +581,15 @@ class ContinuousBatchingHarness:
             t0 = time.perf_counter()
             prompt_table = table[:n_blocks]  # tail blocks (if any) are for generation
             hit_tokens = self.adapter.get_num_matched_tokens(token_ids)
+            lookup_s = time.perf_counter() - t0
+            t_gate = time.perf_counter()
             async with self.gate.exclusive():
+                gate_stall_us = (time.perf_counter() - t_gate) * 1e6
+                t_io = time.perf_counter()
                 self.caches, loaded_tokens = await self.adapter.load_kv(
                     token_ids, self.caches, prompt_table
                 )
+                store_io_us = (lookup_s + time.perf_counter() - t_io) * 1e6
             admission_us = (time.perf_counter() - t0) * 1e6
             loaded_blocks = loaded_tokens // bt
             raced = hit_tokens > 0 and loaded_tokens == 0
@@ -521,6 +633,8 @@ class ContinuousBatchingHarness:
                 raced_eviction=raced,
                 verified=verified,
                 generated=generated,
+                store_io_us=store_io_us,
+                gate_stall_us=gate_stall_us,
             )
             self.stats.append(stats)
             return stats
@@ -551,9 +665,16 @@ class ContinuousBatchingHarness:
         total_blocks = sum(s.hit_blocks + s.computed_blocks for s in self.stats)
         loaded = sum(s.loaded_blocks for s in self.stats)
         lat = sorted(s.admission_us for s in self.stats)
+        io = sorted(s.store_io_us for s in self.stats)
+        io_hit = sorted(s.store_io_us for s in self.stats if s.loaded_blocks)
+        io_miss = sorted(s.store_io_us for s in self.stats if not s.loaded_blocks)
+        stall = sorted(s.gate_stall_us for s in self.stats)
+
+        def _p(xs, q):
+            return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else 0.0
 
         def pctl(q):
-            return lat[min(len(lat) - 1, int(len(lat) * q))] if lat else 0.0
+            return _p(lat, q)
 
         per_block = self._prefill_per_block_s or 0.0
         return {
@@ -564,18 +685,44 @@ class ContinuousBatchingHarness:
             "raced_evictions": sum(s.raced_eviction for s in self.stats),
             "p50_admission_us": pctl(0.50),
             "p99_admission_us": pctl(0.99),
+            # Admission decomposed (RequestStats): the store's own cost vs
+            # time queued behind other requests' compute for the device
+            # gate. Optimizing the store moves the first; only engine
+            # scheduling moves the second.
+            "p50_store_io_us": _p(io, 0.50),
+            "p99_store_io_us": _p(io, 0.99),
+            # Split by outcome: a miss costs one lookup round trip; a hit
+            # adds the whole load pipeline (fetch + H2D + scatter).
+            "p50_store_io_hit_us": _p(io_hit, 0.50),
+            "p50_store_io_miss_us": _p(io_miss, 0.50),
+            "p50_gate_stall_us": _p(stall, 0.50),
+            "p99_gate_stall_us": _p(stall, 0.99),
             "recompute_saved_s": loaded * per_block,
             "prefill_per_block_s": per_block,
             "max_live_requests": self.max_live,
             "max_concurrent_saves": self.max_concurrent_saves,
             "decode_waves": self.wave.waves,
             "max_wave_size": self.wave.max_wave,
-            # Distinct PADDED sizes == jit cache entries for the batched
-            # step (jit keys on shape): the compile-count story.
+            # Distinct PADDED (B, K) buckets == jit cache entries for the
+            # batched step (jit keys on shape): the compile-count story.
             "wave_buckets": sorted(self.wave.bucket_sizes),
             "generated_tokens": sum(
                 len(s.generated) for s in self.stats if s.generated
             ),
+            # Speculative decoding (drafter set): emitted tokens per verify
+            # round (> 1.0 means speculation is paying), and the drafter's
+            # acceptance rate. Without a drafter, rounds == tokens (1.0).
+            "spec_tokens_per_step": (
+                sum(len(s.generated) for s in self.stats if s.generated)
+                / self.spec_rounds
+                if self.spec_rounds
+                else 0.0
+            ),
+            "spec_acceptance_rate": (
+                self.spec_accepted / self.spec_drafted if self.spec_drafted else 0.0
+            ),
+            "spec_drafted_tokens": self.spec_drafted,
+            "spec_accepted_tokens": self.spec_accepted,
             "all_verified": all(
                 s.verified for s in self.stats if s.verified is not None
             ),

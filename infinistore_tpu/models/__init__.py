@@ -13,6 +13,7 @@ from .llama import (
     LlamaConfig,
     decode_step,
     decode_step_batched,
+    verify_step_batched,
     init_params,
     loss_fn,
     prefill,
@@ -29,6 +30,7 @@ __all__ = [
     "speculative_verify",
     "decode_step",
     "decode_step_batched",
+    "verify_step_batched",
     "loss_fn",
     "train_step",
 ]

@@ -296,6 +296,79 @@ def decode_step(
 
 
 @functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
+def verify_step_batched(
+    params: Params,
+    tokens: jax.Array,  # [B, K] int32, one token chunk per live request
+    positions: jax.Array,  # [B, K] int32 absolute position of each token
+    caches: Caches,  # SHARED paged cache across the wave
+    block_tables: jax.Array,  # [B, max_blocks] int32 (rows padded)
+    config: LlamaConfig,
+    max_blocks: int,
+) -> Tuple[jax.Array, Caches]:
+    """THE paged-inference body: a wave of B requests each advancing a
+    K-token chunk against the shared cache in one launch per layer.
+
+    Every per-request inference entry point is a view of this: K=1 is
+    batched decode (``decode_step_batched``), B=1 with K>1 is chunked
+    continuation prefill / speculative verification (``prefill_continue``,
+    ``speculative_verify``), and B>1 with K>1 is a MIXED wave — some
+    requests decoding one token, others verifying drafts — which is what
+    lets a continuous-batching engine fold speculative decoding into its
+    lockstep waves (engine.py WaveDecoder) instead of running spec
+    requests out-of-band.
+
+    Each row inserts its K/V at (table[pos // bt], pos % bt), then one
+    batched fused attention launch covers all B*K rows, each masked to its
+    own position + 1 (tpu/paged_attention.py). Requests own disjoint
+    blocks (the engine's block-table manager guarantees it); duplicate
+    rows WITHIN a request (wave/chunk padding that repeats a row) write
+    identical bytes and are therefore value-safe. Rows may attend sibling
+    rows' K/V within the chunk: inserts complete before attention, and
+    per-row masking keeps causality. Returns ([B, K, vocab] logits,
+    updated caches)."""
+    bsz, kk = tokens.shape
+    if block_tables.shape != (bsz, max_blocks):
+        raise ValueError(
+            f"block_tables must be [{bsz}, {max_blocks}] (one padded row per "
+            f"request), got {block_tables.shape}"
+        )
+    if positions.shape != (bsz, kk):
+        raise ValueError(
+            f"positions must match tokens' [{bsz}, {kk}], got {positions.shape}"
+        )
+    bt = config.block_tokens
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, K, dim]
+
+    flat_pos = positions.reshape(-1)  # [B*K]
+    block_idx = jnp.take_along_axis(
+        block_tables, positions // bt, axis=1
+    ).reshape(-1)  # [B*K]
+    slots = flat_pos % bt
+    row_tables = jnp.repeat(block_tables, kk, axis=0)  # [B*K, max_blocks]
+
+    new_caches: Caches = []
+    for layer, (k_cache, v_cache) in enumerate(caches):
+        k, v = _kv_proj(params, layer, x, positions, config)  # [B, K, KVH, D]
+        k_cache = k_cache.at[block_idx, slots].set(
+            k.reshape(bsz * kk, *k.shape[2:]).astype(k_cache.dtype)
+        )
+        v_cache = v_cache.at[block_idx, slots].set(
+            v.reshape(bsz * kk, *v.shape[2:]).astype(v_cache.dtype)
+        )
+        pre = f"l{layer}."
+        q = _q_proj(params, layer, x, positions, config)  # [B, K, H, D]
+        attn = paged_decode_attention_batched(
+            q.reshape(bsz * kk, *q.shape[2:]), k_cache, v_cache,
+            row_tables, flat_pos + 1,
+        ).reshape(bsz, kk, *q.shape[2:])  # [B, K, H, D]
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
+        x = _ffn(params, layer, x, config)
+        new_caches.append((k_cache, v_cache))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits, new_caches
+
+
 def prefill_continue(
     params: Params,
     tokens: jax.Array,  # [S_c] int32, the suffix chunk
@@ -312,36 +385,26 @@ def prefill_continue(
     matmuls; this inserts the whole chunk's K/V and attends all chunk rows
     in one batched kernel launch (each row masked to its own prefix length),
     with chunk-wide GEMMs for the projections and FFN. Semantically equal to
-    the decode loop (tested). Returns ([S_c, vocab] logits, caches)."""
+    the decode loop (tested). Returns ([S_c, vocab] logits, caches).
+
+    This is the B=1 view of ``verify_step_batched`` — one inference body
+    to maintain."""
     if block_table.shape[0] != max_blocks:
         raise ValueError(
             f"block_table has {block_table.shape[0]} entries, expected "
             f"max_blocks={max_blocks} (pad the table to the static bound)"
         )
-    bt = config.block_tokens
     s_c = tokens.shape[0]
-    positions = start_pos + jnp.arange(s_c, dtype=jnp.int32)  # [S_c]
-    x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, S_c, dim]
-
-    block_idx = jnp.take(block_table, positions // bt)  # [S_c]
-    slots = positions % bt
-    tables = jnp.broadcast_to(block_table, (s_c, max_blocks))
-
-    new_caches: Caches = []
-    for layer, (k_cache, v_cache) in enumerate(caches):
-        k, v = _kv_proj(params, layer, x, positions[None], config)  # [1,S_c,KVH,D]
-        k_cache = k_cache.at[block_idx, slots].set(k[0].astype(k_cache.dtype))
-        v_cache = v_cache.at[block_idx, slots].set(v[0].astype(v_cache.dtype))
-        pre = f"l{layer}."
-        q = _q_proj(params, layer, x, positions[None], config)  # [1,S_c,H,D]
-        attn = paged_decode_attention_batched(
-            q[0], k_cache, v_cache, tables, positions + 1
-        )  # [S_c, H, D]
-        x = x + jnp.einsum("shk,hkd->sd", attn, params[pre + "wo"])[None]
-        x = _ffn(params, layer, x, config)
-        new_caches.append((k_cache, v_cache))
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    positions = start_pos + jnp.arange(s_c, dtype=jnp.int32)
+    logits, new_caches = verify_step_batched(
+        params,
+        tokens[None],
+        positions[None],
+        caches,
+        block_table[None],
+        config,
+        max_blocks,
+    )
     return logits[0], new_caches
 
 
@@ -412,7 +475,6 @@ def speculative_verify(
     return n_accepted, next_token, caches
 
 
-@functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
 def decode_step_batched(
     params: Params,
     tokens: jax.Array,  # [B] int32, one next-token per live request
@@ -424,44 +486,22 @@ def decode_step_batched(
 ) -> Tuple[jax.Array, Caches]:
     """One decode step for a WAVE of requests sharing the paged cache — the
     continuous-batching engine's inner loop (every live request advances one
-    token per step). Each request's K/V lands in ITS block slot (requests
-    must own disjoint blocks — the engine's block-table manager guarantees
-    it; overlapping writes would race), then one batched fused attention
-    launch covers the whole wave (tpu/paged_attention.py). Per-token
-    semantics are identical to ``decode_step`` (tested); the win is paying
-    the model's dispatch and kernel-launch cost once per wave instead of
-    once per request. Returns ([B, vocab] logits, updated caches)."""
-    bsz = tokens.shape[0]
-    if block_tables.shape != (bsz, max_blocks):
-        raise ValueError(
-            f"block_tables must be [{bsz}, {max_blocks}] (one padded row per "
-            f"request), got {block_tables.shape}"
-        )
-    bt = config.block_tokens
-    x = jnp.take(params["embed"], tokens, axis=0)[:, None]  # [B, 1, dim]
-    pos2 = positions[:, None]  # [B, 1]
+    token per step). Per-token semantics are identical to ``decode_step``
+    (tested); the win is paying the model's dispatch and kernel-launch cost
+    once per wave instead of once per request. Returns ([B, vocab] logits,
+    updated caches).
 
-    block_idx = jnp.take_along_axis(
-        block_tables, (positions // bt)[:, None], axis=1
-    )[:, 0]  # [B]
-    slots = positions % bt  # [B]
-
-    new_caches: Caches = []
-    for layer, (k_cache, v_cache) in enumerate(caches):
-        k, v = _kv_proj(params, layer, x, pos2, config)  # [B, 1, KVH, D]
-        # Batched insert at (block_idx[b], slots[b]) — disjoint by contract.
-        k_cache = k_cache.at[block_idx, slots].set(k[:, 0].astype(k_cache.dtype))
-        v_cache = v_cache.at[block_idx, slots].set(v[:, 0].astype(v_cache.dtype))
-        pre = f"l{layer}."
-        q = _q_proj(params, layer, x, pos2, config)  # [B, 1, H, D]
-        attn = paged_decode_attention_batched(
-            q[:, 0], k_cache, v_cache, block_tables, positions + 1
-        )  # [B, H, D]
-        x = x + jnp.einsum("bhk,hkd->bd", attn, params[pre + "wo"])[:, None]
-        x = _ffn(params, layer, x, config)
-        new_caches.append((k_cache, v_cache))
-    x = _rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    This is the K=1 view of ``verify_step_batched`` — one inference body
+    to maintain."""
+    logits, new_caches = verify_step_batched(
+        params,
+        tokens[:, None],
+        positions[:, None],
+        caches,
+        block_tables,
+        config,
+        max_blocks,
+    )
     return logits[:, 0], new_caches
 
 

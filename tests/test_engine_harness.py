@@ -233,21 +233,21 @@ def test_multi_turn_conversation_hits_generated_blocks(conn, params):
 
 
 def test_wave_sizes_bucket_to_powers_of_two(conn, params, monkeypatch):
-    """Varied wave sizes must reach the jitted batched step only at
-    power-of-two PADDED sizes (jit keys its cache on shape, so distinct
-    shapes == compiles): a run whose natural wave sizes wander over
-    1..5 compiles at most the 1/2/4/8 buckets, and padding rows must not
-    perturb any request's output (all verified)."""
+    """Varied wave shapes must reach the jitted batched step only at
+    power-of-two PADDED (B, K) buckets (jit keys its cache on shape, so
+    distinct shapes == compiles): a run whose natural wave sizes wander
+    over 1..5 compiles at most the 1/2/4/8 batch buckets, and padding rows
+    must not perturb any request's output (all verified)."""
     import infinistore_tpu.engine as engine_mod
 
     shapes_seen = set()
-    real = engine_mod.decode_step_batched
+    real = engine_mod.verify_step_batched
 
     def recording(params_, tokens, *a, **kw):
-        shapes_seen.add(int(tokens.shape[0]))
+        shapes_seen.add((int(tokens.shape[0]), int(tokens.shape[1])))
         return real(params_, tokens, *a, **kw)
 
-    monkeypatch.setattr(engine_mod, "decode_step_batched", recording)
+    monkeypatch.setattr(engine_mod, "verify_step_batched", recording)
 
     async def drive():
         h = _harness(conn, params, "engine-buckets")
@@ -261,12 +261,95 @@ def test_wave_sizes_bucket_to_powers_of_two(conn, params, monkeypatch):
     assert m["all_verified"], "padding rows corrupted a request's blocks"
     assert m["generated_tokens"] == 5 * 6
     assert shapes_seen, "no waves decoded"
-    for b in shapes_seen:
-        assert b & (b - 1) == 0, f"non-power-of-two batched-step shape {b}"
+    for b, k in shapes_seen:
+        assert b & (b - 1) == 0, f"non-power-of-two wave batch {b}"
+        assert k & (k - 1) == 0, f"non-power-of-two chunk width {k}"
     # Compile count is bounded by the bucket ladder, not by how many
     # distinct natural sizes occurred.
     assert shapes_seen == set(m["wave_buckets"])
     assert len(shapes_seen) <= 4
+
+
+def test_ngram_drafter_proposes_recurring_continuations():
+    """Prompt-lookup drafting: the continuation after the most recent
+    earlier occurrence of the suffix n-gram, longest n first; empty when
+    nothing recurs."""
+    from infinistore_tpu.engine import NGramDrafter
+
+    d = NGramDrafter(max_draft=3, ngram=2)
+    # suffix (7, 8) occurred earlier, followed by 9, 10, 11.
+    assert d.draft([7, 8, 9, 10, 11, 5, 7, 8]) == [9, 10, 11]
+    # Only a 1-gram recurs.
+    assert d.draft([4, 9, 1, 2, 9]) == [1, 2, 9]
+    # Nothing recurs.
+    assert d.draft([1, 2, 3, 4]) == []
+    # Most RECENT earlier occurrence wins (8 -> 6, not 8 -> 2).
+    assert d.draft([8, 2, 5, 8, 6, 8]) == [6, 8]
+    # max_draft caps the proposal.
+    assert NGramDrafter(max_draft=1, ngram=2).draft([7, 8, 9, 7, 8]) == [9]
+
+
+def test_speculative_generation_matches_greedy_exactly(conn, params):
+    """Greedy acceptance makes speculative output token-for-token IDENTICAL
+    to plain decode — on a repetitive prompt the drafter must also actually
+    accept tokens (tokens/step > 1), or speculation is dead weight."""
+    from infinistore_tpu.engine import NGramDrafter
+
+    bt = CFG.block_tokens
+    # Period-3 repetition: the 2-gram suffix always recurs and the model-
+    # agnostic draft is often wrong (the model decides) — exercising both
+    # accept and reject paths.
+    prompts = [
+        ([11, 12, 13] * (2 * bt))[: 2 * bt],
+        ([3, 7] * bt)[: 2 * bt],
+        ([9, 9, 4, 2] * bt)[: 2 * bt],
+    ]
+
+    async def run_with(drafter):
+        h = _harness(conn, params, "engine-spec", verify=False)
+        h.drafter = drafter
+        stats = []
+        for p in prompts:  # sequential: identical per-request wave makeup
+            stats.append(await h.run_request(p, gen_tokens=2 * bt))
+        return h, [tuple(s.generated) for s in stats]
+
+    h_plain, plain = asyncio.run(run_with(None))
+    h_spec, spec = asyncio.run(run_with(NGramDrafter(max_draft=4)))
+    assert spec == plain, "speculation changed greedy output"
+    m = h_spec.metrics()
+    assert m["spec_drafted_tokens"] > 0, "drafter never proposed on a repetitive prompt"
+    assert m["spec_tokens_per_step"] > 1.0, (
+        f"speculation accepted nothing: {m['spec_tokens_per_step']}"
+    )
+    assert h_spec.spec_rounds < h_plain.spec_rounds, (
+        "speculation did not reduce model rounds"
+    )
+
+
+def test_mixed_spec_and_decode_requests_share_waves(conn, params):
+    """A drafting request and a plain-decode request coalesce into the SAME
+    wave (chunks of different lengths pad to one (B, K) launch) and both
+    verify against the oracle."""
+    from infinistore_tpu.engine import NGramDrafter
+
+    bt = CFG.block_tokens
+
+    async def drive():
+        h = _harness(conn, params, "engine-mixed")
+        h.drafter = NGramDrafter(max_draft=4)
+        rng = np.random.default_rng(31)
+        # One highly repetitive prompt (drafts fire) + ones with no
+        # repetition (drafter proposes nothing -> 1-token chunks).
+        p_rep = ([21, 22] * bt)[: 2 * bt]
+        p_rand = [rng.integers(0, CFG.vocab, size=2 * bt).tolist() for _ in range(2)]
+        return await h.run([p_rep] + p_rand, concurrency=3, gen_tokens=bt)
+
+    m = asyncio.run(drive())
+    assert m["all_verified"]
+    assert m["generated_tokens"] == 3 * CFG.block_tokens
+    assert m["max_wave_size"] >= 2, "requests never shared a wave"
+    # At least one wave carried a chunk wider than 1 (the drafting row).
+    assert any(k > 1 for _, k in m["wave_buckets"]), m["wave_buckets"]
 
 
 def test_wave_decoder_failure_fails_all_waiters(params):
