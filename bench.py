@@ -766,7 +766,14 @@ def _engine_harness_metrics(its, np) -> dict:
       the store's own cost (lookup + load pipeline, ``store_io``) and the
       time queued behind other requests' compute for the device gate
       (``gate_stall``) — the split that tells a store optimizer which
-      number is theirs to move.
+      number is theirs to move. Admission is TWO-PHASE (engine.py):
+      the store fetch starts speculatively at enqueue and never holds the
+      device gate; only the short host->device install does. The overlap
+      keys quantify it: ``gate_hold`` (how long installs actually held the
+      gate), ``overlap_fraction`` (share of fetch time that ran gate-free),
+      ``prefetch_waste`` (staged blocks discarded on raced eviction or
+      cancellation), and ``prefix_ready`` split by hit/miss — the
+      end-to-end check that a cache hit beats recomputing.
     - Generation: 8 requests, 8-way concurrent, 32 greedy tokens each
       through lockstep waves, with speculative decoding active (n-gram
       prompt-lookup drafts verified in mixed waves): reports
@@ -973,6 +980,22 @@ def main() -> int:
         "engine_store_io_miss_p50_us": round(engine["p50_store_io_miss_us"], 1),
         "engine_gate_stall_p50_us": round(engine["p50_gate_stall_us"], 1),
         "engine_gate_stall_p99_us": round(engine["p99_gate_stall_us"], 1),
+        # Two-phase admission overlap (this is what moved gate_stall): how
+        # long installs actually HELD the gate, what fraction of store
+        # fetch time ran with no gate held (1.0 = fully hidden behind
+        # compute), speculation waste, and end-to-end prefix residency by
+        # outcome — hit <= miss is the store earning its keep.
+        "engine_gate_hold_p50_us": round(engine["p50_gate_hold_us"], 1),
+        "engine_gate_hold_p99_us": round(engine["p99_gate_hold_us"], 1),
+        "engine_overlap_fraction": round(engine["overlap_fraction"], 3),
+        "engine_prefetch_waste": round(engine["prefetch_waste"], 4),
+        "engine_prefetch_fallbacks": engine["prefetch_fallbacks"],
+        "engine_prefix_ready_hit_p50_us": round(
+            engine["p50_prefix_ready_hit_us"], 1
+        ),
+        "engine_prefix_ready_miss_p50_us": round(
+            engine["p50_prefix_ready_miss_us"], 1
+        ),
         "engine_recompute_saved_s": round(engine["recompute_saved_s"], 4),
         "engine_max_live_requests": engine["max_live_requests"],
         # Generation rides lockstep batched waves (engine.py WaveDecoder;

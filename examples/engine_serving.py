@@ -70,6 +70,10 @@ def main():
             "requests", "hit_rate", "loaded_blocks", "computed_blocks",
             "raced_evictions", "p50_admission_us", "p99_admission_us",
             "p50_store_io_us", "p50_gate_stall_us",
+            # Two-phase admission overlap: store fetch runs gate-free at
+            # enqueue; only the short install holds the gate.
+            "p50_gate_hold_us", "overlap_fraction", "prefetch_waste",
+            "p50_prefix_ready_hit_us", "p50_prefix_ready_miss_us",
             "recompute_saved_s", "max_live_requests", "decode_waves",
             "max_wave_size", "generated_tokens", "spec_tokens_per_step",
             "spec_acceptance_rate", "all_verified",

@@ -39,10 +39,22 @@ __version__ = "0.1.0"
 def __getattr__(name):
     # Lazy: the connector/engine layers pull in jax (via the TPU data
     # plane); the core client/server API must stay importable without it.
-    if name in ("KVConnector", "token_chain_hashes"):
+    if name in ("KVConnector", "token_chain_hashes", "FetchCoalescer"):
         from . import connector
 
         return getattr(connector, name)
+    if name in ("LayerwisePrefetch", "PrefetchDiscarded"):
+        from .tpu import layerwise
+
+        return getattr(layerwise, name)
+    if name in ("StagingPoolExhausted", "StagingLease", "HostStagingPool"):
+        from .tpu import staging
+
+        return getattr(staging, name)
+    if name == "KVLoadUnderDelivery":
+        from . import vllm_v1
+
+        return vllm_v1.KVLoadUnderDelivery
     if name in ("EngineKVAdapter", "ContinuousBatchingHarness", "BlockPool"):
         from . import engine
 
@@ -73,6 +85,13 @@ __all__ = [
     "InfiniStoreKVConnectorV1",
     "KVConnectorRole",
     "KVConnectorMetadata",
+    "KVLoadUnderDelivery",
+    "FetchCoalescer",
+    "LayerwisePrefetch",
+    "PrefetchDiscarded",
+    "StagingPoolExhausted",
+    "StagingLease",
+    "HostStagingPool",
     "InfinityConnection",
     "StripedConnection",
     "register_server",

@@ -60,6 +60,14 @@ def _build() -> None:
 if _needs_build():
     _build()
 
+# Older glibc keeps shm_open/shm_unlink in librt; a .so built against a glibc
+# that folded them into libc then fails to load with "undefined symbol:
+# shm_open". Preloading librt globally resolves the symbols either way.
+try:
+    ctypes.CDLL("librt.so.1", mode=ctypes.RTLD_GLOBAL)
+except OSError:
+    pass  # no librt (musl / new glibc): the symbols live in libc already
+
 lib = ctypes.CDLL(_SO_PATH)
 
 # ---- logging ----

@@ -44,6 +44,13 @@ def parse_args(argv=None):
              "see docs/multistream.md)",
     )
     p.add_argument(
+        "--wave", type=int, default=0,
+        help="also measure admission-wave read coalescing: N concurrent "
+             "requests' reads issued as N separate calls vs merged into one "
+             "(the FetchCoalescer mechanism the engine's overlapped "
+             "admission pipeline rides; connector.py)",
+    )
+    p.add_argument(
         "--pacing-mbps", type=int, default=0,
         help="cap each connection's egress in MB/s (SO_MAX_PACING_RATE); "
              "implies the socket path (shm off — a same-host memcpy would "
@@ -90,6 +97,54 @@ def _measure_latency(conn, samples: int = 200) -> dict:
         }
         conn.delete_keys([key])
     return out
+
+
+def _measure_wave_coalescing(conn, keys, offsets, block_size, dst, wave: int) -> dict:
+    """N concurrent 'admissions' reading disjoint spans: N separate
+    read_cache_async calls racing on the connection vs the SAME blocks
+    merged into one call (what connector.FetchCoalescer does for a wave of
+    engine admissions). The gain is per-call overhead amortization — the
+    number striped deployments multiply, since one merged call splits
+    across all stripes."""
+    n = len(keys)
+    # Exactly `wave` near-equal spans (never more, never fewer — except
+    # when there are fewer keys than requests), so the reported
+    # wave_requests is the concurrency actually raced.
+    wave = min(wave, n)
+    bounds = [round(j * n / wave) for j in range(wave + 1)]
+    spans = [
+        list(zip(keys[a:b], offsets[a:b]))
+        for a, b in zip(bounds, bounds[1:])
+        if b > a
+    ]
+
+    async def split():
+        await asyncio.gather(*(
+            conn.read_cache_async(span, block_size, dst.ctypes.data)
+            for span in spans
+        ))
+
+    async def merged():
+        await conn.read_cache_async(
+            [b for span in spans for b in span], block_size, dst.ctypes.data
+        )
+
+    asyncio.run(split())  # warm
+    best_split = best_merged = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        asyncio.run(split())
+        best_split = min(best_split, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        asyncio.run(merged())
+        best_merged = min(best_merged, time.perf_counter() - t0)
+    moved_mb = n * block_size / (1 << 20)
+    return {
+        "wave_requests": len(spans),
+        "wave_split_mb_s": round(moved_mb / best_split, 2),
+        "wave_merged_mb_s": round(moved_mb / best_merged, 2),
+        "wave_coalescing_gain": round(best_split / best_merged, 3),
+    }
 
 
 async def _run_batched(conn, keys, offsets, block_size, src, dst, steps):
@@ -180,6 +235,10 @@ def run(args) -> dict:
         }
         if args.latency and args.type == "rdma":
             result["latency"] = _measure_latency(conn)
+        if args.wave > 1 and args.type == "rdma":
+            result["coalescing"] = _measure_wave_coalescing(
+                conn, keys, offsets, block_size, dst, args.wave
+            )
         conn.delete_keys(keys)
         return result
     finally:

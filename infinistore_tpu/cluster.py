@@ -134,6 +134,26 @@ class ClusterKVConnector:
             self._absorb(e)
             return 0
 
+    def start_fetch(
+        self, token_ids, first_block: int = 0, limit_blocks=None
+    ):
+        """Two-phase admission over the pool: route the gate-free fetch to
+        the prefix owner (same rendezvous as load). Returns the member's
+        prefetch handle, or None when nothing is fetchable / the owner is
+        down under the degrade policy — callers then use the one-phase
+        ``load``. StagingPoolExhausted propagates (backpressure, not
+        failure)."""
+        member = self._owner(token_ids)
+        if member is None:
+            return None
+        try:
+            return member.start_fetch(
+                token_ids, first_block=first_block, limit_blocks=limit_blocks
+            )
+        except InfiniStoreException as e:
+            self._absorb(e)
+            return None
+
     async def load(
         self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
         on_layer=None,

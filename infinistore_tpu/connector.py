@@ -27,9 +27,14 @@ from .lib import (
     InfiniStoreNoMatch,
     InfiniStoreResourcePressure,
 )
-from .tpu.layerwise import LayerwiseKVReader, LayerwiseKVWriter, PartialReadError
+from .tpu.layerwise import (
+    LayerwiseKVReader,
+    LayerwiseKVWriter,
+    LayerwisePrefetch,
+    PartialReadError,
+)
 from .tpu.paged import PagedKVCacheSpec
-from .tpu.staging import HostStagingPool
+from .tpu.staging import HostStagingPool, StagingPoolExhausted  # noqa: F401 - re-export
 
 
 def token_chain_hashes(token_ids: Sequence[int], block_tokens: int) -> List[str]:
@@ -49,6 +54,83 @@ def token_chain_hashes(token_ids: Sequence[int], block_tokens: int) -> List[str]
         h.update(chunk.tobytes())
         hashes.append(h.copy().hexdigest()[:32])
     return hashes
+
+
+class FetchCoalescer:
+    """Merge store reads issued in the same event-loop tick into ONE
+    batched ``read_cache_async`` call.
+
+    A wave of concurrent admissions starts one prefetch each; without
+    coalescing, every layer of every request is its own store round trip.
+    Batched, the wave's reads ride a single call — which a
+    ``StripedConnection`` then splits across its connection stripes, so a
+    burst of admissions shares the stripes instead of queueing serially.
+
+    All submitters must target the same base pointer (one staging pool)
+    and block size; the coalescer only merges, it never copies."""
+
+    def __init__(self, conn, block_size: int, base_ptr: int):
+        self.conn = conn
+        self.block_size = block_size
+        self.base_ptr = base_ptr
+        self._pending: list = []
+        self._flush_scheduled = False
+        # Strong refs: the loop holds only weak refs to tasks (same
+        # discipline as engine.WaveDecoder).
+        self._flush_tasks: set = set()
+        self.calls = 0  # batched store calls issued
+        self.submissions = 0  # logical submits merged into them
+        self.max_batch = 0
+
+    def submit(self, blocks) -> "asyncio.Future":
+        """Queue one logical read (list of (key, offset-from-base) pairs);
+        returns a future resolving when those bytes are staged."""
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((blocks, fut))
+        self.submissions += 1
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            task = asyncio.ensure_future(self._flush())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_tasks.discard)
+        return fut
+
+    async def _flush(self):
+        # One yield: everything enqueued this tick joins the batch.
+        await asyncio.sleep(0)
+        batch, self._pending = self._pending, []
+        self._flush_scheduled = False
+        if not batch:
+            return
+        self.calls += 1
+        self.max_batch = max(self.max_batch, len(batch))
+        merged = [b for blocks, _ in batch for b in blocks]
+        try:
+            await self.conn.read_cache_async(merged, self.block_size, self.base_ptr)
+        except Exception as e:
+            if len(batch) == 1:
+                blocks, fut = batch[0]
+                if not fut.done():
+                    fut.set_exception(e)
+                return
+            # One member's evicted key must not doom its wave-mates: retry
+            # each submission alone so only the genuinely missing one fails.
+            for blocks, fut in batch:
+                if fut.done():
+                    continue
+                self.calls += 1
+                try:
+                    await self.conn.read_cache_async(
+                        blocks, self.block_size, self.base_ptr
+                    )
+                except Exception as e2:
+                    fut.set_exception(e2)
+                else:
+                    fut.set_result(None)
+            return
+        for _, fut in batch:
+            if not fut.done():
+                fut.set_result(None)
 
 
 class KVConnector:
@@ -97,6 +179,12 @@ class KVConnector:
             self.pool = pool
             self._writer = LayerwiseKVWriter(conn, pool, spec, max_blocks)
             self._reader = LayerwiseKVReader(conn, pool, spec, max_blocks)
+        # Two-phase admission path (start_fetch): its own staging pool —
+        # the reader's ``_LayerRegions`` owns ``pool``'s layout outright, so
+        # speculative prefetches reserve from a separate arena. Lazy: only
+        # engines on the pipelined path pay for it.
+        self._prefetch_pool: Optional[HostStagingPool] = None
+        self._coalescer: Optional[FetchCoalescer] = None
 
     def _require_store(self, what: str):
         if self.conn is None:
@@ -227,6 +315,86 @@ class KVConnector:
             raise
         return out, n
 
+    def start_fetch(
+        self,
+        token_ids,
+        first_block: int = 0,
+        limit_blocks: Optional[int] = None,
+        prefetch_pool: Optional[HostStagingPool] = None,
+    ) -> LayerwisePrefetch:
+        """Begin the GATE-FREE half of a load: probe the store (one control
+        round trip) and immediately start streaming the hit prefix's layers
+        into reserved host staging regions — no device work, no engine
+        lock, callable before the engine has even allocated blocks. The
+        returned :class:`~.tpu.layerwise.LayerwisePrefetch` carries
+        ``hit_blocks`` (the lookup answer) and ``n_blocks`` (what is being
+        fetched); ``install(caches, block_ids)`` is the short exclusive
+        phase with ``load``'s exact semantics, and ``discard()`` cancels
+        cleanly (staging accounting returns to baseline).
+
+        Concurrent admissions' fetches coalesce into shared batched store
+        reads (:class:`FetchCoalescer`), so a wave of requests splits
+        striped connections instead of queueing serially.
+
+        Raises :class:`~.tpu.staging.StagingPoolExhausted` when the
+        prefetch arena cannot hold another pipeline — callers treat that
+        as backpressure and fall back to the one-phase ``load``. Must be
+        called from a running event loop (the loop the install/discard
+        will run on)."""
+        self._require_store("start_fetch")
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if first_block < 0 or first_block > len(chains):
+            raise ValueError(
+                f"first_block={first_block} outside the prompt's "
+                f"{len(chains)} complete blocks"
+            )
+        hit = self._lookup_chains(chains)
+        n = max(0, hit - first_block)
+        n = min(n, self.max_blocks)
+        if limit_blocks is not None:
+            n = min(n, limit_blocks)
+        pool = prefetch_pool or self._ensure_prefetch_pool()
+        span = chains[first_block : first_block + n]
+        try:
+            handle = LayerwisePrefetch(
+                self.conn,
+                pool,
+                self.spec,
+                self._key_fn(span),
+                n,
+                self.spec.num_layers,
+                submit=self._ensure_coalescer(pool).submit
+                if prefetch_pool is None
+                else None,
+            )
+        except StagingPoolExhausted as e:
+            # The probe already ran — hand its answer to the fallback so a
+            # backpressured admission (the most loaded moment) does not pay
+            # the control round trip twice.
+            e.hit_blocks = hit
+            raise
+        handle.hit_blocks = hit
+        return handle
+
+    def _ensure_prefetch_pool(self) -> HostStagingPool:
+        if self._prefetch_pool is None:
+            # ~4 full-depth pipelines (capped at 8 regions each, matching
+            # LayerwisePrefetch's default): enough for a concurrent
+            # admission wave; an over-wave falls back to the gated load.
+            regions = min(self.spec.num_layers, 8)
+            nbytes = 4 * regions * 2 * self.max_blocks * self.spec.block_nbytes
+            self._prefetch_pool = HostStagingPool(
+                nbytes, self.spec.block_nbytes, conn=self.conn
+            )
+        return self._prefetch_pool
+
+    def _ensure_coalescer(self, pool: HostStagingPool) -> FetchCoalescer:
+        if self._coalescer is None or self._coalescer.base_ptr != pool.base_ptr:
+            self._coalescer = FetchCoalescer(
+                self.conn, self.spec.block_nbytes, pool.base_ptr
+            )
+        return self._coalescer
+
     def stage_layer_save(
         self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
         first_block: int = 0,
@@ -250,7 +418,16 @@ class KVConnector:
 
         from .tpu.paged import gather_blocks
 
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)[first_block:]
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if first_block < 0 or first_block > len(chains):
+            # Same bounds contract as save()/load(): an out-of-range
+            # first_block would silently slice to an empty chain list and
+            # return a no-op ship, hiding the caller's bug.
+            raise ValueError(
+                f"first_block={first_block} outside the prompt's "
+                f"{len(chains)} complete blocks"
+            )
+        chains = chains[first_block:]
         n = min(len(chains), len(block_ids))
         if n == 0:
             async def noop() -> int:

@@ -17,11 +17,26 @@ of that story for JAX/TPU engines:
   batched waves (``WaveDecoder`` -> one ``decode_step_batched`` call per
   wave), byte-verified against the model's prefill oracle, and
   store writes of every computed prefix. Device-cache discipline mirrors a
-  real engine scheduler: mutating phases (load scatters donate cache
+  real engine scheduler: mutating phases (install scatters donate cache
   buffers; compute rewrites blocks) are exclusive; saves snapshot their
   blocks with cheap device-side gathers and then stream to the store with
   no lock held — so multiple requests keep store I/O in flight concurrently
   while the device cache stays consistent.
+
+Admission is TWO-PHASE and store I/O never holds the device gate: a
+speculative, gate-free FETCH (``KVConnector.start_fetch``) starts streaming
+the hit prefix into host staging at enqueue — before blocks are even
+allocated — with concurrent admissions' reads coalesced into shared store
+calls; only the short INSTALL (host->device scatter) takes the exclusive
+gate, in an expedited lane so late-arriving-but-cheap installs are not
+parked behind a convoy of prefills. Gate-held compute runs in executor
+threads so the event loop keeps draining fetch completions — that, plus
+the fetch/install split, is what turns the old serialized
+probe->load->prefill admission into a pipeline where a cache hit is
+cheaper end-to-end than recomputing (``p50_prefix_ready_hit_us`` vs
+``_miss_`` in the metrics). Prefetches cancel cleanly: a raced eviction
+or an abandoned admission discards the handle, staging accounting returns
+to baseline, and the waste is reported (``prefetch_waste``).
 
 Metrics reported (the engine-side figures of merit the reference never
 measured): prefix hit rate, admission latency percentiles, recompute seconds
@@ -41,6 +56,7 @@ import numpy as np
 
 from .models.llama import prefill, prefill_continue, verify_step_batched
 from .tpu.paged import gather_blocks
+from .tpu.staging import StagingPoolExhausted
 
 
 class BlockPool:
@@ -90,17 +106,31 @@ class DeviceGate:
         # computes indefinitely. (Phases are never nested per request, so
         # priority cannot deadlock.)
         self._exclusive_waiting = 0
+        # Expedite lane: short mutators (prefix INSTALLS — a device
+        # transfer, not a model forward) go ahead of queued long ones
+        # (prefills). Installs arrive LATE by construction (their gate-free
+        # fetch runs first), so FIFO would park every cache hit behind a
+        # convoy of misses' prefills — shortest-job-first keeps the hit
+        # path's latency at install cost. No starvation in practice: each
+        # admission expedites at most once, so the lane drains.
+        self._expedite_waiting = 0
 
     @asynccontextmanager
-    async def exclusive(self):
+    async def exclusive(self, expedite: bool = False):
         async with self._cond:
             self._exclusive_waiting += 1
+            if expedite:
+                self._expedite_waiting += 1
             try:
                 await self._cond.wait_for(
-                    lambda: not self._exclusive and self._shared == 0
+                    lambda: not self._exclusive
+                    and self._shared == 0
+                    and (expedite or self._expedite_waiting == 0)
                 )
             finally:
                 self._exclusive_waiting -= 1
+                if expedite:
+                    self._expedite_waiting -= 1
                 # A cancelled wait (e.g. a timed-out request) may be the
                 # writer that shared() waiters queued behind; without this
                 # notify they would sleep forever on a free gate.
@@ -302,6 +332,25 @@ class EngineKVAdapter:
         store already holds (block-aligned; one control round trip)."""
         return self.connector.lookup(token_ids) * self.block_tokens
 
+    def start_fetch(self, token_ids, limit_blocks: Optional[int] = None):
+        """Speculative, gate-free half of a load: probe + start streaming
+        the hit prefix into host staging NOW (before the engine has even
+        allocated blocks). Returns a prefetch handle (``hit_blocks``,
+        ``install``, ``discard`` — KVConnector.start_fetch), or None when
+        the underlying connector has no two-phase path (the caller then
+        uses the one-phase ``load_kv``). StagingPoolExhausted propagates —
+        it is admission backpressure, not failure."""
+        if not hasattr(self.connector, "start_fetch"):
+            return None
+        return self.connector.start_fetch(token_ids, limit_blocks=limit_blocks)
+
+    async def install_kv(self, prefetch, caches, block_table: np.ndarray):
+        """The short exclusive half: scatter a prefetch's staged layers
+        into the engine's cache blocks. Same contract as ``load_kv``
+        (donation; returns (caches, tokens_loaded))."""
+        out, blocks = await prefetch.install(caches, block_table)
+        return out, blocks * self.block_tokens
+
     async def load_kv(self, token_ids, caches, block_table: np.ndarray):
         """Fetch the cached prefix into the engine's cache blocks. Returns
         (updated caches, tokens_loaded). Input caches are consumed
@@ -343,9 +392,29 @@ class RequestStats:
     # The two do not sum to admission_us (event-loop scheduling and future
     # plumbing fill the gap) but each is individually honest — a fat
     # gate_stall with a thin store_io means the engine is compute-bound,
-    # not store-bound.
+    # not store-bound. gate_stall_us totals EVERY exclusive-gate wait the
+    # request paid (install at admission, then the compute phase), so
+    # misses — which no longer touch the gate at admission — still report
+    # their queue time.
     store_io_us: float = 0.0
     gate_stall_us: float = 0.0
+    # Two-phase admission (prefetch path): how long the exclusive gate was
+    # actually HELD for the install (host->device scatter — the only part
+    # of a load that still needs exclusivity), the gate-free store fetch's
+    # duration, and what fraction of that fetch ran while this request
+    # held NO gate (1.0 = store I/O fully hidden behind other work).
+    gate_hold_us: float = 0.0
+    fetch_us: float = 0.0
+    overlap_fraction: Optional[float] = None
+    # Prefetch accounting: K+V blocks staged for this request, and how
+    # many of those never reached the device (discarded on raced
+    # eviction / cancellation — the waste the speculation paid).
+    prefetched_blocks: int = 0
+    wasted_blocks: int = 0
+    # t0 -> the request's ENTIRE prefix resident on device (loaded and/or
+    # computed): the end-to-end figure that decides whether a cache hit
+    # actually beats recomputing.
+    prefix_ready_us: float = 0.0
 
 
 class ContinuousBatchingHarness:
@@ -404,6 +473,14 @@ class ContinuousBatchingHarness:
         self.max_live = 0
         self._saving = 0
         self.max_concurrent_saves = 0
+        # Admissions that wanted a prefetch but found the staging arena
+        # full and fell back to the one-phase gated load (backpressure).
+        self.prefetch_fallbacks = 0
+        # Prefetch bytes from requests that DIED before install (cancelled
+        # mid-admission): they never reach self.stats, but their waste is
+        # real and must show in prefetch_waste.
+        self._prefetch_extra_fetched = 0
+        self._prefetch_extra_wasted = 0
         self.stats: List[RequestStats] = []
         self._prefill_per_block_s: Optional[float] = None
         # Jitted whole-prompt pass: on a real (or tunneled) TPU the eager
@@ -467,11 +544,20 @@ class ContinuousBatchingHarness:
         (the prompt, or prompt + generated for response blocks)."""
         dev = jnp.asarray(np.asarray(phys_blocks))
         async with self.gate.shared():
-            snapshot = [
-                (gather_blocks(k, dev), gather_blocks(v, dev))
-                for k, v in self.caches
-            ]
-            jax.block_until_ready(snapshot)
+            caches = self.caches  # stable under the shared gate
+
+            def snap():
+                s = [
+                    (gather_blocks(k, dev), gather_blocks(v, dev))
+                    for k, v in caches
+                ]
+                jax.block_until_ready(s)
+                return s
+
+            # Executor: the gathers + readiness wait must not pin the event
+            # loop (it is the artery every gate-free fetch completion and
+            # wave flush flows through).
+            snapshot = await asyncio.get_running_loop().run_in_executor(None, snap)
         self._saving += 1
         self.max_concurrent_saves = max(self.max_concurrent_saves, self._saving)
         try:
@@ -576,29 +662,115 @@ class ContinuousBatchingHarness:
         token_ids = list(token_ids)[: n_blocks * bt]
         self.live += 1
         self.max_live = max(self.max_live, self.live)
-        table = await self.pool.alloc(total_blocks)
+        # Speculative prefetch AT ENQUEUE: probe + start streaming the hit
+        # prefix into host staging before BlockPool.alloc even completes —
+        # the store fetch overlaps this request's own admission wait and
+        # every other request's compute, and NEVER holds the device gate.
+        t0 = time.perf_counter()
+        prefetch = None
+        fallback_hit: Optional[int] = None  # probe answer from a failed start_fetch
+        # getattr: adapters without a two-phase path (QuantizingKVAdapter)
+        # simply keep the one-phase gated load below.
+        starter = getattr(self.adapter, "start_fetch", None)
+        if starter is not None:
+            try:
+                prefetch = starter(token_ids, limit_blocks=n_blocks)
+            except StagingPoolExhausted as e:
+                # Admission backpressure: the staging arena is carrying a
+                # full wave already — this request takes the gated load,
+                # reusing the probe the failed start_fetch already paid.
+                self.prefetch_fallbacks += 1
+                fallback_hit = getattr(e, "hit_blocks", None)
+        lookup_s = time.perf_counter() - t0  # start_fetch includes the probe
+        prefetch_settled = prefetch is None or prefetch.n_blocks == 0
+        table = None
         try:
-            t0 = time.perf_counter()
+            table = await self.pool.alloc(total_blocks)
             prompt_table = table[:n_blocks]  # tail blocks (if any) are for generation
-            hit_tokens = self.adapter.get_num_matched_tokens(token_ids)
-            lookup_s = time.perf_counter() - t0
-            t_gate = time.perf_counter()
-            async with self.gate.exclusive():
-                gate_stall_us = (time.perf_counter() - t_gate) * 1e6
-                t_io = time.perf_counter()
-                self.caches, loaded_tokens = await self.adapter.load_kv(
-                    token_ids, self.caches, prompt_table
-                )
-                store_io_us = (lookup_s + time.perf_counter() - t_io) * 1e6
+            gate_hold_us = fetch_us = 0.0
+            overlap = None
+            if prefetch is not None:
+                # -- pipelined admission: fetch (gate-free) then install --
+                hit_tokens = prefetch.hit_blocks * bt
+                loaded_tokens = 0
+                gate_stall_us = store_io_us = 0.0
+                if prefetch.n_blocks:
+                    # Wait for the fetch pipeline to fill WITHOUT the gate:
+                    # the store I/O runs while other requests compute.
+                    await prefetch.primed()
+                    t_gate = time.perf_counter()
+                    async with self.gate.exclusive(expedite=True):
+                        gate_stall_us = (time.perf_counter() - t_gate) * 1e6
+                        t_hold = time.perf_counter()
+                        self.caches, loaded_tokens = await self.adapter.install_kv(
+                            prefetch,
+                            self.caches,
+                            prompt_table[: prefetch.n_blocks],
+                        )
+                        gate_hold_us = (time.perf_counter() - t_hold) * 1e6
+                    prefetch_settled = True
+                    t_end = prefetch.fetch_finished_s or time.perf_counter()
+                    fetch_dur = max(t_end - prefetch.fetch_started_s, 0.0)
+                    fetch_us = fetch_dur * 1e6
+                    if fetch_dur > 0:
+                        # Fraction of the fetch that ran before this request
+                        # acquired the gate = store I/O hidden behind other
+                        # work instead of serializing the device.
+                        overlapped = min(t_end, t_gate) - prefetch.fetch_started_s
+                        overlap = min(1.0, max(0.0, overlapped / fetch_dur))
+                # The store's own cost: probe + gate-free fetch + the
+                # install's H2D/scatter. Unlike the pre-split pipeline,
+                # only the LAST term ever serializes the device.
+                store_io_us = lookup_s * 1e6 + fetch_us + gate_hold_us
+            else:
+                # -- one-phase fallback (no start_fetch, or arena full) --
+                if fallback_hit is not None:
+                    hit_tokens = fallback_hit * bt
+                else:
+                    t_l = time.perf_counter()
+                    hit_tokens = self.adapter.get_num_matched_tokens(token_ids)
+                    lookup_s = time.perf_counter() - t_l
+                t_gate = time.perf_counter()
+                async with self.gate.exclusive():
+                    gate_stall_us = (time.perf_counter() - t_gate) * 1e6
+                    t_io = time.perf_counter()
+                    self.caches, loaded_tokens = await self.adapter.load_kv(
+                        token_ids, self.caches, prompt_table
+                    )
+                    gate_hold_us = (time.perf_counter() - t_io) * 1e6
+                    store_io_us = lookup_s * 1e6 + gate_hold_us
             admission_us = (time.perf_counter() - t0) * 1e6
             loaded_blocks = loaded_tokens // bt
             raced = hit_tokens > 0 and loaded_tokens == 0
             if loaded_blocks < n_blocks:
+                # The compute phase's gate wait counts toward gate_stall
+                # too: misses never touch the gate at admission anymore, so
+                # without this their "queued behind other requests" signal
+                # (the thing gate_stall exists to expose) would read 0.
+                t_g2 = time.perf_counter()
                 async with self.gate.exclusive():
+                    gate_stall_us += (time.perf_counter() - t_g2) * 1e6
+                    # Compute runs in an executor thread: the jitted call
+                    # (and its block_until_ready) would otherwise pin the
+                    # EVENT LOOP for the whole forward — freezing every
+                    # other request's gate-free fetch completions, which is
+                    # exactly the overlap this pipeline exists to create.
+                    # The gate (held across the await) still serializes
+                    # cache mutation.
+                    loop = asyncio.get_running_loop()
                     if loaded_blocks == 0:
-                        self._prefill_full(token_ids, prompt_table)
+                        await loop.run_in_executor(
+                            None, self._prefill_full, token_ids, prompt_table
+                        )
                     else:
-                        self._chunked_resume(token_ids, table, loaded_blocks)
+                        await loop.run_in_executor(
+                            None,
+                            self._chunked_resume,
+                            token_ids,
+                            table,
+                            loaded_blocks,
+                        )
+            prefix_ready_us = (time.perf_counter() - t0) * 1e6
             verified = None
             if self.verify:
                 async with self.gate.shared():
@@ -635,11 +807,35 @@ class ContinuousBatchingHarness:
                 generated=generated,
                 store_io_us=store_io_us,
                 gate_stall_us=gate_stall_us,
+                gate_hold_us=gate_hold_us,
+                fetch_us=fetch_us,
+                overlap_fraction=overlap,
+                prefetched_blocks=(
+                    prefetch.blocks_fetched if prefetch is not None else 0
+                ),
+                wasted_blocks=(
+                    prefetch.wasted_blocks if prefetch is not None else 0
+                ),
+                prefix_ready_us=prefix_ready_us,
             )
             self.stats.append(stats)
             return stats
         finally:
-            await self.pool.free(table)
+            if not prefetch_settled:
+                # Admission died between enqueue and install (cancellation,
+                # alloc backpressure unwound, model error): the speculative
+                # fetch must hand every staging slot back — accounting
+                # returns to baseline, the staged bytes count as waste.
+                # shield(): even if THIS task is being cancelled, the
+                # discard runs to completion (in the background if need be).
+                try:
+                    await asyncio.shield(prefetch.discard())
+                except BaseException:  # noqa: BLE001 - cleanup must not mask
+                    pass
+                self._prefetch_extra_fetched += prefetch.blocks_fetched
+                self._prefetch_extra_wasted += prefetch.wasted_blocks
+            if table is not None:
+                await self.pool.free(table)
             self.live -= 1
 
     async def run(
@@ -669,6 +865,23 @@ class ContinuousBatchingHarness:
         io_hit = sorted(s.store_io_us for s in self.stats if s.loaded_blocks)
         io_miss = sorted(s.store_io_us for s in self.stats if not s.loaded_blocks)
         stall = sorted(s.gate_stall_us for s in self.stats)
+        # Gate HOLD is only meaningful where a load/install ran (hits, or
+        # the one-phase fallback); zeros from pure misses would drown it.
+        hold = sorted(s.gate_hold_us for s in self.stats if s.gate_hold_us > 0)
+        overlaps = [
+            s.overlap_fraction for s in self.stats if s.overlap_fraction is not None
+        ]
+        prefetched = (
+            sum(s.prefetched_blocks for s in self.stats)
+            + self._prefetch_extra_fetched
+        )
+        wasted = (
+            sum(s.wasted_blocks for s in self.stats) + self._prefetch_extra_wasted
+        )
+        ready_hit = sorted(s.prefix_ready_us for s in self.stats if s.loaded_blocks)
+        ready_miss = sorted(
+            s.prefix_ready_us for s in self.stats if not s.loaded_blocks
+        )
 
         def _p(xs, q):
             return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else 0.0
@@ -697,6 +910,22 @@ class ContinuousBatchingHarness:
             "p50_store_io_miss_us": _p(io_miss, 0.50),
             "p50_gate_stall_us": _p(stall, 0.50),
             "p99_gate_stall_us": _p(stall, 0.99),
+            # Two-phase admission: how long the exclusive gate was HELD for
+            # installs (the only store-side phase that still serializes the
+            # device), what fraction of store fetch time ran gate-free
+            # (1.0 = I/O fully hidden), and the speculation's waste ratio
+            # (staged blocks that never reached the device / staged blocks).
+            "p50_gate_hold_us": _p(hold, 0.50),
+            "p99_gate_hold_us": _p(hold, 0.99),
+            "overlap_fraction": (
+                sum(overlaps) / len(overlaps) if overlaps else 0.0
+            ),
+            "prefetch_waste": wasted / prefetched if prefetched else 0.0,
+            "prefetch_fallbacks": self.prefetch_fallbacks,
+            # End-to-end prefix residency split by outcome: the number that
+            # says whether a cache hit actually beats recomputing.
+            "p50_prefix_ready_hit_us": _p(ready_hit, 0.50),
+            "p50_prefix_ready_miss_us": _p(ready_miss, 0.50),
             "recompute_saved_s": loaded * per_block,
             "prefill_per_block_s": per_block,
             "max_live_requests": self.max_live,

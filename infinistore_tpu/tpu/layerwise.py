@@ -15,13 +15,18 @@ Key naming follows the reference's convention of hash-chain keys per block
 """
 
 import asyncio
+import time
 from collections import deque
-from typing import Callable, List, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
-from ..lib import InfiniStoreException
+from ..lib import (
+    InfiniStoreException,
+    InfiniStoreKeyNotFound,
+    InfiniStoreResourcePressure,
+)
 from .paged import PagedKVCacheSpec, gather_blocks, scatter_blocks
 from .staging import HostStagingPool
 
@@ -336,3 +341,418 @@ class LayerwiseKVReader:
             jax.block_until_ready(list(uploads.values()))
             jax.block_until_ready(out)
         return out
+
+
+class PrefetchDiscarded(RuntimeError):
+    """install() was called on a prefetch that was discarded (or the
+    prefetch was discarded out from under a waiter)."""
+
+
+class LayerwisePrefetch:
+    """The two-phase split of :class:`LayerwiseKVReader`: a gate-free FETCH
+    (store -> reserved host staging regions, running the moment the object
+    is constructed) and a short device INSTALL (host -> HBM upload +
+    scatter) the engine runs under its exclusive cache discipline.
+
+    The reader's monolithic ``read`` forces the caller to hold its
+    cache-mutation lock across the whole network fetch; splitting lets the
+    fetch overlap other requests' compute and start speculatively at
+    admission, before the engine has even allocated device blocks — the
+    block table is only needed at :meth:`install`.
+
+    Layout: ``regions`` staging regions, each one contiguous packed
+    [K blocks | V blocks] span, reserved from the pool as ONE lease.
+    Layer L fetches into region ``L % regions``; when ``regions <
+    num_layers`` the pipeline wraps and a region is refilled only after
+    :meth:`install` consumed its occupant (double buffering). Completion
+    per layer feeds install's per-layer loop, so install can stream layer
+    L to the device while layer L+1 is still on the network.
+
+    Cancellation (:meth:`discard`) is safe at ANY point before install:
+    in-flight store reads are drained (they write into leased memory),
+    then the lease is released — pool accounting returns to baseline and
+    the staged bytes are counted as waste (``wasted_blocks``).
+
+    Single event loop: construct, install, and discard from the same
+    running loop (the fetch tasks and consumed-events bind to it)."""
+
+    def __init__(
+        self,
+        conn,
+        pool: HostStagingPool,
+        spec: PagedKVCacheSpec,
+        key_fn: KeyFn,
+        n_blocks: int,
+        num_layers: int,
+        regions: Optional[int] = None,
+        submit=None,
+    ):
+        """``submit(blocks)``: optional override for the store read (the
+        connector's fetch coalescer batches concurrent admissions' reads
+        into shared calls); default is a direct ``read_cache_async``.
+        Raises :class:`~..tpu.staging.StagingPoolExhausted` when the pool
+        cannot hold even a double-buffered pipeline."""
+        self.conn = conn
+        self.pool = pool
+        self.spec = spec
+        self.n_blocks = n_blocks
+        self.num_layers = num_layers
+        self.hit_blocks = n_blocks  # overridden by the connector's lookup
+        self.blocks_fetched = 0  # K+V blocks landed in staging
+        self.blocks_installed = 0  # K+V blocks scattered to the device
+        self.fetch_started_s = time.perf_counter()
+        self.fetch_finished_s: Optional[float] = None
+        self._cancelled = False
+        self._discarded = False
+        self._error: Optional[BaseException] = None  # first store failure
+        self._lease = None
+        if n_blocks == 0:
+            self.regions = 0
+            self._staged: List[asyncio.Future] = []
+            self._consumed: List[asyncio.Event] = []
+            self._drained = asyncio.Event()
+            self._drained.set()
+            self.fetch_finished_s = self.fetch_started_s
+            return
+        bn = spec.block_nbytes
+        # Region stride in whole pool slots (a region is one contiguous
+        # [K | V] span of 2*n_blocks KV blocks).
+        self._region_bytes = 2 * n_blocks * bn
+        slots_per_region = -(-self._region_bytes // pool.block_size)
+        self._region_stride = slots_per_region * pool.block_size
+        want = min(num_layers, 8) if regions is None else regions
+        want = max(2, min(want, num_layers)) if num_layers > 1 else 1
+        # Degrade to a shallower pipeline before giving up: fewer regions
+        # only means more install/fetch handoffs, not less data.
+        lease = None
+        for r in range(want, (1 if num_layers == 1 else 2) - 1, -1):
+            try:
+                lease = pool.reserve(r * slots_per_region)
+                self.regions = r
+                break
+            except Exception:
+                if r <= (1 if num_layers == 1 else 2):
+                    raise
+        self._lease = lease
+        self._submit = submit or (
+            lambda blocks: conn.read_cache_async(blocks, bn, pool.base_ptr)
+        )
+        loop = asyncio.get_running_loop()
+        self._staged = [loop.create_future() for _ in range(num_layers)]
+        for fut in self._staged:
+            # Defensively retrieve exceptions: a prefetch discarded before
+            # install must not spew "exception was never retrieved".
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None
+            )
+        self._consumed = [asyncio.Event() for _ in range(num_layers)]
+        self._installing: set = set()  # layers whose bytes the device reads
+        self._drained = asyncio.Event()
+        self._key_fn = key_fn
+        self._tasks = [
+            asyncio.ensure_future(self._fetch_layer(layer))
+            for layer in range(num_layers)
+        ]
+        self._live = len(self._tasks)
+        for t in self._tasks:
+            t.add_done_callback(self._on_task_done)
+
+    # -- fetch phase (gate-free) --------------------------------------------
+
+    def _region_offset(self, layer: int) -> int:
+        return self._lease.offset + (layer % self.regions) * self._region_stride
+
+    async def _fetch_layer(self, layer: int):
+        if layer >= self.regions:
+            # Double buffering: refill a region only once install consumed
+            # (or discard wrote off) its previous occupant.
+            await self._consumed[layer - self.regions].wait()
+        if self._cancelled:
+            return
+        n, bn = self.n_blocks, self.spec.block_nbytes
+        base = self._region_offset(layer)
+        blocks = [
+            (self._key_fn(layer, "k", i), base + i * bn) for i in range(n)
+        ] + [
+            (self._key_fn(layer, "v", i), base + (n + i) * bn) for i in range(n)
+        ]
+        try:
+            await self._submit(blocks)
+        except asyncio.CancelledError:
+            self._cancel_rest()
+            raise
+        except BaseException as e:
+            if self._error is None:
+                self._error = e
+            if not self._staged[layer].done():
+                self._staged[layer].set_exception(e)
+            # One failing layer dooms the whole prefix (a partial prefix
+            # has no value) — stop refilling regions.
+            self._cancel_rest()
+            return
+        self.blocks_fetched += 2 * n
+        if not self._staged[layer].done():
+            self._staged[layer].set_result(layer % self.regions)
+        if layer == self.num_layers - 1:
+            self.fetch_finished_s = time.perf_counter()
+
+    def _on_task_done(self, task):
+        if not task.cancelled() and task.exception() is not None:
+            # _fetch_layer catches store errors itself; anything here is a
+            # bug or a cancellation-at-teardown — don't lose it silently.
+            self._cancel_rest()
+        self._live -= 1
+        if self._live == 0:
+            self._drained.set()
+            self._maybe_release()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _cancel_rest(self):
+        """Stop refilling regions and write off layers that never staged.
+        Layers that DID stage successfully are NOT written off here: a
+        later install() may still legally read them from the lease, and
+        marking them consumed would release the lease under its feet (a
+        concurrent prefetch could re-reserve and overwrite the slots).
+        They are written off by install()'s own abort paths or discard()
+        — the two places that guarantee no further reads."""
+        if self._cancelled:
+            return
+        self._cancelled = True
+        for fut in self._staged:
+            if not fut.done():
+                fut.cancel()
+        for layer, ev in enumerate(self._consumed):
+            fut = self._staged[layer]
+            staged_ok = fut.done() and not fut.cancelled() and fut.exception() is None
+            if layer not in self._installing and not staged_ok:
+                ev.set()
+
+    def _write_off_uninstalled(self):
+        """Mark every layer the device will never read as consumed (call
+        only when no further install reads can happen: install() aborting,
+        or discard())."""
+        for layer, ev in enumerate(self._consumed):
+            if layer not in self._installing:
+                ev.set()
+        self._maybe_release()
+
+    def _maybe_release(self):
+        if (
+            self._lease is not None
+            and self._drained.is_set()
+            and all(ev.is_set() for ev in self._consumed)
+        ):
+            self._lease.release()
+
+    @property
+    def wasted_blocks(self) -> int:
+        """Blocks fetched into staging that never reached the device —
+        meaningful once the prefetch settled (installed or discarded)."""
+        return max(0, self.blocks_fetched - self.blocks_installed)
+
+    async def primed(self) -> None:
+        """Wait (gate-free) until the fetch pipeline is full: every staging
+        region holds a layer — or every layer is staged, whichever is less.
+        Entering the exclusive install phase before this point would hold
+        the engine's gate across raw network time; after it, install
+        consumes at device speed while any remaining layers fetch into the
+        regions it frees. Store errors do NOT raise here — they surface
+        with proper miss/partial semantics from :meth:`install`."""
+        if self.n_blocks == 0:
+            return
+        idx = min(self.num_layers, self.regions) - 1
+        await asyncio.wait([self._staged[idx]])
+
+    async def discard(self) -> None:
+        """Cancel the prefetch and return every staging slot to the pool.
+        Safe at any point except concurrently with install(); counts the
+        staged-but-never-installed bytes as waste. Idempotent."""
+        self._discarded = True
+        self._cancel_rest()
+        # install() is forbidden from here on, so staged-but-uninstalled
+        # layers can be written off wholesale.
+        self._write_off_uninstalled()
+        await self._drained.wait()
+        for ev in self._consumed:
+            await ev.wait()
+        if self._lease is not None:
+            self._lease.release()
+
+    # -- install phase (device; caller holds its cache-mutation discipline) --
+
+    def _release_region_async(self, layers, uploads, outs, loop):
+        """Mark regions consumed once the device actually copied (or, on
+        the zero-copy CPU backend, finished computing through) their bytes
+        — off-thread, so the caller's gate-held install stays short."""
+        copies = _device_put_copies()
+
+        def wait_and_mark():
+            jax.block_until_ready(uploads)
+            if not copies:
+                jax.block_until_ready(outs)
+
+            def mark():
+                for layer in layers:
+                    self._consumed[layer].set()
+                self._maybe_release()
+
+            try:
+                loop.call_soon_threadsafe(mark)
+            except RuntimeError:
+                # Loop closed at teardown: nothing will reuse the regions;
+                # release the lease directly so the pool is never leaked.
+                for layer in layers:
+                    self._consumed[layer].set()
+                self._maybe_release()
+
+        loop.run_in_executor(None, wait_and_mark)
+
+    async def install(
+        self,
+        caches: Sequence[Tuple[jax.Array, jax.Array]],
+        block_ids: np.ndarray,
+        on_layer=None,
+    ):
+        """Scatter the staged prefix into the engine's paged cache; returns
+        ``(updated caches, blocks_loaded)`` with :meth:`KVConnector.load`'s
+        exact semantics (DONATION of inputs; raced-away blocks -> partial
+        caches and 0 loaded; ``on_layer`` fires per layer in order).
+
+        This is the only phase that needs the engine's exclusive cache
+        gate; per-layer host bytes usually sit staged already, so the hold
+        is device-transfer time, not store time. When every layer is
+        staged in back-to-back regions the whole prefix rides ONE device
+        upload (per-transfer fixed cost dominates tunneled hosts)."""
+        if self._discarded:
+            raise PrefetchDiscarded("install() after discard()")
+        out = list(caches)
+        if self.n_blocks == 0:
+            return out, 0
+        n = self.n_blocks
+        if len(block_ids) != n:
+            raise ValueError(
+                f"install needs exactly the {n} fetched blocks' placement, "
+                f"got {len(block_ids)} block ids"
+            )
+        if len(caches) != self.num_layers:
+            raise ValueError(
+                f"cache list has {len(caches)} layers, prefetch fetched "
+                f"{self.num_layers}"
+            )
+        ids_dev = jax.numpy.asarray(np.asarray(block_ids), jax.numpy.int32)
+        bn = self.spec.block_nbytes
+        dt = np.dtype(jax.numpy.dtype(self.spec.dtype))
+        loop = asyncio.get_running_loop()
+        fused = (
+            self.regions >= self.num_layers
+            and self._region_stride == self._region_bytes
+            and all(f.done() and not f.cancelled() and f.exception() is None
+                    for f in self._staged)
+        )
+        if fused:
+            # Back-to-back regions, fully staged: one packed
+            # [L x (K | V)] span -> ONE H2D transfer for the whole prefix
+            # (per-transfer fixed cost dominates tunneled hosts). The
+            # device work runs in an executor so the EVENT LOOP — and
+            # every other request's in-flight fetch completion — never
+            # stalls behind it; the caller's gate still serializes the
+            # cache mutation across the await.
+            span = self.pool.buf[
+                self._lease.offset : self._lease.offset
+                + self.num_layers * self._region_bytes
+            ]
+            host_all = span.view(dt).reshape(
+                (self.num_layers * 2 * n, *self.spec.block_shape)
+            )
+
+            def dev_all(caches_in):
+                kv_all = jax.device_put(host_all)
+                scattered = []
+                for layer in range(self.num_layers):
+                    base = layer * 2 * n
+                    k_cache, v_cache = caches_in[layer]
+                    scattered.append((
+                        scatter_blocks(k_cache, ids_dev, kv_all[base : base + n]),
+                        scatter_blocks(
+                            v_cache, ids_dev, kv_all[base + n : base + 2 * n]
+                        ),
+                    ))
+                return kv_all, scattered
+
+            kv_all, scattered = await loop.run_in_executor(
+                None, dev_all, list(out)
+            )
+            for layer in range(self.num_layers):
+                out[layer] = scattered[layer]
+                self._installing.add(layer)
+                self.blocks_installed += 2 * n
+                if on_layer is not None:
+                    on_layer(layer, out[layer])
+            self._release_region_async(
+                list(range(self.num_layers)), kv_all, list(out), loop
+            )
+            return out, n
+        for layer in range(self.num_layers):
+            try:
+                await asyncio.shield(self._staged[layer])
+            except asyncio.CancelledError:
+                if not self._staged[layer].cancelled():
+                    raise  # the INSTALLING task was cancelled, not the fetch
+                # A DEEPER layer's store failure cancels shallower pending
+                # futures (completion order is not layer order) — surface
+                # that first error's semantics, not a bogus "discarded".
+                self._write_off_uninstalled()  # no further reads from here
+                err = self._error
+                if err is None:
+                    raise PrefetchDiscarded(
+                        f"prefetch discarded before layer {layer}"
+                    )
+                if isinstance(
+                    err, (InfiniStoreKeyNotFound, InfiniStoreResourcePressure)
+                ):
+                    return out, 0
+                raise PartialReadError(out, err) from err
+            except (InfiniStoreKeyNotFound, InfiniStoreResourcePressure):
+                # Blocks raced away (eviction between lookup and read) or
+                # the store shed load: cache semantics — report a miss, the
+                # engine recomputes. Layers already scattered donated their
+                # inputs, so the partial list is the only valid one.
+                self._cancel_rest()
+                self._write_off_uninstalled()
+                return out, 0
+            except Exception as e:
+                self._cancel_rest()
+                self._write_off_uninstalled()
+                raise PartialReadError(out, e) from e
+            if self._lease is None or self._lease._released:
+                # Belt and braces: never read staging memory after the
+                # lease went back to the pool (another prefetch may own the
+                # slots now) — treat as the miss it semantically is.
+                return out, 0
+            off = self._region_offset(layer)
+            kv_host = (
+                self.pool.buf[off : off + 2 * n * bn]
+                .view(dt)
+                .reshape((2 * n, *self.spec.block_shape))
+            )
+
+            def dev_one(pair, kv_host=kv_host):
+                kv_dev = jax.device_put(kv_host)
+                k_cache, v_cache = pair
+                return kv_dev, (
+                    scatter_blocks(k_cache, ids_dev, kv_dev[:n]),
+                    scatter_blocks(v_cache, ids_dev, kv_dev[n:]),
+                )
+
+            # Off-loop for the same reason as the fused path: upload +
+            # scatter must not freeze other requests' fetch completions.
+            kv_dev, out[layer] = await loop.run_in_executor(
+                None, dev_one, out[layer]
+            )
+            self._installing.add(layer)
+            self.blocks_installed += 2 * n
+            if on_layer is not None:
+                on_layer(layer, out[layer])
+            self._release_region_async([layer], kv_dev, out[layer], loop)
+        return out, n

@@ -24,6 +24,49 @@ import jax
 import numpy as np
 
 
+class StagingPoolExhausted(RuntimeError):
+    """`HostStagingPool.reserve` could not find a contiguous free run.
+
+    Deliberately a distinct type: callers treat exhaustion as backpressure
+    (skip the speculative prefetch, fall back to the gated load path), not
+    as a bug — so it must be catchable without swallowing real errors."""
+
+
+class StagingLease:
+    """A reserved contiguous run of staging-pool slots.
+
+    Handed out by ``HostStagingPool.reserve``; release() (idempotent)
+    returns the slots to the pool. The lease is pure accounting — the pool's
+    buffer is shared, and the lease only guarantees no OTHER reserver gets
+    these slots while it is held."""
+
+    def __init__(self, pool: "HostStagingPool", start_slot: int, num_slots: int):
+        self.pool = pool
+        self.start_slot = start_slot
+        self.num_slots = num_slots
+        self._released = False
+
+    @property
+    def offset(self) -> int:
+        """Byte offset of the lease's first slot within the pool buffer."""
+        return self.start_slot * self.pool.block_size
+
+    def view(self, nbytes: Optional[int] = None) -> np.ndarray:
+        """Zero-copy uint8 view of the leased span (nbytes trims the tail)."""
+        span = self.num_slots * self.pool.block_size
+        if nbytes is not None:
+            if nbytes > span:
+                raise ValueError(f"nbytes {nbytes} > leased span {span}")
+            span = nbytes
+        return self.pool.buf[self.offset : self.offset + span]
+
+    def release(self) -> None:
+        """Return the slots to the pool (idempotent)."""
+        if not self._released:
+            self._released = True
+            self.pool._release_run(self.start_slot, self.num_slots)
+
+
 class StagedTransfer:
     """Handle for in-flight async device->host copies.
 
@@ -118,6 +161,45 @@ class HostStagingPool:
             if conn is not None:
                 conn.register_mr(buf.ctypes.data, nbytes)
         self.buf = buf
+        # Slot reservation state (reserve/release): a per-slot taken flag.
+        # Reservation is OPT-IN — legacy users (_LayerRegions, benches) carve
+        # the pool by fixed layout on a pool they own outright; a pool shared
+        # by reservers must only be used through reserve().
+        self._taken = bytearray(self.num_slots)
+        self._reserved_slots = 0
+
+    @property
+    def slots_in_use(self) -> int:
+        """Slots currently held by unreleased leases (reserve() users)."""
+        return self._reserved_slots
+
+    def reserve(self, slots: int) -> StagingLease:
+        """Reserve a CONTIGUOUS run of ``slots`` slots (first fit).
+
+        Contiguity is what lets a whole leased region ship as one network
+        read and upload as one device transfer. Raises
+        :class:`StagingPoolExhausted` when no run fits — callers treat that
+        as backpressure, not failure."""
+        if slots <= 0:
+            raise ValueError("need slots > 0")
+        run = 0
+        for i in range(self.num_slots):
+            run = 0 if self._taken[i] else run + 1
+            if run == slots:
+                start = i - slots + 1
+                for j in range(start, start + slots):
+                    self._taken[j] = 1
+                self._reserved_slots += slots
+                return StagingLease(self, start, slots)
+        raise StagingPoolExhausted(
+            f"no contiguous run of {slots} slots free "
+            f"({self._reserved_slots}/{self.num_slots} reserved)"
+        )
+
+    def _release_run(self, start_slot: int, num_slots: int) -> None:
+        for j in range(start_slot, start_slot + num_slots):
+            self._taken[j] = 0
+        self._reserved_slots -= num_slots
 
     @property
     def base_ptr(self) -> int:

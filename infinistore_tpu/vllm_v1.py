@@ -61,6 +61,19 @@ import jax
 import numpy as np
 
 from .connector import KVConnector  # noqa: F401 - the canonical surface
+from .tpu.staging import StagingPoolExhausted
+
+
+class KVLoadUnderDelivery(RuntimeError):
+    """A load delivered fewer tokens than the scheduler was promised.
+
+    Stock vLLM counts the promised tokens as computed the moment the
+    scheduler builds the step, so silently under-delivering (a store-side
+    eviction racing the load) would make the engine attend over zero-filled
+    blocks and emit wrong output. Raised from ``wait_for_layer_load`` /
+    ``wait_for_save`` unless the engine opts into the ``loaded_tokens()``
+    recompute protocol (``allow_partial_delivery`` in the connector's extra
+    config)."""
 
 
 class KVConnectorRole(enum.Enum):
@@ -180,6 +193,33 @@ class KVConnectorBase_V1(ABC):
         return False, None
 
 
+def _iter_cached_reqs(cached):
+    """Yield (req_id, new_block_ids, num_computed_tokens, resumed) from
+    vLLM's ``scheduled_cached_reqs``, duck-typing both published shapes: a
+    list of CachedRequestData objects, or the newer struct-of-arrays object
+    with parallel ``req_ids`` / ``new_block_ids`` / ``num_computed_tokens``
+    / ``resumed_from_preemption``."""
+    if cached is None:
+        return
+    req_ids = getattr(cached, "req_ids", None)
+    if req_ids is not None:
+        n = len(req_ids)
+        new_blocks = getattr(cached, "new_block_ids", None) or [None] * n
+        computed = getattr(cached, "num_computed_tokens", None) or [0] * n
+        resumed = (
+            getattr(cached, "resumed_from_preemption", None) or [False] * n
+        )
+        yield from zip(req_ids, new_blocks, computed, resumed)
+        return
+    for r in cached:
+        yield (
+            r.req_id,
+            getattr(r, "new_block_ids", None),
+            getattr(r, "num_computed_tokens", 0),
+            getattr(r, "resumed_from_preemption", False),
+        )
+
+
 def _block_ids_of(blocks) -> np.ndarray:
     """Accept vLLM's KVCacheBlocks (``get_block_ids()`` -> [[ids]]), its
     per-group nested lists ([[ids]], one entry per KV cache group — we
@@ -227,10 +267,29 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
             )
         self.kv = kv
         self.block_tokens = kv.spec.block_tokens
+        # Opt-in to graceful under-delivery: the engine promises to call
+        # loaded_tokens() and recompute the shortfall. Without it, a load
+        # delivering less than promised fails the step loudly
+        # (KVLoadUnderDelivery) — stock vLLM would otherwise attend over
+        # zero-filled blocks.
+        if isinstance(extra, dict):
+            self._allow_partial = bool(extra.get("allow_partial_delivery", False))
+        else:
+            self._allow_partial = bool(
+                getattr(extra, "allow_partial_delivery", False)
+            )
         # scheduler-side per-step state
         self._pending_loads: Dict[str, _LoadSpec] = {}
         self._probed_tokens: Dict[str, int] = {}  # req -> engine-computed blocks
         self._store_hits: Dict[str, int] = {}  # req -> store's hit blocks
+        # scheduler-side per-REQUEST state (persists across steps; cleared
+        # in request_finished): chunked prefill's later chunks arrive via
+        # scheduled_cached_reqs carrying no prompt tokens, so the first
+        # step's data and a saved-block watermark must be remembered or the
+        # tail of a long prompt never reaches the store.
+        self._save_watermark: Dict[str, int] = {}  # req -> blocks saved/stored
+        self._req_tokens: Dict[str, List[int]] = {}
+        self._req_blocks: Dict[str, List[int]] = {}
         # worker-side state
         self._layer_names: List[str] = []
         self._layer_index: Dict[str, int] = {}
@@ -292,11 +351,13 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
 
     def build_connector_meta(self, scheduler_output) -> InfiniStoreConnectorMetadata:
         """Assemble this step's plan: the loads recorded since the last
-        build, plus a save of every newly scheduled request's computed
-        suffix (the loaded prefix is already stored — re-saving it would
-        double write traffic on every hit). Scheduler state resets here:
-        metadata is rebuilt from scratch each step (the published
-        contract's lifecycle)."""
+        build, plus a save of every scheduled request's computed suffix
+        (the loaded prefix is already stored — re-saving it would double
+        write traffic on every hit). PER-STEP scheduler state resets here;
+        per-REQUEST state (the saved-block watermark) persists across
+        steps so a chunked prefill's later chunks — which arrive via
+        ``scheduled_cached_reqs`` with no prompt data — still emit their
+        saves, and is cleared in ``request_finished``."""
         meta = InfiniStoreConnectorMetadata(loads=list(self._pending_loads.values()))
         # Chunked prefill: scheduler_output.num_scheduled_tokens (vLLM's
         # per-request dict) bounds what this step actually computes; only
@@ -317,20 +378,73 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
             # is skipped; blocks the engine computed LOCALLY beyond the
             # store's hit (its own prefix cache outran the store) are saved
             # too, or the store could never learn them.
-            in_store = min(self._store_hits.get(rid, 0), end_blocks)
-            if end_blocks > in_store:
+            hit = self._store_hits.get(rid, 0)
+            start = max(min(hit, end_blocks), self._save_watermark.get(rid, 0))
+            if end_blocks > start:
                 meta.saves.append(
                     _SaveSpec(
                         req_id=rid,
                         token_ids=list(req.prompt_token_ids),
-                        block_ids=ids[in_store:end_blocks],
-                        first_block=in_store,
+                        block_ids=ids[start:end_blocks],
+                        first_block=start,
                     )
                 )
+            # Remember what a resumed (cached) step will need, and advance
+            # the watermark past everything now saved OR already in store.
+            self._req_tokens[rid] = list(req.prompt_token_ids)
+            self._req_blocks[rid] = [int(i) for i in ids]
+            self._save_watermark[rid] = max(hit, end_blocks)
+        for rid, new_ids, num_computed, resumed in _iter_cached_reqs(
+            getattr(scheduler_output, "scheduled_cached_reqs", None)
+        ):
+            tokens = self._req_tokens.get(rid)
+            if tokens is None:
+                continue  # not a request we admitted (or already finished)
+            if resumed:
+                # Preemption freed (and likely re-used) every old physical
+                # block; new_block_ids is the FULL replacement list, not an
+                # extension — appending would misalign logical->physical
+                # and gather other requests' data under this prompt's
+                # chain keys. The watermark survives: already-saved blocks
+                # are content-addressed by tokens and stay valid.
+                self._req_blocks[rid] = []
+            blocks = self._req_blocks.setdefault(rid, [])
+            if new_ids is not None:
+                ext = _block_ids_of(new_ids)
+                if len(ext):
+                    blocks.extend(int(i) for i in ext)
+            end_tokens = len(tokens)
+            if rid in num_sched:
+                end_tokens = min(end_tokens, int(num_computed) + num_sched[rid])
+            end_blocks = min(end_tokens // self.block_tokens, len(blocks))
+            start = self._save_watermark.get(rid, 0)
+            if end_blocks > start:
+                meta.saves.append(
+                    _SaveSpec(
+                        req_id=rid,
+                        token_ids=list(tokens),
+                        block_ids=np.asarray(blocks[start:end_blocks], np.int32),
+                        first_block=start,
+                    )
+                )
+                self._save_watermark[rid] = end_blocks
         self._pending_loads.clear()
         self._probed_tokens.clear()
         self._store_hits.clear()
         return meta
+
+    def request_finished(self, request, block_ids) -> Tuple[bool, Optional[dict]]:
+        """Request left the engine: drop its cross-step tracking (saved-
+        block watermark, remembered prompt/blocks). Saves are synchronous
+        within the step, so blocks never need delayed freeing."""
+        rid = getattr(request, "request_id", None) or getattr(
+            request, "req_id", None
+        )
+        if rid is not None:
+            self._save_watermark.pop(rid, None)
+            self._req_tokens.pop(rid, None)
+            self._req_blocks.pop(rid, None)
+        return False, None
 
     # ======================================================================
     # worker side
@@ -420,14 +534,34 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
 
         async def run_loads():
             try:
+                # Phase 1 — start every request's GATE-FREE fetch now
+                # (KVConnector.start_fetch): the whole wave's store reads
+                # run concurrently and coalesce into shared batched calls
+                # (a StripedConnection splits them across its stripes),
+                # instead of each request's network time queueing behind
+                # the previous request's install. A full staging arena
+                # just drops that request back to the one-phase load.
+                can_fetch = hasattr(self.kv, "start_fetch")
+                handles = []
                 for spec in loads:
-                    # Per-layer installs happen ONLY here: the runner
-                    # thread may concurrently install computed layers via
-                    # save_kv_layer, and a wholesale post-load assignment
-                    # would clobber them with the load-time snapshot. The
-                    # runner's own ordering (wait_for_layer_load(L) before
-                    # computing/saving L) keeps per-layer install order
-                    # consistent.
+                    handle = None
+                    if can_fetch:
+                        try:
+                            handle = self.kv.start_fetch(
+                                spec.token_ids,
+                                first_block=spec.first_block,
+                                limit_blocks=len(spec.block_ids),
+                            )
+                        except StagingPoolExhausted:
+                            handle = None
+                    handles.append(handle)
+                # Phase 2 — install sequentially (each install donates and
+                # replaces the shared cache arrays; two concurrent installs
+                # would scatter into deleted buffers — the engine-harness
+                # DeviceGate exists for the same reason), layer by layer.
+                # Per-layer progress feeds ``wait_for_layer_load``: layer
+                # L's event fires once EVERY request's layer L landed.
+                for spec, handle in zip(loads, handles):
                     fired = set()
 
                     def on_layer(layer, kv, fired=fired):
@@ -440,26 +574,63 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
 
                     with self._kv_lock:
                         caches = list(self._kv_caches)
-                    _out, loaded = await self.kv.load(
-                        spec.token_ids,
-                        caches,
-                        spec.block_ids,
-                        first_block=spec.first_block,
-                        on_layer=on_layer,
-                    )
+                    if handle is not None:
+                        _out, loaded = await handle.install(
+                            caches,
+                            spec.block_ids[: handle.n_blocks],
+                            on_layer=on_layer,
+                        )
+                    else:
+                        _out, loaded = await self.kv.load(
+                            spec.token_ids,
+                            caches,
+                            spec.block_ids,
+                            first_block=spec.first_block,
+                            on_layer=on_layer,
+                        )
                     self._loaded_tokens[spec.req_id] = loaded * self.block_tokens
                     # Settle layers on_layer never reached for THIS spec
                     # (no read at all, or a partial read that failed after
                     # some layers) — decrementing all layers again would
                     # release waits while a later spec's load is still
-                    # scattering into the same arrays.
+                    # scattering into the same arrays. A hook-less return
+                    # may still have REPLACED a layer's arrays (donation:
+                    # e.g. the quantized connector's scales-race degrade
+                    # path donates every layer and returns 0) — install the
+                    # returned refs, or _kv_caches keeps pointing at
+                    # deleted TPU buffers for the rest of the step.
                     for layer in range(num_layers):
                         if layer not in fired:
+                            if _out is not None and _out[layer] is not caches[layer]:
+                                with self._kv_lock:
+                                    self._kv_caches[layer] = tuple(_out[layer])
                             remaining[layer] -= 1
                             if remaining[layer] == 0:
                                 self._load_done[layer].set()
+                    if (
+                        loaded * self.block_tokens < spec.num_tokens
+                        and not self._allow_partial
+                    ):
+                        # The scheduler already counted the promise as
+                        # computed; silently delivering less would make the
+                        # engine attend over zero-filled blocks.
+                        raise KVLoadUnderDelivery(
+                            f"request {spec.req_id!r}: promised "
+                            f"{spec.num_tokens} external tokens, delivered "
+                            f"{loaded * self.block_tokens} (raced eviction?). "
+                            "Opt into the loaded_tokens() recompute protocol "
+                            "with allow_partial_delivery=True if the engine "
+                            "recomputes shortfalls."
+                        )
             except BaseException as e:  # noqa: BLE001 - surfaced by waits
                 self._load_error = e
+                # Unconsumed prefetches must hand their staging slots back.
+                for h in handles:
+                    if h is not None and h.blocks_installed == 0 and h.n_blocks:
+                        try:
+                            await h.discard()
+                        except Exception:
+                            pass
             finally:
                 for ev in self._load_done:
                     ev.set()
@@ -522,6 +693,10 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
         if self._load_future is not None:
             self._load_future.result()
             self._load_future = None
+        if self._load_error is not None:
+            # A failed or under-delivered load must not slip past the step
+            # boundary just because no later layer wait observed it.
+            raise RuntimeError("KV load failed this step") from self._load_error
         try:
             for f in self._save_futures:
                 f.result()

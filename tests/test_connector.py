@@ -157,6 +157,23 @@ def test_writer_commits_layer0_last(connector, conn):
     assert min(layer0_positions) > max(others)
 
 
+def test_stage_layer_save_validates_first_block(connector):
+    """stage_layer_save applies the same first_block bounds contract as
+    save()/load(): out of range raises instead of silently slicing to an
+    empty chain list and returning a no-op ship (which would hide caller
+    bugs save() fails loudly on)."""
+    tokens = list(range(16))  # 2 complete blocks
+    kv_pair = _rand_caches(7)[0]
+    ids = np.array([0, 1], dtype=np.int32)
+    with pytest.raises(ValueError, match="first_block"):
+        connector.stage_layer_save(tokens, 0, kv_pair, ids, first_block=3)
+    with pytest.raises(ValueError, match="first_block"):
+        connector.stage_layer_save(tokens, 0, kv_pair, ids, first_block=-1)
+    # The boundary value (== block count) is legal: an empty-span no-op.
+    ship = connector.stage_layer_save(tokens, 0, kv_pair, ids, first_block=2)
+    assert asyncio.run(ship()) == 0
+
+
 def test_drop_removes_all_layers(connector, conn):
     tokens = list(range(16))
     caches = _rand_caches(4)

@@ -441,6 +441,44 @@ def test_device_gate_excludes_mutators():
     asyncio.run(run())
 
 
+def test_device_gate_expedite_jumps_queued_writers():
+    """An expedited exclusive (a prefix INSTALL — short, device-transfer
+    bound) queued behind a normal exclusive (a prefill) must acquire first
+    when the gate frees: installs arrive late by construction (their fetch
+    runs gate-free first), so FIFO would park every cache hit behind a
+    convoy of misses' prefills."""
+
+    async def run():
+        gate = DeviceGate()
+        order = []
+
+        async def holder():
+            async with gate.exclusive():
+                order.append("hold")
+                await asyncio.sleep(0.03)
+
+        async def normal():
+            async with gate.exclusive():
+                order.append("prefill")
+
+        async def install():
+            async with gate.exclusive(expedite=True):
+                order.append("install")
+
+        h = asyncio.ensure_future(holder())
+        await asyncio.sleep(0.005)
+        n1 = asyncio.ensure_future(normal())
+        n2 = asyncio.ensure_future(normal())
+        await asyncio.sleep(0.005)
+        i1 = asyncio.ensure_future(install())  # arrives LAST...
+        await asyncio.gather(h, n1, n2, i1)
+        assert order[0] == "hold"
+        assert order[1] == "install", order  # ...acquires first
+        assert sorted(order[2:]) == ["prefill", "prefill"]
+
+    asyncio.run(asyncio.wait_for(run(), 10))
+
+
 def test_device_gate_cancelled_writer_releases_queued_readers():
     """A reader queued behind a WAITING writer must wake when that writer's
     task is cancelled (e.g. a timed-out request) — not sleep forever on a

@@ -77,11 +77,11 @@ def _connect(server):
     return c
 
 
-def _vllm_config(kv: KVConnector):
+def _vllm_config(kv: KVConnector, **extra):
     """Duck-typed vllm_config: kv_transfer_config.kv_connector_extra_config."""
 
     class KTC:
-        kv_connector_extra_config = {"kv_connector": kv}
+        kv_connector_extra_config = {"kv_connector": kv, **extra}
 
     class Cfg:
         kv_transfer_config = KTC()
@@ -89,10 +89,10 @@ def _vllm_config(kv: KVConnector):
     return Cfg()
 
 
-def _connector(server, model_id: str, role: KVConnectorRole):
+def _connector(server, model_id: str, role: KVConnectorRole, **extra):
     conn = _connect(server)
     kv = KVConnector(conn, SPEC, model_id, max_blocks=MAX_BLOCKS)
-    c = InfiniStoreKVConnectorV1(_vllm_config(kv), role)
+    c = InfiniStoreKVConnectorV1(_vllm_config(kv, **extra), role)
     return c, conn
 
 
@@ -496,14 +496,17 @@ def test_v1_composes_with_cluster_pool(server):
 
 
 def test_raced_eviction_degrades_to_recompute(server):
-    """Keys deleted between the scheduler's probe and the worker's load:
-    the load must settle every layer wait and report loaded_tokens == 0 —
+    """Keys deleted between the scheduler's probe and the worker's load,
+    with the engine OPTED INTO the loaded_tokens() recompute protocol: the
+    load must settle every layer wait and report loaded_tokens == 0 —
     cache semantics (the engine recomputes), never a hang or stale bytes."""
     prompt = list(range(10))
     sched_p, worker_p = _produce(server, "v1-race", prompt, [0, 1], seed=7)
 
     sched, _ = _connector(server, "v1-race", KVConnectorRole.SCHEDULER)
-    worker, _ = _connector(server, "v1-race", KVConnectorRole.WORKER)
+    worker, _ = _connector(
+        server, "v1-race", KVConnectorRole.WORKER, allow_partial_delivery=True
+    )
     req = Request("rr", prompt)
     external, _ = sched.get_num_new_matched_tokens(req, 0)
     assert external == 8
@@ -525,3 +528,203 @@ def test_raced_eviction_degrades_to_recompute(server):
         assert not np.asarray(k).any() and not np.asarray(v).any()
     for c in (sched_p, worker_p, sched, worker):
         c.kv.conn.close()
+
+
+def test_under_delivery_raises_without_opt_in(server):
+    """WITHOUT the loaded_tokens() opt-in, a load delivering less than the
+    scheduler was promised must fail the step loudly — stock vLLM already
+    counted the promise as computed and would silently attend over
+    zero-filled blocks."""
+    from infinistore_tpu.vllm_v1 import KVLoadUnderDelivery
+
+    prompt = list(range(10))
+    sched_p, worker_p = _produce(server, "v1-strict", prompt, [0, 1], seed=12)
+
+    sched, _ = _connector(server, "v1-strict", KVConnectorRole.SCHEDULER)
+    worker, _ = _connector(server, "v1-strict", KVConnectorRole.WORKER)
+    req = Request("ru", prompt)
+    external, _ = sched.get_num_new_matched_tokens(req, 0)
+    assert external == 8
+    sched.update_state_after_alloc(req, [[2, 3]], external)
+    meta = sched.build_connector_meta(
+        SchedulerOutput([NewRequestData("ru", prompt, [[2, 3]])])
+    )
+    assert worker_p.kv.drop(prompt) > 0  # the race
+    zero = {
+        name: (jnp.zeros((SPEC.num_blocks, *SPEC.block_shape), jnp.float32),
+               jnp.zeros((SPEC.num_blocks, *SPEC.block_shape), jnp.float32))
+        for name in LAYERS
+    }
+    worker.register_kv_caches(zero)
+    worker.bind_connector_metadata(meta)
+    worker.start_load_kv(forward_context=None)
+    with pytest.raises(RuntimeError) as ei:
+        for name in LAYERS:
+            worker.wait_for_layer_load(name)
+        worker.wait_for_save()
+    assert isinstance(
+        ei.value if isinstance(ei.value, KVLoadUnderDelivery) else ei.value.__cause__,
+        KVLoadUnderDelivery,
+    )
+    worker.clear_connector_metadata()
+    for c in (sched_p, worker_p, sched, worker):
+        c.kv.conn.close()
+
+
+@dataclass
+class CachedRequestData:
+    """Duck-typed vLLM CachedRequestData: a resumed request's step carries
+    no prompt tokens — only ids, newly allocated blocks, and progress."""
+
+    req_id: str
+    new_block_ids: List[List[int]]
+    num_computed_tokens: int
+    resumed_from_preemption: bool = False
+
+
+def test_chunked_prefill_resumed_chunks_are_saved(server):
+    """A long prompt chunked over several steps: chunks after the first
+    arrive via scheduled_cached_reqs (no prompt data). The per-request
+    saved-block watermark must carry across steps so EVERY computed block
+    reaches the store — and be cleared at request_finished."""
+    prompt = list(range(400, 412))  # 3 blocks, cold
+    sched, _ = _connector(server, "v1-resume", KVConnectorRole.SCHEDULER)
+    worker, _ = _connector(server, "v1-resume", KVConnectorRole.WORKER)
+    req = Request("rz", prompt)
+    assert sched.get_num_new_matched_tokens(req, 0)[0] == 0
+    sched.update_state_after_alloc(req, [[0, 1, 2]], 0)
+    # Step 1: the new request computes 1 of 3 blocks.
+    out1 = SchedulerOutput([NewRequestData("rz", prompt, [[0, 1, 2]])])
+    out1.num_scheduled_tokens = {"rz": 4}
+    meta1 = sched.build_connector_meta(out1)
+    assert [list(s.block_ids) for s in meta1.saves] == [[0]]
+    caches = _filled_caches([0, 1, 2], 3, seed=13)
+    _worker_step(worker, meta1, dict(zip(LAYERS, caches)))
+    # Step 2: the SAME request resumes via scheduled_cached_reqs — 8 more
+    # tokens complete blocks 1 and 2. Without the watermark these blocks
+    # would silently never be saved (the seed behavior).
+    out2 = SchedulerOutput([])
+    out2.scheduled_cached_reqs = [CachedRequestData("rz", [[]], 4)]
+    out2.num_scheduled_tokens = {"rz": 8}
+    meta2 = sched.build_connector_meta(out2)
+    assert len(meta2.loads) == 0
+    assert len(meta2.saves) == 1
+    assert meta2.saves[0].first_block == 1
+    assert list(meta2.saves[0].block_ids) == [1, 2]
+    _worker_step(worker, meta2, dict(zip(LAYERS, caches)))
+    probe = _connect(server)
+    probe_kv = KVConnector(probe, SPEC, "v1-resume", max_blocks=MAX_BLOCKS)
+    assert probe_kv.lookup(prompt) == 3, "resumed chunks never reached the store"
+    probe.close()
+    # request_finished clears the watermark (no unbounded growth, and a
+    # reused request id starts fresh).
+    assert sched.request_finished(req, [[0, 1, 2]]) == (False, None)
+    assert "rz" not in sched._save_watermark
+    # A third step for the (finished) request emits nothing.
+    out3 = SchedulerOutput([])
+    out3.scheduled_cached_reqs = [CachedRequestData("rz", [[]], 12)]
+    meta3 = sched.build_connector_meta(out3)
+    assert meta3.saves == []
+    for c in (sched, worker):
+        c.kv.conn.close()
+
+
+def test_preemption_resume_replaces_block_list(server):
+    """resumed_from_preemption=True means the old physical blocks were
+    freed and new_block_ids is the FULL replacement list: the tracker must
+    REPLACE, not append — appending would emit saves that gather other
+    requests' data from the recycled blocks under this prompt's chain
+    keys. The saved-block watermark survives (already-saved blocks are
+    content-addressed by tokens, still valid)."""
+    prompt = list(range(500, 512))  # 3 blocks, cold
+    sched, _ = _connector(server, "v1-preempt", KVConnectorRole.SCHEDULER)
+    req = Request("rp2", prompt)
+    assert sched.get_num_new_matched_tokens(req, 0)[0] == 0
+    sched.update_state_after_alloc(req, [[0, 1, 2]], 0)
+    out1 = SchedulerOutput([NewRequestData("rp2", prompt, [[0, 1, 2]])])
+    out1.num_scheduled_tokens = {"rp2": 4}  # step 1 computes block 0
+    meta1 = sched.build_connector_meta(out1)
+    assert [list(s.block_ids) for s in meta1.saves] == [[0]]
+    # Preempted; resumed later with a completely new physical placement.
+    out2 = SchedulerOutput([])
+    out2.scheduled_cached_reqs = [
+        CachedRequestData("rp2", [[5, 6, 7]], 4, resumed_from_preemption=True)
+    ]
+    out2.num_scheduled_tokens = {"rp2": 8}  # completes blocks 1 and 2
+    meta2 = sched.build_connector_meta(out2)
+    assert len(meta2.saves) == 1
+    assert meta2.saves[0].first_block == 1
+    assert list(meta2.saves[0].block_ids) == [6, 7], (
+        "resume must save from the REPLACEMENT block list, not the stale one"
+    )
+    sched.request_finished(req, [[5, 6, 7]])
+    sched.kv.conn.close()
+
+
+def test_hookless_donating_load_installs_returned_caches(server):
+    """A connector whose load DONATES the cache buffers but fires no
+    on_layer hooks (the quantized connector's scales-race degrade path
+    returns 0 after donating every layer): the worker must install the
+    returned per-layer arrays — dropping them leaves _kv_caches pointing
+    at deleted TPU buffers for the rest of the step."""
+
+    class DonatingKV:
+        """KVConnector-shaped; load replaces every layer, fires no hooks,
+        reports 0 loaded (degrade path). No start_fetch: exercises the
+        one-phase branch the degrade path actually takes."""
+
+        spec = SPEC
+
+        def lookup(self, token_ids):
+            return 2
+
+        async def load(self, token_ids, caches, block_ids, first_block=0,
+                       on_layer=None):
+            replaced = [
+                (k + jnp.float32(1.0), v + jnp.float32(1.0)) for k, v in caches
+            ]
+            return replaced, 0
+
+        def stage_layer_save(self, *a, **kw):
+            async def noop():
+                return 0
+
+            return noop
+
+    kv = DonatingKV()
+    worker = InfiniStoreKVConnectorV1(
+        _vllm_config(kv, allow_partial_delivery=True), KVConnectorRole.WORKER
+    )
+    zero = {
+        name: (jnp.zeros((SPEC.num_blocks, *SPEC.block_shape), jnp.float32),
+               jnp.zeros((SPEC.num_blocks, *SPEC.block_shape), jnp.float32))
+        for name in LAYERS
+    }
+    originals = {name: zero[name] for name in LAYERS}
+    worker.register_kv_caches(zero)
+    from infinistore_tpu.vllm_v1 import _LoadSpec
+
+    meta = InfiniStoreConnectorMetadata(
+        loads=[
+            _LoadSpec(
+                req_id="dq",
+                token_ids=list(range(8)),
+                block_ids=np.array([1, 2], np.int32),
+                num_tokens=8,
+                first_block=0,
+            )
+        ]
+    )
+    worker.bind_connector_metadata(meta)
+    worker.start_load_kv(forward_context=None)
+    for name in LAYERS:
+        worker.wait_for_layer_load(name)
+        k, v = worker.kv_cache(name)
+        # The donated replacements (all-ones) were installed, not the
+        # stale originals.
+        assert k is not originals[name][0]
+        assert float(np.asarray(k)[0, 0, 0, 0]) == 1.0
+    worker.wait_for_save()
+    assert worker.loaded_tokens("dq") == 0
+    worker.clear_connector_metadata()
+    worker.close()
