@@ -149,6 +149,11 @@ lib.its_conn_drain_completions.argtypes = [
     c_void_p, POINTER(c_uint64), POINTER(c_int32), c_int,
 ]
 lib.its_conn_drain_completions.restype = c_int
+# Wakeup-coalescing counters: ring pushes vs eventfd writes (empty->non-empty
+# transitions only), the completion_batch_size numerator/denominator.
+lib.its_conn_completion_counters.argtypes = [
+    c_void_p, POINTER(c_uint64), POINTER(c_uint64),
+]
 
 # ---- mempool (unit-test surface) ----
 lib.its_mm_create.argtypes = [c_uint64, c_uint64, c_int]

@@ -44,6 +44,12 @@ def parse_args(argv=None):
              "see docs/multistream.md)",
     )
     p.add_argument(
+        "--adaptive", action=argparse.BooleanOptionalAction, default=True,
+        help="striped fan-out mode: adaptive work-stealing chunk scheduler "
+             "(default) vs the legacy static 1/N split (--no-adaptive, for "
+             "A/B comparison of the two data planes)",
+    )
+    p.add_argument(
         "--wave", type=int, default=0,
         help="also measure admission-wave read coalescing: N concurrent "
              "requests' reads issued as N separate calls vs merged into one "
@@ -61,8 +67,15 @@ def parse_args(argv=None):
 
 
 def _measure_latency(conn, samples: int = 200) -> dict:
-    """p50/p99 single-block fetch latency at 4KB and 64KB."""
+    """p50/p99 single-block fetch latency at 4KB and 64KB.
+
+    Sync (read_cache, the low-latency API: the calling thread blocks on the
+    native completion) and async samples are taken in short INTERLEAVED
+    chunks — hosts swing between seconds, and the async-minus-sync delta
+    (``async_overhead_us``) only means 'bridge cost' when both paths saw
+    the same weather (same discipline as bench.py's _fetch_latency_us)."""
     out = {}
+    chunk = 50
     for size in (4 << 10, 64 << 10):
         buf = np.random.randint(0, 256, size=size, dtype=np.uint8)
         dst = np.zeros_like(buf)
@@ -70,30 +83,40 @@ def _measure_latency(conn, samples: int = 200) -> dict:
         conn.register_mr(dst)
         key = f"lat-{uuid.uuid4().hex[:8]}"
 
-        async def sample():
-            await conn.write_cache_async([(key, 0)], size, buf.ctypes.data)
-            await conn.read_cache_async([(key, 0)], size, dst.ctypes.data)  # warm
+        async def async_chunk(k):
             lats = []
-            for _ in range(samples):
+            for _ in range(k):
                 t0 = time.perf_counter()
                 await conn.read_cache_async([(key, 0)], size, dst.ctypes.data)
                 lats.append((time.perf_counter() - t0) * 1e6)
             return lats
 
-        lats = sorted(asyncio.run(sample()))
-        # Sync path (read_cache): the low-latency API — the calling thread
-        # blocks on the native completion, skipping the asyncio hop.
+        async def seed():
+            await conn.write_cache_async([(key, 0)], size, buf.ctypes.data)
+            await conn.read_cache_async([(key, 0)], size, dst.ctypes.data)
+
+        asyncio.run(seed())  # write + warm the async path
+        conn.read_cache([(key, 0)], size, dst.ctypes.data)  # warm sync
+        lats = []
         sync_lats = []
-        for _ in range(samples):
-            t0 = time.perf_counter()
-            conn.read_cache([(key, 0)], size, dst.ctypes.data)
-            sync_lats.append((time.perf_counter() - t0) * 1e6)
+        for _ in range(max(1, samples // chunk)):
+            for _ in range(chunk):
+                t0 = time.perf_counter()
+                conn.read_cache([(key, 0)], size, dst.ctypes.data)
+                sync_lats.append((time.perf_counter() - t0) * 1e6)
+            lats += asyncio.run(async_chunk(chunk))
+        lats.sort()
         sync_lats.sort()
+        p50 = lats[len(lats) // 2]
+        sync_p50 = sync_lats[len(sync_lats) // 2]
         out[f"fetch_{size >> 10}kb"] = {
-            "p50_us": round(lats[len(lats) // 2], 1),
+            "p50_us": round(p50, 1),
             "p99_us": round(lats[int(len(lats) * 0.99)], 1),
-            "sync_p50_us": round(sync_lats[len(sync_lats) // 2], 1),
+            "sync_p50_us": round(sync_p50, 1),
             "sync_p99_us": round(sync_lats[int(len(sync_lats) * 0.99)], 1),
+            # The asyncio bridge's whole per-op tax in one number; its floor
+            # is the eventfd loop wake (bench.py asyncio_efd_floor_us).
+            "async_overhead_us": round(p50 - sync_p50, 1),
         }
         conn.delete_keys([key])
     return out
@@ -180,7 +203,7 @@ def run(args) -> dict:
         enable_shm=args.pacing_mbps == 0,
     )
     if args.streams > 1:
-        conn = StripedConnection(cfg, streams=args.streams)
+        conn = StripedConnection(cfg, streams=args.streams, adaptive=args.adaptive)
     else:
         conn = InfinityConnection(cfg)
     conn.connect()
@@ -239,6 +262,16 @@ def run(args) -> dict:
             result["coalescing"] = _measure_wave_coalescing(
                 conn, keys, offsets, block_size, dst, args.wave
             )
+        if args.type == "rdma":
+            # Wakeup coalescing over the whole run (native ring pushes vs
+            # eventfd signals; >1 means pipelined ops shared loop wakes).
+            result["completion_batch_size"] = round(
+                conn.completion_stats()["completion_batch_size"], 2
+            )
+        if args.streams > 1:
+            # Adaptive scheduler receipt: per-stripe chunk/block counts,
+            # steals, EWMA rates, and same-host collapse count.
+            result["striping"] = conn.data_plane_stats()
         conn.delete_keys(keys)
         return result
     finally:

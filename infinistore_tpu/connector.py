@@ -56,6 +56,76 @@ def token_chain_hashes(token_ids: Sequence[int], block_tokens: int) -> List[str]
     return hashes
 
 
+class _ChainHashCache:
+    """Incremental chain-hash cache for repeated/extended token prefixes.
+
+    Chain hashes commit to the whole prefix, so an unchanged prefix yields
+    byte-identical hashes call after call — yet every connector entry point
+    (lookup, load, save, start_fetch, per-layer saves) re-ran one sha256
+    update PER BLOCK per call. This caches the last prompt's full-block
+    tokens, its chain list, and the live sha256 state after the final full
+    block:
+
+    - same prompt again        -> one array compare, zero hashing
+    - the cached prompt's own  -> a slice of the cached chains (hash_i only
+      prefix (fewer blocks)       depends on tokens [0, (i+1)*block); the
+                                  cache keeps the LONGER chain)
+    - extended prompt          -> hash only the new tail blocks (decode
+                                  steps growing a prompt block by block pay
+                                  O(new), not O(total))
+    - anything else            -> full recompute, cache replaced
+
+    One entry only, held as ONE tuple read once and swapped atomically
+    (the GIL makes the swap safe; sync lookups may run from concurrent
+    threads — same discipline as InfinityConnection's match-blob cache):
+    admission churn alternating between two prompt families costs a
+    recompute, never a wrong hash."""
+
+    __slots__ = ("_state",)
+
+    def __init__(self):
+        # (block_tokens, full-block tokens ndarray, chain hashes, sha256
+        # state after the last cached full block) — or None before first use.
+        self._state: Optional[tuple] = None
+
+    def hashes(self, token_ids: Sequence[int], block_tokens: int) -> List[str]:
+        n_full = len(token_ids) // block_tokens
+        if n_full == 0:
+            return []
+        # copy=True matters: for ndarray inputs asarray would keep a VIEW of
+        # the caller's buffer, and an engine reusing that buffer for the next
+        # prompt would mutate our cached tokens into falsely matching it —
+        # returning the OLD prompt's hashes (another request's KV keys).
+        toks = np.array(token_ids[: n_full * block_tokens], dtype=np.int64, copy=True)
+        state = self._state  # one read: threads race the swap, never a tear
+        if state is not None and state[0] == block_tokens:
+            _, c_toks, c_hashes, c_h = state
+            if toks.size <= c_toks.size and np.array_equal(
+                toks, c_toks[: toks.size]
+            ):
+                # Repeat or prefix of the cached prompt: pure cache read
+                # (keep the longer entry — serving its prefixes is free).
+                return c_hashes[:n_full]
+            if toks.size > c_toks.size and np.array_equal(
+                toks[: c_toks.size], c_toks
+            ):
+                # Extension: hash only the new tail blocks.
+                h = c_h.copy()
+                hashes = list(c_hashes)
+                for i in range(len(hashes), n_full):
+                    h.update(toks[i * block_tokens : (i + 1) * block_tokens].tobytes())
+                    hashes.append(h.copy().hexdigest()[:32])
+                self._state = (block_tokens, toks, hashes, h)  # atomic swap
+                return list(hashes)
+        h = hashlib.sha256()
+        hashes = []
+        for i in range(n_full):
+            h.update(toks[i * block_tokens : (i + 1) * block_tokens].tobytes())
+            hashes.append(h.copy().hexdigest()[:32])
+        self._state = (block_tokens, toks, hashes, h)  # atomic swap
+        return list(hashes)
+
+
 class FetchCoalescer:
     """Merge store reads issued in the same event-loop tick into ONE
     batched ``read_cache_async`` call.
@@ -67,12 +137,27 @@ class FetchCoalescer:
     burst of admissions shares the stripes instead of queueing serially.
 
     All submitters must target the same base pointer (one staging pool)
-    and block size; the coalescer only merges, it never copies."""
+    and block size; the coalescer only merges, it never copies.
 
-    def __init__(self, conn, block_size: int, base_ptr: int):
+    Merges are SIZED to the connection's fan-out: a striped connection
+    reports ``preferred_fanout_blocks()`` (every stripe's maximum per-trip
+    pull — more blocks in one call adds no parallelism), and a tick's
+    submissions are packed into merged calls of at most that many blocks,
+    issued concurrently. This keeps a mega-wave's failure isolation at
+    group granularity (one evicted key re-splits its group, not the whole
+    wave) without giving up the per-call amortization merging exists for.
+    Unstriped connections report no hint and keep the single-merge
+    behavior."""
+
+    def __init__(self, conn, block_size: int, base_ptr: int,
+                 max_merge_blocks: Optional[int] = None):
         self.conn = conn
         self.block_size = block_size
         self.base_ptr = base_ptr
+        if max_merge_blocks is None:
+            hint = getattr(conn, "preferred_fanout_blocks", None)
+            max_merge_blocks = hint() if callable(hint) else 0
+        self.max_merge_blocks = max_merge_blocks or 0  # 0 = unbounded
         self._pending: list = []
         self._flush_scheduled = False
         # Strong refs: the loop holds only weak refs to tasks (same
@@ -95,6 +180,23 @@ class FetchCoalescer:
             task.add_done_callback(self._flush_tasks.discard)
         return fut
 
+    def _group(self, batch):
+        """Pack this tick's submissions into merged-call groups of at most
+        ``max_merge_blocks`` blocks (a single oversized submission still
+        rides alone — the data plane chunks it internally)."""
+        if not self.max_merge_blocks:
+            return [batch]
+        groups, cur, cur_blocks = [], [], 0
+        for blocks, fut in batch:
+            if cur and cur_blocks + len(blocks) > self.max_merge_blocks:
+                groups.append(cur)
+                cur, cur_blocks = [], 0
+            cur.append((blocks, fut))
+            cur_blocks += len(blocks)
+        if cur:
+            groups.append(cur)
+        return groups
+
     async def _flush(self):
         # One yield: everything enqueued this tick joins the batch.
         await asyncio.sleep(0)
@@ -102,6 +204,9 @@ class FetchCoalescer:
         self._flush_scheduled = False
         if not batch:
             return
+        await asyncio.gather(*(self._issue(g) for g in self._group(batch)))
+
+    async def _issue(self, batch):
         self.calls += 1
         self.max_batch = max(self.max_batch, len(batch))
         merged = [b for blocks, _ in batch for b in blocks]
@@ -113,7 +218,7 @@ class FetchCoalescer:
                 if not fut.done():
                     fut.set_exception(e)
                 return
-            # One member's evicted key must not doom its wave-mates: retry
+            # One member's evicted key must not doom its group-mates: retry
             # each submission alone so only the genuinely missing one fails.
             for blocks, fut in batch:
                 if fut.done():
@@ -185,6 +290,12 @@ class KVConnector:
         # engines on the pipelined path pay for it.
         self._prefetch_pool: Optional[HostStagingPool] = None
         self._coalescer: Optional[FetchCoalescer] = None
+        # Chain-hash + sentinel-key caches: admission re-derives the same
+        # prefix's keys on every lookup/load/save (satellite of the adaptive
+        # data-plane PR; BENCH_r05 put the 256-chain lookup at 26.1us with
+        # the hashing/keying on top of it).
+        self._chain_cache = _ChainHashCache()
+        self._keys0_cache: Optional[Tuple[List[str], List[str]]] = None
 
     def _require_store(self, what: str):
         if self.conn is None:
@@ -205,6 +316,29 @@ class KVConnector:
 
         return key_fn
 
+    def _chains(self, token_ids: Sequence[int]) -> List[str]:
+        """Chain hashes for this prompt's complete blocks, served from the
+        incremental cache (repeat prefixes are an array compare; extensions
+        hash only their tail)."""
+        return self._chain_cache.hashes(token_ids, self.spec.block_tokens)
+
+    def _sentinel_keys(self, chains: List[str]) -> List[str]:
+        """Layer-0 K keys for a chain (the whole-block presence sentinels
+        lookups send). Cached: because chain hash i commits to the entire
+        prefix, a match on length + final hash proves the whole key list is
+        the cached one — repeated admissions of a hot prefix skip N string
+        formats per call, and a shorter chain is served as a slice of a
+        cached longer one."""
+        cached = self._keys0_cache
+        n = len(chains)
+        if cached is not None:
+            c_chains, c_keys = cached
+            if len(c_chains) >= n and c_chains[n - 1] == chains[-1]:
+                return c_keys[:n]
+        keys = [self.block_key(0, "k", c) for c in chains]
+        self._keys0_cache = (list(chains), keys)
+        return keys
+
     # -- engine surface ------------------------------------------------------
 
     def lookup(self, token_ids: Sequence[int]) -> int:
@@ -222,12 +356,12 @@ class KVConnector:
         own exceptions, reference lib.py:575-577).
         """
         self._require_store("lookup")
-        return self._lookup_chains(token_chain_hashes(token_ids, self.spec.block_tokens))
+        return self._lookup_chains(self._chains(token_ids))
 
     def _lookup_chains(self, chains: List[str]) -> int:
         if not chains:
             return 0
-        keys = [self.block_key(0, "k", c) for c in chains]
+        keys = self._sentinel_keys(chains)
         try:
             return self.conn.get_match_last_index(keys) + 1
         except InfiniStoreNoMatch:
@@ -246,7 +380,7 @@ class KVConnector:
         whole prefix) but saves just its logical span. The spans compose:
         once every shard saved, a consumer's lookup sees the whole prefix."""
         self._require_store("save")
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         if first_block < 0 or first_block > len(chains):
             raise ValueError(
                 f"first_block={first_block} outside the prompt's "
@@ -283,7 +417,7 @@ class KVConnector:
         the vLLM-v1 worker's ``wait_for_layer_load`` gates on.
         """
         self._require_store("load")
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         if first_block < 0 or first_block > len(chains):
             raise ValueError(
                 f"first_block={first_block} outside the prompt's "
@@ -342,7 +476,7 @@ class KVConnector:
         called from a running event loop (the loop the install/discard
         will run on)."""
         self._require_store("start_fetch")
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         if first_block < 0 or first_block > len(chains):
             raise ValueError(
                 f"first_block={first_block} outside the prompt's "
@@ -418,7 +552,7 @@ class KVConnector:
 
         from .tpu.paged import gather_blocks
 
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         if first_block < 0 or first_block > len(chains):
             # Same bounds contract as save()/load(): an out-of-range
             # first_block would silently slice to an empty chain list and
@@ -498,7 +632,7 @@ class KVConnector:
         # blocks (an incomplete tail block has no chain key, so the DCN path
         # could never carry it — the ICI path must agree or a cross-mesh
         # fallback would silently serve different data).
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         n = min(len(src_block_ids), len(dst_block_ids), len(chains))
         if n == 0:
             return list(caches), 0
@@ -559,7 +693,7 @@ class KVConnector:
         """Remove this prompt's blocks from the store (all layers). Returns
         the number of store keys deleted."""
         self._require_store("drop")
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        chains = self._chains(token_ids)
         keys = [
             self.block_key(layer, kind, c)
             for layer in range(self.spec.num_layers)

@@ -22,7 +22,9 @@ import json
 import os
 import socket
 import threading
+import time
 import weakref
+from collections import deque
 from dataclasses import dataclass
 from typing import List, Optional, Tuple, Union
 
@@ -241,6 +243,11 @@ class InfinityConnection:
         self._reader_loops = weakref.WeakSet()  # loops with add_reader(_efd)
         self._drain_tokens = (ctypes.c_uint64 * _DRAIN_CAP)()
         self._drain_codes = (ctypes.c_int32 * _DRAIN_CAP)()
+        # Bridge-side coalescing observability: event-loop wakeups that found
+        # work vs completions dispatched through them (the native side keeps
+        # the matching push/signal counters — completion_stats()).
+        self._drain_wakeups = 0
+        self._drain_completed = 0
         # Called after a successful reconnect() — e.g. a StripedConnection
         # invalidating sibling stripes' aliases of this connection's shm
         # segments (which the reconnect just unmapped).
@@ -525,6 +532,13 @@ class InfinityConnection:
     # -- batched async data plane -------------------------------------------
 
     def _semaphore(self, loop) -> asyncio.BoundedSemaphore:
+        # Lock-free fast path: dict reads are atomic under the GIL, and a
+        # loop's entry never changes once inserted — only insertion (below)
+        # and close() mutate the registry. Saves a threading-lock round trip
+        # per async op on the latency path.
+        sem = self._semaphores.get(loop)
+        if sem is not None:
+            return sem
         with self._lock:  # loops in different threads may race the registry
             sem = self._semaphores.get(loop)
             if sem is None:
@@ -590,6 +604,7 @@ class InfinityConnection:
             os.eventfd_read(self._efd)
         except (BlockingIOError, OSError):
             pass  # another loop's drain got here first, or fd is closing
+        woke = False
         while True:
             with self._lock:  # two loops may share this efd; serialize
                 if self._handle is None:
@@ -600,6 +615,11 @@ class InfinityConnection:
                 pairs = [
                     (self._drain_tokens[i], self._drain_codes[i]) for i in range(n)
                 ]
+                if n:
+                    if not woke:
+                        woke = True
+                        self._drain_wakeups += 1
+                    self._drain_completed += n
             self._dispatch_completions(pairs)
             if n < _DRAIN_CAP:
                 return
@@ -841,6 +861,33 @@ class InfinityConnection:
             )
         return int(ret)
 
+    def completion_stats(self) -> dict:
+        """Async-bridge coalescing counters for this connection's lifetime:
+        how many completions the native reactor pushed into the ring, how
+        many eventfd writes it took (one per empty->non-empty transition —
+        completions landing while a wakeup is armed piggyback on it), and
+        the loop-side drain counts. ``completion_batch_size`` =
+        completions / signals: 1.0 means every op paid its own wakeup;
+        higher means pipelined ops shared them (the bench's
+        ``completion_batch_size`` key)."""
+        pushed = ctypes.c_uint64()
+        signalled = ctypes.c_uint64()
+        with self._lock:
+            if self._handle is not None:
+                lib.its_conn_completion_counters(
+                    self._handle, ctypes.byref(pushed), ctypes.byref(signalled)
+                )
+            wakeups, drained = self._drain_wakeups, self._drain_completed
+        return {
+            "completions": pushed.value,
+            "wakeups_signalled": signalled.value,
+            "loop_wakeups": wakeups,
+            "loop_drained": drained,
+            "completion_batch_size": (
+                pushed.value / signalled.value if signalled.value else 0.0
+            ),
+        }
+
     @_reconnecting()
     def get_stats(self) -> dict:
         """Server-side per-op latency/throughput counters — first-class
@@ -867,19 +914,61 @@ class StripedConnection:
     src/protocol.h:22-26); a TCP stream has no such depth — per-connection
     congestion windows and the kernel's per-socket processing cap a single
     stream well below NIC rate on DCN. Striping opens `streams` independent
-    connections and splits every batched op across them (contiguous chunks,
-    so scatter/gather runs stay long). See docs/multistream.md for when this
-    wins (cross-host) and when it cannot (same-host: memcpy-bound).
+    connections and fans batched ops out across them.
+
+    The fan-out is an ADAPTIVE WORK-STEALING SCHEDULER, not a static split:
+    each batched op is broken into bounded contiguous chunk descriptors
+    (``wire.chunk_spans``) on a shared queue, and every stripe runs a worker
+    that pulls the next span whenever it finishes its previous one — a slow
+    stripe simply pulls less, so it can never gate the whole batch the way a
+    static 1/N split lets it (the head-of-line failure BENCH_r05 measured as
+    a 1.6x striped-vs-single inversion). How much a stripe pulls per trip
+    adapts to its measured throughput EWMA (targeting ``TARGET_CHUNK_S`` of
+    transfer per pull, so fast stripes amortize per-op cost over big spans
+    while paced/slow ones stay at fine grain and rebalance quickly), capped
+    by an even share of what remains so the batch TAIL is always split fine.
+    Spans stay contiguous, so each stripe's scatter/gather iovec runs stay
+    long. A same-host detector (the shm fast path active on stripe 0 — proof
+    the data plane is a memcpy, where extra socket stripes only add reactor
+    contention) collapses batched ops to stripe 0 automatically: striping
+    can no longer lose to a single stream. See docs/multistream.md.
 
     Control ops, the shm fast path, and stats ride stripe 0; batched
     data-plane ops fan out. The surface mirrors InfinityConnection.
     """
 
-    def __init__(self, config: ClientConfig, streams: int = 4):
+    # Descriptor granularity on the shared queue: the indivisible steal unit.
+    CHUNK_QUANTUM_BLOCKS = 8
+    # Per-pull transfer-time target: big enough to amortize one batched op's
+    # fixed cost (~tens of us), small enough that stripes rebalance within a
+    # few ms when one slows down (and that a paced 50 MB/s stripe still makes
+    # multiple trips per batch instead of swallowing a static share).
+    TARGET_CHUNK_S = 0.004
+    # Hard per-pull cap in blocks: bounds the damage of a stale (optimistic)
+    # EWMA — at most this much work can strand behind a stripe that stalls
+    # right after pulling.
+    MAX_CHUNK_BLOCKS = 256
+    EWMA_ALPHA = 0.3  # per-chunk throughput smoothing
+
+    def __init__(self, config: ClientConfig, streams: int = 4, adaptive: bool = True):
         if streams < 1:
             raise ValueError("streams must be >= 1")
         self.config = config
+        self.adaptive = adaptive
         self.conns = [InfinityConnection(config) for _ in range(streams)]
+        # Per-stripe measured throughput EWMA in bytes/s (0 = unmeasured).
+        # Persists across batches: the second batch starts from the first
+        # batch's measured rates instead of re-probing.
+        self._ewma_bps = [0.0] * streams
+        self._sched_stats = {
+            "batched_ops": 0,
+            "collapsed_ops": 0,  # same-host detector sent the op to stripe 0
+            "small_ops": 0,  # below 2*streams blocks: not worth splitting
+            "chunks": 0,
+            "steals": 0,  # pulls beyond each worker's first (stolen share)
+            "stripe_chunks": [0] * streams,
+            "stripe_blocks": [0] * streams,
+        }
         # Stripe 0 owns the shm segments the other stripes alias. WHENEVER it
         # reconnects — including a self-heal inside the auto_reconnect
         # decorator that this object never sees — the segments are unmapped
@@ -959,20 +1048,99 @@ class StripedConnection:
             c._register_segment_alias(buf.ctypes.data, nbytes)
         return buf
 
-    # -- batched data plane: split across stripes ----------------------------
+    # -- batched data plane: adaptive work-stealing fan-out ------------------
 
     def _split(self, blocks: List[Tuple[str, int]]) -> List[List[Tuple[str, int]]]:
+        """Static contiguous 1/N split (the ``adaptive=False`` legacy path,
+        kept for A/B comparison — benchmark.py ``--no-adaptive``)."""
         n = len(self.conns)
         per = (len(blocks) + n - 1) // n
         return [blocks[i : i + per] for i in range(0, len(blocks), per)]
 
+    def memcpy_bound(self) -> bool:
+        """Same-host detector: stripe 0's shm fast path being active proves
+        client and server share a host and batched bytes move by memcpy
+        (pool copy or one-RTT segment) — the regime where extra socket
+        stripes only add reactor threads contending for the same cores.
+        Deliberately NOT a throughput heuristic: a real DCN stripe can
+        sustain GB/s too, and collapsing it would throw away the NIC
+        headroom striping exists for; shm is unforgeable same-host proof
+        and is off exactly when pacing emulates a cross-host link."""
+        return self.conns[0].shm_active
+
+    def _pull_blocks(self, idx: int, remaining: int, block_size: int) -> int:
+        """How many blocks stripe ``idx`` takes this trip, in whole
+        descriptor quanta: its throughput EWMA times the per-pull time
+        target (unmeasured stripes start at one quantum so the first
+        measurement lands fast), floored at one quantum, capped by
+        MAX_CHUNK_BLOCKS and by an even share of what REMAINS — the tail of
+        a batch is always split finely, so the last pulls cannot recreate
+        the static split's one-slow-stripe long pole."""
+        q = self.CHUNK_QUANTUM_BLOCKS
+        ewma = self._ewma_bps[idx]
+        want = int(ewma * self.TARGET_CHUNK_S / block_size) if ewma > 0 else q
+        fair = (remaining + len(self.conns) - 1) // len(self.conns)
+        take = min(max(q, want), self.MAX_CHUNK_BLOCKS, max(q, fair), remaining)
+        return max(1, (take // q) * q if take >= q else take)
+
+    async def _adaptive_op(self, meth_name: str, blocks, block_size: int, ptr: int):
+        """Fan one batched op out over the stripes via the shared descriptor
+        queue. Every worker settles (its in-flight native op completes)
+        before this raises: a fail-fast would hand control back to a caller
+        who may free the staging buffer while sibling stripes are still
+        scatter/gathering from it in the native reactor."""
+        descs = deque(wire.chunk_spans(len(blocks), self.CHUNK_QUANTUM_BLOCKS))
+        remaining = [len(blocks)]  # cell: workers mutate between awaits
+        stats = self._sched_stats
+        errors: list = []
+
+        async def worker(idx: int, conn: InfinityConnection):
+            bound = getattr(conn, meth_name)
+            pulls = 0
+            while descs and not errors:
+                take = self._pull_blocks(idx, remaining[0], block_size)
+                # Pop whole quanta without yielding: consecutive descriptors
+                # are contiguous by construction, so the merged span is one
+                # contiguous run of the original batch.
+                first = descs.popleft()
+                start, count = first.start, first.count
+                while count < take and descs:
+                    count += descs.popleft().count
+                remaining[0] -= count
+                chunk = blocks[start : start + count]
+                t0 = time.perf_counter()
+                try:
+                    await bound(chunk, block_size, ptr)
+                except BaseException as e:
+                    errors.append(e)
+                    return
+                dt = time.perf_counter() - t0
+                if dt > 0:
+                    bps = count * block_size / dt
+                    prev = self._ewma_bps[idx]
+                    self._ewma_bps[idx] = (
+                        bps if prev <= 0
+                        else self.EWMA_ALPHA * bps + (1 - self.EWMA_ALPHA) * prev
+                    )
+                pulls += 1
+                stats["chunks"] += 1
+                stats["stripe_chunks"][idx] += 1
+                stats["stripe_blocks"][idx] += count
+            if pulls > 1:
+                stats["steals"] += pulls - 1
+
+        await asyncio.gather(*(worker(i, c) for i, c in enumerate(self.conns)))
+        if errors:
+            for extra in errors[1:]:  # don't silently drop sibling failures
+                Logger.warn(f"striped op: suppressed sibling stripe error: {extra!r}")
+            raise errors[0]
+        return wire.STATUS_OK
+
     @staticmethod
     async def _gather_settled(coros):
         """Run the per-stripe chunk ops to completion — ALL of them — before
-        raising. A fail-fast gather would hand control back to the caller
-        (who may unregister and free the staging buffer) while sibling
-        stripes' ops are still scatter/gathering from that memory in the
-        native reactor: an error-path use-after-free window."""
+        raising (see _adaptive_op for why; this is the static-split
+        variant's settle barrier)."""
         results = await asyncio.gather(*coros, return_exceptions=True)
         errors = [r for r in results if isinstance(r, BaseException)]
         if errors:
@@ -981,32 +1149,87 @@ class StripedConnection:
             raise errors[0]
         return results[0]
 
-    async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
-        """Batched block write split across stripes in contiguous chunks
-        (write_cache_async is the TPU-native alias). Small batches stay on
-        stripe 0 — splitting them would only add per-op round trips."""
+    async def _batched(self, meth_name: str, blocks, block_size: int, ptr: int):
+        stats = self._sched_stats
+        stats["batched_ops"] += 1
         if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
-            return await self.conns[0].write_cache_async(blocks, block_size, ptr)
+            # Too small to be worth splitting: fan-out would only add per-op
+            # round trips.
+            stats["small_ops"] += 1
+            return await getattr(self.conns[0], meth_name)(blocks, block_size, ptr)
+        if self.adaptive:
+            if self.memcpy_bound():
+                # Same host, memcpy data plane: one stream IS the ceiling —
+                # ride stripe 0's one-RTT segment path whole, so striping
+                # can never lose to a single stream.
+                stats["collapsed_ops"] += 1
+                return await getattr(self.conns[0], meth_name)(blocks, block_size, ptr)
+            return await self._adaptive_op(meth_name, blocks, block_size, ptr)
         chunks = self._split(blocks)
         return await self._gather_settled(
-            c.write_cache_async(chunk, block_size, ptr)
+            getattr(c, meth_name)(chunk, block_size, ptr)
             for c, chunk in zip(self.conns, chunks)
         )
 
+    async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
+        """Batched block write fanned out across stripes by the adaptive
+        scheduler (write_cache_async is the TPU-native alias)."""
+        return await self._batched("write_cache_async", blocks, block_size, ptr)
+
     async def rdma_read_cache_async(self, blocks, block_size: int, ptr: int):
-        """Batched block read split across stripes (read_cache_async is the
-        TPU-native alias); KeyNotFound on any stripe raises after all
-        stripes settle."""
-        if len(self.conns) == 1 or len(blocks) < 2 * len(self.conns):
-            return await self.conns[0].read_cache_async(blocks, block_size, ptr)
-        chunks = self._split(blocks)
-        return await self._gather_settled(
-            c.read_cache_async(chunk, block_size, ptr)
-            for c, chunk in zip(self.conns, chunks)
-        )
+        """Batched block read fanned out across stripes (read_cache_async is
+        the TPU-native alias); KeyNotFound on any stripe raises after all
+        in-flight chunk ops settle."""
+        return await self._batched("read_cache_async", blocks, block_size, ptr)
 
     write_cache_async = rdma_write_cache_async
     read_cache_async = rdma_read_cache_async
+
+    def preferred_fanout_blocks(self) -> int:
+        """Sizing hint for batch builders (connector.FetchCoalescer): the
+        most blocks one batched call can usefully carry — every stripe
+        pulling its per-trip maximum once. Beyond this, merging more blocks
+        into a single call buys no extra parallelism; it only coarsens the
+        caller's failure/retry granularity."""
+        return len(self.conns) * self.MAX_CHUNK_BLOCKS
+
+    def data_plane_stats(self) -> dict:
+        """Scheduler observability: per-stripe chunk/block counts, steal
+        count, measured per-stripe EWMA rates, and how often the same-host
+        detector collapsed ops to stripe 0."""
+        s = self._sched_stats
+        return {
+            "streams": len(self.conns),
+            "adaptive": self.adaptive,
+            "batched_ops": s["batched_ops"],
+            "collapsed_ops": s["collapsed_ops"],
+            "small_ops": s["small_ops"],
+            "chunks": s["chunks"],
+            "steals": s["steals"],
+            "stripe_chunks": list(s["stripe_chunks"]),
+            "stripe_blocks": list(s["stripe_blocks"]),
+            "stripe_ewma_gbps": [round(b / (1 << 30), 4) for b in self._ewma_bps],
+        }
+
+    def completion_stats(self) -> dict:
+        """Aggregate async-bridge coalescing counters across stripes (see
+        InfinityConnection.completion_stats)."""
+        out = {
+            "completions": 0,
+            "wakeups_signalled": 0,
+            "loop_wakeups": 0,
+            "loop_drained": 0,
+        }
+        for c in self.conns:
+            st = c.completion_stats()
+            for k in out:
+                out[k] += st[k]
+        out["completion_batch_size"] = (
+            out["completions"] / out["wakeups_signalled"]
+            if out["wakeups_signalled"]
+            else 0.0
+        )
+        return out
 
     def write_cache(self, blocks, block_size: int, ptr: int):
         """Sync ops ride stripe 0: a blocking single-block op gains nothing

@@ -20,32 +20,48 @@ from .lib import InfinityConnection, StripedConnection
 BLOCK = 64 << 10
 
 
-def shaped_config(port: int, cap_mbps: int) -> ClientConfig:
+def shaped_config(port: int, cap_mbps: Optional[int]) -> ClientConfig:
     """Loopback client config with per-connection pacing and shm disabled
-    (every byte rides the paced socket)."""
+    (every byte rides the paced socket).
+
+    ``cap_mbps`` of ``None`` or ``0`` means UNSHAPED: pacing off, but shm
+    still off — the socket path without a bandwidth cap, the config the
+    shaping edge-case tests pin (a zero cap must be a no-op, not a stall).
+
+    Shm staying off also matters for the ADAPTIVE striped scheduler
+    (lib.StripedConnection): its same-host detector keys on the shm fast
+    path being active, so a shaped connection never auto-collapses to one
+    stripe — pacing emulates a cross-host link and the scheduler must keep
+    striping it, merely shrinking each stripe's chunks to the paced rate
+    (throughput EWMA x target chunk latency)."""
     return ClientConfig(
         host_addr="127.0.0.1",
         service_port=port,
         log_level="error",
         enable_shm=False,  # force the socket path: that is what stripes split
-        pacing_rate_mbps=cap_mbps,
+        pacing_rate_mbps=int(cap_mbps or 0),
     )
 
 
 def shaped_roundtrip_mbps(
     port: int,
-    cap_mbps: int,
+    cap_mbps: Optional[int],
     streams: int,
     nbytes: int,
     key_prefix: str = "shaped",
     verify: bool = False,
+    stats_out: Optional[dict] = None,
 ) -> Tuple[float, Optional[bool]]:
     """Aggregate write+read MB/s of the headline workload over N paced
     stripes against the (server-side paced) store on ``port``.
 
     Returns (mbps, verified): ``verified`` is None unless ``verify`` — the
     verifying variant reads into a second buffer and compares, at the cost of
-    a larger working set.
+    a larger working set. When ``stats_out`` is given and the connection is
+    striped, the adaptive scheduler's ``data_plane_stats()`` snapshot is
+    copied into it after the measurement (per-stripe chunk counts + EWMA —
+    how the tests see that pacing shrank the chunks rather than starving a
+    stripe).
     """
     cfg = shaped_config(port, cap_mbps)
     conn = (
@@ -71,5 +87,7 @@ def shaped_roundtrip_mbps(
     asyncio.run(once())
     dt = time.perf_counter() - t0
     verified = bool(np.array_equal(src, dst)) if verify else None
+    if stats_out is not None and hasattr(conn, "data_plane_stats"):
+        stats_out.update(conn.data_plane_stats())
     conn.close()
     return 2 * n * BLOCK / dt / (1 << 20), verified

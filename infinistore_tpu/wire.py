@@ -244,6 +244,51 @@ class SegBatchMeta:
 
 
 @dataclass
+class ChunkDesc:
+    """Descriptor for one contiguous slice of a split batched op — the
+    work-stealing unit of the adaptive striped data plane
+    (lib.StripedConnection): a batch of N blocks is broken into bounded
+    descriptors on a shared queue and stripes pull them as they finish
+    prior ones. ``start``/``count`` index the ORIGINAL batch's block list
+    (contiguous, so each stripe's scatter/gather iovec runs stay long);
+    ``seq`` orders descriptors for debugging/tracing. The wire protocol
+    itself is unchanged — each pulled descriptor rides an ordinary batched
+    op on its stripe — but the framing here is the canonical record (and
+    the unit tests' contract) for anything that persists or ships a split
+    plan, e.g. a cross-process scheduler or a replay trace."""
+
+    seq: int = 0
+    start: int = 0
+    count: int = 0
+
+    _STRUCT = struct.Struct("<IQI")
+
+    def encode(self) -> bytes:
+        return self._STRUCT.pack(self.seq, self.start, self.count)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ChunkDesc":
+        if len(data) < cls._STRUCT.size:
+            raise ValueError("wire body truncated")
+        seq, start, count = cls._STRUCT.unpack(data[: cls._STRUCT.size])
+        return cls(seq=seq, start=start, count=count)
+
+
+def chunk_spans(n_blocks: int, quantum: int) -> List[ChunkDesc]:
+    """Split an n-block batch into bounded contiguous chunk descriptors of
+    at most ``quantum`` blocks each (the last may be shorter). The shared
+    queue the striped scheduler's workers pull from is exactly this list."""
+    if n_blocks < 0:
+        raise ValueError("n_blocks must be >= 0")
+    if quantum < 1:
+        raise ValueError("quantum must be >= 1")
+    return [
+        ChunkDesc(seq=seq, start=start, count=min(quantum, n_blocks - start))
+        for seq, start in enumerate(range(0, n_blocks, quantum))
+    ]
+
+
+@dataclass
 class KeyMeta:
     key: str = ""
 

@@ -136,6 +136,13 @@ class Connection {
     void set_completion_fd(int fd);
     // Pop up to cap completions into tokens/codes; returns the count.
     int drain_completions(uint64_t* tokens, int32_t* codes, int cap);
+    // Coalescing counters: completions pushed into the ring vs eventfd
+    // writes issued. The fd is written only on an empty->non-empty ring
+    // transition (a completion landing while a wakeup is already armed
+    // piggybacks on it — this is what lets a burst of small gets share one
+    // loop wakeup instead of arming one each), so pushed/signalled is the
+    // mean completion batch per wakeup the bench reports.
+    void completion_counters(uint64_t* pushed, uint64_t* signalled) const;
 
   private:
     struct Request;
@@ -222,6 +229,9 @@ class Connection {
     std::atomic<int> comp_fd_{-1};
     std::mutex ring_mu_;
     std::vector<std::pair<uint64_t, int32_t>> ring_;
+    // Wakeup-coalescing counters (see completion_counters).
+    std::atomic<uint64_t> comp_pushed_{0};
+    std::atomic<uint64_t> comp_signalled_{0};
 
     // Client-owned shm staging segments (one-RTT path).
     struct ClientSeg {

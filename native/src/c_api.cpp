@@ -149,6 +149,11 @@ void its_conn_set_completion_fd(void* c, int fd) {
 int its_conn_drain_completions(void* c, uint64_t* tokens, int32_t* codes, int cap) {
     return static_cast<Connection*>(c)->drain_completions(tokens, codes, cap);
 }
+// Wakeup-coalescing counters: ring pushes vs eventfd writes (the fd is
+// written only on empty->non-empty transitions; see Connection::complete).
+void its_conn_completion_counters(void* c, uint64_t* pushed, uint64_t* signalled) {
+    static_cast<Connection*>(c)->completion_counters(pushed, signalled);
+}
 
 int its_conn_put_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,

@@ -648,6 +648,11 @@ std::string Connection::stat_json() {
 
 void Connection::set_completion_fd(int fd) { comp_fd_.store(fd); }
 
+void Connection::completion_counters(uint64_t* pushed, uint64_t* signalled) const {
+    if (pushed != nullptr) *pushed = comp_pushed_.load(std::memory_order_relaxed);
+    if (signalled != nullptr) *signalled = comp_signalled_.load(std::memory_order_relaxed);
+}
+
 int Connection::drain_completions(uint64_t* tokens, int32_t* codes, int cap) {
     std::lock_guard<std::mutex> lock(ring_mu_);
     int n = static_cast<int>(std::min<size_t>(cap, ring_.size()));
@@ -676,14 +681,28 @@ void Connection::complete(std::unique_ptr<Request> req, int code, bool take_body
     } else if (comp_fd_.load() >= 0 && req->ctx != nullptr) {
         // Ring mode: push, then signal — the drainer reads the fd BEFORE
         // popping, so a push after its pop re-arms the fd and no completion
-        // is ever stranded.
+        // is ever stranded. Coalescing: the fd is written only when the
+        // ring transitions empty -> non-empty. A non-empty ring means a
+        // wakeup is already armed (or a drain is mid-flight, which clears
+        // the fd first and then pops EVERYTHING under ring_mu_, so this
+        // push is either seen by that drain or re-signalled by the next
+        // empty-transition push) — completions landing in between, e.g. a
+        // burst of small (<16KB) gets streaming back-to-back off one
+        // socket, piggyback on the armed wakeup instead of paying one
+        // eventfd syscall (and one loop wake) each.
+        bool was_empty;
         {
             std::lock_guard<std::mutex> lock(ring_mu_);
+            was_empty = ring_.empty();
             ring_.emplace_back(reinterpret_cast<uint64_t>(req->ctx), code);
         }
-        uint64_t one = 1;
-        ssize_t rc = ::write(comp_fd_.load(), &one, sizeof(one));
-        (void)rc;
+        comp_pushed_.fetch_add(1, std::memory_order_relaxed);
+        if (was_empty) {
+            comp_signalled_.fetch_add(1, std::memory_order_relaxed);
+            uint64_t one = 1;
+            ssize_t rc = ::write(comp_fd_.load(), &one, sizeof(one));
+            (void)rc;
+        }
     }
     if (req->rx_buf != nullptr) free(req->rx_buf);
 }

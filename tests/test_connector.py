@@ -289,3 +289,30 @@ def test_connector_save_load_over_striped_connection():
             )
     conn.close()
     srv.stop()
+
+
+def test_chain_hash_cache_survives_buffer_reuse():
+    """The connector's incremental chain-hash cache must copy ndarray token
+    inputs: an engine reusing a preallocated token buffer for the next
+    prompt would otherwise mutate the cached tokens into falsely matching
+    it — returning the OLD prompt's hashes (another request's KV keys)."""
+    from infinistore_tpu.connector import _ChainHashCache
+
+    cache = _ChainHashCache()
+    buf = np.arange(64, dtype=np.int64)
+    assert cache.hashes(buf, 8) == token_chain_hashes(list(range(64)), 8)
+    buf[:] = 999  # engine reuses the buffer for a different prompt
+    assert cache.hashes(buf, 8) == token_chain_hashes([999] * 64, 8)
+
+
+def test_chain_hash_cache_repeat_prefix_extension():
+    """Cache paths (repeat / prefix / extension / divergence) must all be
+    byte-identical to the uncached token_chain_hashes."""
+    from infinistore_tpu.connector import _ChainHashCache
+
+    rng = np.random.default_rng(7)
+    cache = _ChainHashCache()
+    base = rng.integers(0, 1000, size=100).tolist()
+    for tokens in (base, base, base[:40], base + [1, 2] * 12, base[:16],
+                   rng.integers(0, 1000, size=33).tolist(), [], [5]):
+        assert cache.hashes(tokens, 8) == token_chain_hashes(tokens, 8)

@@ -104,3 +104,35 @@ def _recv_exact(sock, n):
             raise ConnectionError("server closed early")
         buf += chunk
     return buf
+
+
+def test_chunk_desc_roundtrip():
+    """ChunkDesc framing (the striped scheduler's work-stealing unit) must
+    survive encode/decode byte-exactly, including 64-bit starts."""
+    for desc in (
+        wire.ChunkDesc(seq=0, start=0, count=1),
+        wire.ChunkDesc(seq=125, start=992, count=8),
+        wire.ChunkDesc(seq=2**32 - 1, start=2**40, count=2**32 - 1),
+    ):
+        out = wire.ChunkDesc.decode(desc.encode())
+        assert out == desc
+    with pytest.raises(ValueError):
+        wire.ChunkDesc.decode(wire.ChunkDesc().encode()[:-1])
+
+
+def test_chunk_spans_partition():
+    """chunk_spans must tile [0, n) exactly: contiguous, ordered, bounded
+    by the quantum, last descriptor short when n is not a multiple."""
+    for n, q in ((0, 8), (1, 8), (8, 8), (1000, 8), (17, 4)):
+        descs = wire.chunk_spans(n, q)
+        assert sum(d.count for d in descs) == n
+        pos = 0
+        for i, d in enumerate(descs):
+            assert d.seq == i and d.start == pos
+            assert 1 <= d.count <= q
+            pos += d.count
+        assert pos == n
+    with pytest.raises(ValueError):
+        wire.chunk_spans(8, 0)
+    with pytest.raises(ValueError):
+        wire.chunk_spans(-1, 8)

@@ -1,0 +1,246 @@
+"""Adaptive work-stealing stripe scheduler (lib.StripedConnection).
+
+The static 1/N split let one slow stripe gate every batched op (the
+BENCH_r05 4-vs-1 inversion); the scheduler replaces it with bounded chunk
+descriptors on a shared queue that stripes pull as they finish prior ones,
+per-stripe EWMA-adaptive pull sizes, and a same-host detector that
+collapses to stripe 0 when the data plane is a memcpy. These tests pin the
+scheduler's correctness properties (data integrity through arbitrary chunk
+interleavings, typed errors, settle-before-raise) and its observable
+scheduling behavior (participation, stealing, collapse, pull sizing).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu.lib import StripedConnection
+
+BLOCK = 64 << 10
+
+
+@pytest.fixture(scope="module")
+def socket_server():
+    """Shm OFF: batched bytes ride the sockets, so the fan-out is real and
+    the same-host detector must NOT collapse."""
+    srv = its.start_local_server(
+        prealloc_bytes=256 << 20, block_bytes=BLOCK, enable_shm=False
+    )
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def shm_server():
+    srv = its.start_local_server(prealloc_bytes=256 << 20, block_bytes=BLOCK)
+    yield srv
+    srv.stop()
+
+
+def _cfg(port, **kw):
+    return its.ClientConfig(
+        host_addr="127.0.0.1", service_port=port, log_level="error", **kw
+    )
+
+
+def test_adaptive_roundtrip_and_participation(socket_server):
+    """A 64-block batch over 4 stripes: bytes survive the work-stealing
+    interleave, every stripe pulls work, and the op was actually chunked
+    (more chunks than stripes -> at least one stripe came back for more)."""
+    conn = StripedConnection(
+        _cfg(socket_server.port, enable_shm=False), streams=4
+    )
+    conn.connect()
+    try:
+        n = 64
+        src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        pairs = [(f"ad-{i}", i * BLOCK) for i in range(n)]
+
+        async def go():
+            await conn.write_cache_async(pairs, BLOCK, src.ctypes.data)
+            await conn.read_cache_async(pairs, BLOCK, dst.ctypes.data)
+
+        asyncio.run(go())
+        assert np.array_equal(src, dst)
+        stats = conn.data_plane_stats()
+        assert stats["collapsed_ops"] == 0, "no shm -> no same-host collapse"
+        assert stats["chunks"] > stats["streams"], stats
+        assert all(c > 0 for c in stats["stripe_chunks"]), stats
+        assert sum(stats["stripe_blocks"]) == 2 * n, stats
+        assert stats["steals"] > 0, "nobody pulled a second chunk"
+        # The measured EWMA feeds the next batch's pull sizing.
+        assert all(e > 0 for e in stats["stripe_ewma_gbps"]), stats
+    finally:
+        conn.close()
+
+
+def test_same_host_shm_collapses_to_one_stripe(shm_server):
+    """With the shm fast path active the data plane is a memcpy: batched
+    ops must ride stripe 0 whole (striping can only lose here), and the
+    bytes must still verify."""
+    conn = StripedConnection(_cfg(shm_server.port), streams=4)
+    conn.connect()
+    try:
+        assert conn.shm_active and conn.memcpy_bound()
+        n = 32
+        buf = conn.alloc_shm_mr(n * BLOCK)
+        buf[:] = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+        gold = buf.copy()
+        pairs = [(f"co-{i}", i * BLOCK) for i in range(n)]
+
+        async def go():
+            await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
+            buf[:] = 0
+            await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+        asyncio.run(go())
+        assert np.array_equal(buf, gold)
+        stats = conn.data_plane_stats()
+        assert stats["collapsed_ops"] == 2, stats
+        assert stats["chunks"] == 0, "collapsed ops must not be chunked"
+    finally:
+        conn.close()
+
+
+def test_missing_key_raises_typed_after_settle(socket_server):
+    """KeyNotFound on one stolen chunk propagates as the typed exception,
+    and only after every stripe's in-flight op settled (no pending native
+    ops scatter/gathering into caller memory once the caller sees the
+    error — the settle-before-raise contract the static split had)."""
+    conn = StripedConnection(
+        _cfg(socket_server.port, enable_shm=False), streams=4
+    )
+    conn.connect()
+    try:
+        n = 32
+        buf = np.zeros(n * BLOCK, dtype=np.uint8)
+        conn.register_mr(buf)
+        pairs = [(f"miss-{i}", i * BLOCK) for i in range(n)]
+
+        with pytest.raises(its.InfiniStoreKeyNotFound):
+            asyncio.run(conn.read_cache_async(pairs, BLOCK, buf.ctypes.data))
+        # The connection must remain fully usable (nothing wedged).
+        buf[:] = 7
+        asyncio.run(conn.write_cache_async(pairs, BLOCK, buf.ctypes.data))
+        buf[:] = 0
+        asyncio.run(conn.read_cache_async(pairs, BLOCK, buf.ctypes.data))
+        assert (buf == 7).all()
+    finally:
+        conn.close()
+
+
+def test_small_batches_skip_the_scheduler(socket_server):
+    """Below 2*streams blocks, fan-out would only add round trips: the op
+    rides stripe 0 and is counted as small, not chunked."""
+    conn = StripedConnection(
+        _cfg(socket_server.port, enable_shm=False), streams=4
+    )
+    conn.connect()
+    try:
+        buf = np.ones(4 * BLOCK, dtype=np.uint8)
+        conn.register_mr(buf)
+        pairs = [(f"sm-{i}", i * BLOCK) for i in range(4)]
+        asyncio.run(conn.write_cache_async(pairs, BLOCK, buf.ctypes.data))
+        stats = conn.data_plane_stats()
+        assert stats["small_ops"] == 1 and stats["chunks"] == 0, stats
+    finally:
+        conn.close()
+
+
+def test_pull_sizing_tracks_ewma_and_tail():
+    """Pure sizing-policy unit test (no server): unmeasured stripes start
+    at one quantum; a fast stripe's pull grows toward its EWMA x target
+    time (whole quanta, capped); the remaining-work fair share splits the
+    batch tail finely no matter how fast a stripe claims to be."""
+    conn = StripedConnection.__new__(StripedConnection)
+    conn.conns = [None] * 4
+    conn._ewma_bps = [0.0] * 4
+    q = StripedConnection.CHUNK_QUANTUM_BLOCKS
+    # Unmeasured: exactly one quantum.
+    assert conn._pull_blocks(0, 1000, BLOCK) == q
+    # 2 GB/s EWMA at a 4ms target = ~8MB = 128 x 64KB blocks.
+    conn._ewma_bps[1] = 2 * (1 << 30)
+    take = conn._pull_blocks(1, 1000, BLOCK)
+    assert take == 128 and take % q == 0
+    # Absurd EWMA: capped at MAX_CHUNK_BLOCKS (remaining big enough that
+    # the fair-share cap is not the binding one).
+    conn._ewma_bps[2] = 1 << 40
+    assert conn._pull_blocks(2, 4000, BLOCK) == StripedConnection.MAX_CHUNK_BLOCKS
+    # Tail: with 32 blocks left, even the fastest stripe takes only a fair
+    # share (ceil(32/4) = 8), so the end of the batch stays finely split.
+    assert conn._pull_blocks(2, 32, BLOCK) == 8
+    # Last blocks: never zero, never more than remain.
+    assert conn._pull_blocks(2, 3, BLOCK) == 3
+    # Paced stripe (50 MB/s): EWMA x 4ms is under one quantum -> floor at q.
+    conn._ewma_bps[3] = 50 * (1 << 20)
+    assert conn._pull_blocks(3, 1000, BLOCK) == q
+
+
+def test_preferred_fanout_blocks_hint():
+    conn = StripedConnection.__new__(StripedConnection)
+    conn.conns = [None] * 4
+    assert conn.preferred_fanout_blocks() == 4 * StripedConnection.MAX_CHUNK_BLOCKS
+
+
+def test_completion_coalescing_counters(shm_server):
+    """A burst of concurrent single-block reads must retire on fewer
+    eventfd signals than completions (the native ring writes the fd only on
+    empty->non-empty transitions), and the loop must drain every completion
+    it was signalled for."""
+    conn = its.InfinityConnection(_cfg(shm_server.port))
+    conn.connect()
+    try:
+        n = 32
+        block = 4 << 10
+        buf = conn.alloc_shm_mr(n * block)
+        buf[:] = 1
+        pairs = [(f"cc-{i}", i * block) for i in range(n)]
+        asyncio.run(conn.write_cache_async(pairs, block, buf.ctypes.data))
+
+        async def burst():
+            await asyncio.gather(*(
+                conn.read_cache_async([p], block, buf.ctypes.data) for p in pairs
+            ))
+
+        for _ in range(3):
+            asyncio.run(burst())
+        st = conn.completion_stats()
+        assert st["completions"] == st["loop_drained"], st
+        assert st["wakeups_signalled"] <= st["completions"], st
+        assert st["completion_batch_size"] >= 1.0, st
+        # 3 bursts of 32 concurrent ops: if every op still paid its own
+        # wakeup the batch size would be exactly 1.0; coalescing must show.
+        assert st["completion_batch_size"] > 1.2, st
+    finally:
+        conn.close()
+
+
+def test_static_split_mode_still_works(socket_server):
+    """adaptive=False keeps the legacy contiguous 1/N split (the
+    benchmark's A/B baseline) byte-correct."""
+    conn = StripedConnection(
+        _cfg(socket_server.port, enable_shm=False), streams=4, adaptive=False
+    )
+    conn.connect()
+    try:
+        n = 32
+        src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        pairs = [(f"st-{i}", i * BLOCK) for i in range(n)]
+
+        async def go():
+            await conn.write_cache_async(pairs, BLOCK, src.ctypes.data)
+            await conn.read_cache_async(pairs, BLOCK, dst.ctypes.data)
+
+        asyncio.run(go())
+        assert np.array_equal(src, dst)
+        assert conn.data_plane_stats()["chunks"] == 0
+    finally:
+        conn.close()

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Data-plane regression gate over a BENCH receipt.
+
+Reads one or more bench JSON files and exits non-zero when a known
+regression signature is present. The founding check is the striping
+inversion BENCH_r05 shipped (striped_4_gbps = 3.14 < striped_1_gbps = 5.03:
+a 4-stripe transfer LOSING to one stream, the head-of-line failure the
+adaptive work-stealing scheduler + same-host auto-collapse eliminate) —
+wired here so it can never silently return. Further checks guard the other
+data-plane invariants the striped PR established.
+
+Accepted inputs, per file:
+  - raw ``bench.py`` output: {"metric": ..., "value": ..., "extra": {...}}
+  - a driver receipt: {"cmd": ..., "rc": ..., "tail": "..."} where ``tail``
+    is the (possibly TRUNCATED, mid-JSON) last bytes of the bench output —
+    metrics are recovered by key-value scan, so a clipped head is fine.
+
+Usage:
+    python tools/bench_check.py BENCH.json [MORE.json ...]
+    python bench.py --check BENCH.json      # same gate, wired into the bench
+
+Exit status: 0 = every applicable check passed on every file; 1 = at least
+one check failed; 2 = no usable metrics found (an empty receipt must not
+masquerade as a passing one).
+"""
+
+import argparse
+import json
+import re
+import sys
+
+# "key": number — tolerant of truncated receipts (driver tails start
+# mid-object); booleans/strings are ignored, last occurrence wins.
+_NUM_RE = re.compile(r'"([A-Za-z0-9_]+)"\s*:\s*(-?[0-9]+(?:\.[0-9]+)?)')
+
+
+def extract_metrics(text: str) -> dict:
+    """Recover flat numeric metrics from a bench receipt in any of its
+    shapes (raw output, driver wrapper, truncated tail)."""
+    metrics = {}
+    try:
+        doc = json.loads(text)
+    except (ValueError, TypeError):
+        doc = None
+    if isinstance(doc, dict):
+        # Driver wrapper: the real payload hides in "tail"/"parsed".
+        for inner in (doc.get("parsed"), doc.get("tail")):
+            if isinstance(inner, dict):
+                doc.update(inner)
+            elif isinstance(inner, str):
+                text = text + "\n" + inner
+    for key, val in _NUM_RE.findall(text):
+        metrics[key] = float(val)
+    return metrics
+
+
+class Check:
+    """One named invariant over the metric dict; not-applicable (missing
+    keys) is reported but never fails — receipts predating a metric must
+    stay checkable for the metrics they do carry."""
+
+    def __init__(self, name, keys, predicate, describe):
+        self.name = name
+        self.keys = keys
+        self.predicate = predicate
+        self.describe = describe
+
+    def run(self, m: dict):
+        if any(k not in m for k in self.keys):
+            missing = [k for k in self.keys if k not in m]
+            return None, f"skipped (missing {', '.join(missing)})"
+        return self.predicate(m), self.describe(m)
+
+
+CHECKS = [
+    Check(
+        "striping_inversion",
+        ["striped_4_gbps", "striped_1_gbps"],
+        lambda m: m["striped_4_gbps"] >= m["striped_1_gbps"],
+        lambda m: (
+            f"striped_4={m['striped_4_gbps']:.3f} GB/s vs "
+            f"striped_1={m['striped_1_gbps']:.3f} GB/s "
+            "(4 stripes must never lose to one stream)"
+        ),
+    ),
+    Check(
+        "shaped_striping_scaling",
+        ["shaped_striped_4_mbps", "shaped_striped_1_mbps"],
+        lambda m: m["shaped_striped_4_mbps"] >= 2.0 * m["shaped_striped_1_mbps"],
+        lambda m: (
+            f"shaped 4-stripe {m['shaped_striped_4_mbps']:.1f} MB/s vs "
+            f"1-stripe {m['shaped_striped_1_mbps']:.1f} MB/s "
+            "(bandwidth-capped stripes must scale >= 2x)"
+        ),
+    ),
+    # Threshold calibration: the async/sync ratio's structural floor is
+    # (sync + eventfd loop wake) / sync ~= 1.6x on this box, and the
+    # measured history swings with host weather — r03 2.64x, r04 1.69x,
+    # r05 1.27x. 3.0x sits just above the worst honest measurement ever
+    # recorded while still catching the pathological regressions this gate
+    # exists for (e.g. falling back to a per-op call_soon_threadsafe hop,
+    # historically 3-5x).
+    Check(
+        "async_bridge_overhead",
+        ["p50_fetch_4k_us", "sync_p50_fetch_4k_us"],
+        lambda m: m["p50_fetch_4k_us"] <= 3.0 * m["sync_p50_fetch_4k_us"],
+        lambda m: (
+            f"async p50 {m['p50_fetch_4k_us']:.1f}us vs sync "
+            f"{m['sync_p50_fetch_4k_us']:.1f}us "
+            "(bridge must stay within 3x of the sync path at 4KB)"
+        ),
+    ),
+]
+
+
+def check_file(path: str, out=sys.stdout) -> int:
+    """Run every applicable check against one receipt. Returns 0 pass,
+    1 fail, 2 no metrics."""
+    with open(path) as f:
+        metrics = extract_metrics(f.read())
+    applicable = 0
+    failed = 0
+    for check in CHECKS:
+        ok, detail = check.run(metrics)
+        if ok is None:
+            print(f"[{path}] -    {check.name}: {detail}", file=out)
+            continue
+        applicable += 1
+        if ok:
+            print(f"[{path}] PASS {check.name}: {detail}", file=out)
+        else:
+            failed += 1
+            print(f"[{path}] FAIL {check.name}: {detail}", file=out)
+    if applicable == 0:
+        print(f"[{path}] no usable data-plane metrics found", file=out)
+        return 2
+    return 1 if failed else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bench_check", description="fail on data-plane regressions in BENCH json receipts"
+    )
+    parser.add_argument("files", nargs="+", help="bench output / driver receipt JSON files")
+    args = parser.parse_args(argv)
+    rc = 0
+    for path in args.files:
+        rc = max(rc, check_file(path))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
