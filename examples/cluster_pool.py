@@ -76,6 +76,18 @@ def main():
             f"miss, {kept} still fully cached, degraded_ops="
             f"{cluster.degraded_ops}"
         )
+        # The self-healing layer's attribution (docs/robustness.md): the
+        # dead member's breaker opens after a few errors (later ops
+        # fast-fail locally instead of burning timeouts), and health()
+        # names the sick node. With replicas=2 the same drain would cost
+        # NOTHING: saves mirror to the rendezvous runner-up and reads fail
+        # over to it (see tests/test_selfheal.py).
+        for m in cluster.health()["members"]:
+            print(
+                f"  {m['member_id']}: breaker={m['breaker_state']} "
+                f"errors={m['errors']} fast_fails={m['fast_fails']} "
+                f"degraded_ops={m['degraded_ops']}"
+            )
     finally:
         for c in conns:
             try:

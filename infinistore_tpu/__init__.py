@@ -59,10 +59,19 @@ def __getattr__(name):
         from . import engine
 
         return getattr(engine, name)
-    if name in ("ClusterKVConnector", "rendezvous_owner"):
+    if name in (
+        "ClusterKVConnector",
+        "rendezvous_owner",
+        "rendezvous_ranked",
+        "CircuitBreaker",
+    ):
         from . import cluster
 
         return getattr(cluster, name)
+    if name in ("FaultRule", "FaultyConnection", "kill_transport"):
+        from . import faults
+
+        return getattr(faults, name)
     if name in (
         "InfiniStoreKVConnectorV1",
         "KVConnectorRole",
@@ -79,6 +88,11 @@ __all__ = [
     "token_chain_hashes",
     "ClusterKVConnector",
     "rendezvous_owner",
+    "rendezvous_ranked",
+    "CircuitBreaker",
+    "FaultRule",
+    "FaultyConnection",
+    "kill_transport",
     "EngineKVAdapter",
     "ContinuousBatchingHarness",
     "BlockPool",

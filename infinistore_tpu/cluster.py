@@ -18,22 +18,52 @@ removing a server remaps only the keys it owned; every other root keeps its
 owner (tested), which is what lets an operator drain one cache node without
 invalidating the rest of the pool.
 
+The cluster is **self-healing** (docs/robustness.md):
+
+- Every member sits behind a :class:`CircuitBreaker`: consecutive transport
+  errors OPEN it, after which ops against that member fast-fail locally (no
+  per-op timeout burn) except one half-open probe per exponential-backoff
+  window. A successful probe closes the breaker — a restarted node rejoins
+  within one probe window, and the probe itself heals a dead connection
+  (``reconnect``) so the async data plane recovers too, not just the
+  auto-reconnecting sync ops.
+- With ``replicas=2`` (rendezvous R=2: the HRW owner plus the runner-up),
+  saves mirror to both members and lookups/loads FAIL OVER to the replica
+  when the owner is open or erroring: one node death degrades to replica
+  reads instead of recompute. ``replicas=1`` (default) keeps the
+  single-owner behavior exactly.
+
 Failure policy is explicit: ``degrade=False`` (default) propagates member
-transport errors — the engine must see "store unreachable" (the lookup()
-contract, connector.py). ``degrade=True`` converts a DOWN member into cache
-misses (lookup 0 / load 0 / save skipped, counted in ``degraded_ops``): on
-an engine, a dead cache node should cost recompute, not availability.
+errors once no replica could serve — the engine must see "store
+unreachable" (the lookup() contract, connector.py). ``degrade=True``
+converts an unserved op into a cache miss (lookup 0 / load 0 / save
+skipped), counted in the aggregate ``degraded_ops`` AND per-member in
+``stats()``/``health()`` so an operator can tell WHICH node is sick: on an
+engine, a dead cache node should cost recompute, not availability.
 """
 
+import asyncio
 import hashlib
+import random
+import time
+from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .connector import KVConnector, token_chain_hashes
-from .lib import InfiniStoreException
+from .lib import (
+    InfiniStoreException,
+    InfiniStoreKeyNotFound,
+    InfiniStoreNoMatch,
+    InfiniStoreResourcePressure,
+)
 from .tpu.layerwise import PartialReadError
 from .tpu.paged import PagedKVCacheSpec
+
+
+def _score(member_id: str, root: str) -> bytes:
+    return hashlib.sha256(f"{member_id}|{root}".encode()).digest()
 
 
 def rendezvous_owner(member_ids: Sequence[str], root: str) -> int:
@@ -44,14 +74,183 @@ def rendezvous_owner(member_ids: Sequence[str], root: str) -> int:
         raise ValueError("rendezvous_owner needs at least one member")
     best, best_score = 0, b""
     for i, mid in enumerate(member_ids):
-        score = hashlib.sha256(f"{mid}|{root}".encode()).digest()
+        score = _score(mid, root)
         if score > best_score:
             best, best_score = i, score
     return best
 
 
+def rendezvous_ranked(member_ids: Sequence[str], root: str) -> List[int]:
+    """ALL member indices for ``root``, by descending HRW score: index 0 is
+    the owner (== :func:`rendezvous_owner`), index 1 the replication
+    successor, and so on. The same stability property holds rank-wise:
+    removing one member only promotes the members ranked below it for the
+    roots where it appeared — every other (root, rank) pairing is
+    untouched, so R=2 replica placement survives drains as cheaply as
+    ownership does."""
+    if not member_ids:
+        raise ValueError("rendezvous_ranked needs at least one member")
+    return sorted(
+        range(len(member_ids)),
+        key=lambda i: _score(member_ids[i], root),
+        reverse=True,
+    )
+
+
+def _is_transport(exc: BaseException) -> bool:
+    """Transport/availability errors trip breakers; SEMANTIC errors (miss,
+    no-match, resource pressure) prove the member answered and must not —
+    a store shedding load under memory pressure is sick, not dead, and
+    opening its breaker would turn pressure into an outage."""
+    if isinstance(exc, PartialReadError):
+        return exc.cause is None or _is_transport(exc.cause)
+    return isinstance(exc, InfiniStoreException) and not isinstance(
+        exc,
+        (InfiniStoreKeyNotFound, InfiniStoreNoMatch, InfiniStoreResourcePressure),
+    )
+
+
+class CircuitBreaker:
+    """Per-member availability gate: CLOSED -> OPEN after ``fail_threshold``
+    consecutive transport errors; while OPEN every op fast-fails locally
+    except one half-open probe per backoff window (exponential with
+    deterministic seeded jitter, so a fleet of breakers does not probe in
+    lockstep); a probe success re-CLOSES, a probe failure re-OPENs with
+    doubled backoff up to ``max_backoff_s``.
+
+    The point is cost: without a breaker, every op routed to a dead member
+    burns a full transport timeout; with one, a dead member costs one
+    fast-failed op per probe window. ``clock`` is injectable (tests drive
+    the state machine with a fake clock; defaults to ``time.monotonic``).
+    Not thread-safe by itself — callers serialize (the cluster drives it
+    from its own call sites, which share the caller's loop/thread).
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(
+        self,
+        fail_threshold: int = 3,
+        probe_backoff_s: float = 0.25,
+        max_backoff_s: float = 8.0,
+        jitter_frac: float = 0.2,
+        seed: int = 0,
+        clock=time.monotonic,
+    ):
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        if probe_backoff_s <= 0 or max_backoff_s < probe_backoff_s:
+            raise ValueError("need 0 < probe_backoff_s <= max_backoff_s")
+        self.fail_threshold = fail_threshold
+        self.probe_backoff_s = probe_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self.jitter_frac = jitter_frac
+        self._rng = random.Random(seed)
+        self._clock = clock
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.next_probe_at: Optional[float] = None
+        self._backoff = probe_backoff_s
+
+    def _schedule_probe(self):
+        jitter = 1.0 + self.jitter_frac * self._rng.random()
+        self.next_probe_at = self._clock() + self._backoff * jitter
+
+    def allow(self) -> bool:
+        """May an op proceed against this member right now? CLOSED: always.
+        OPEN: only once the probe window elapsed — that call becomes THE
+        half-open probe (subsequent calls fast-fail until its outcome is
+        recorded). HALF_OPEN: no — one probe in flight is enough."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN and self._clock() >= (self.next_probe_at or 0.0):
+            self.state = self.HALF_OPEN
+            return True
+        return False
+
+    def record_success(self) -> bool:
+        """An op (or the half-open probe) succeeded. Returns True when this
+        success RECOVERED the member (breaker was not closed)."""
+        recovered = self.state != self.CLOSED
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at = None
+        self.next_probe_at = None
+        self._backoff = self.probe_backoff_s
+        return recovered
+
+    def record_failure(self):
+        """An op against this member failed with a transport error."""
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN:
+            # The probe failed: still down — back off harder.
+            self.state = self.OPEN
+            self._backoff = min(self._backoff * 2.0, self.max_backoff_s)
+            self._schedule_probe()
+        elif self.state == self.CLOSED and (
+            self.consecutive_failures >= self.fail_threshold
+        ):
+            self.state = self.OPEN
+            self.opened_at = self._clock()
+            self._backoff = self.probe_backoff_s
+            self._schedule_probe()
+        # state OPEN: a straggler op that was in flight when we opened —
+        # counted, but the probe schedule stands.
+
+    def snapshot(self) -> dict:
+        """Observability dict (stats()/health() building block)."""
+        now = self._clock()
+        return {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "open_for_s": (
+                round(now - self.opened_at, 3) if self.opened_at is not None else 0.0
+            ),
+            "next_probe_in_s": (
+                round(max(0.0, self.next_probe_at - now), 3)
+                if self.next_probe_at is not None and self.state != self.CLOSED
+                else 0.0
+            ),
+        }
+
+
+@dataclass
+class _MemberHealth:
+    """Per-member failure-domain bookkeeping (the attributable counters the
+    old single global ``degraded_ops`` could not provide)."""
+
+    breaker: CircuitBreaker
+    errors: int = 0  # transport errors observed
+    fast_fails: int = 0  # ops denied locally while the breaker was open
+    probes: int = 0  # half-open probes attempted
+    recoveries: int = 0  # probe successes that re-closed the breaker
+    degraded_ops: int = 0  # ops degraded to a miss while this member OWNED them
+    replica_serves: int = 0  # ops this member served as a non-owner replica
+    last_error: Optional[str] = None
+
+    def as_dict(self) -> dict:
+        d = self.breaker.snapshot()
+        return {
+            "breaker_state": d["state"],
+            "breaker_consecutive_failures": d["consecutive_failures"],
+            "breaker_open_for_s": d["open_for_s"],
+            "breaker_next_probe_in_s": d["next_probe_in_s"],
+            "errors": self.errors,
+            "fast_fails": self.fast_fails,
+            "probes": self.probes,
+            "recoveries": self.recoveries,
+            "degraded_ops": self.degraded_ops,
+            "replica_serves": self.replica_serves,
+            "last_error": self.last_error,
+        }
+
+
 class ClusterKVConnector:
-    """``KVConnector`` surface over N servers with prefix-affine routing.
+    """``KVConnector`` surface over N servers with prefix-affine routing,
+    per-member circuit breakers, and optional R-way rendezvous replication.
 
     Duck-type compatible with what ``EngineKVAdapter`` needs (``spec``,
     ``lookup``/``load``/``save``/``drop``), so the continuous-batching
@@ -70,12 +269,24 @@ class ClusterKVConnector:
         member_ids: Optional[Sequence[str]] = None,
         degrade: bool = False,
         member_factory=None,
+        replicas: int = 1,
+        breaker_factory=None,
     ):
         """``member_factory(conn) -> KVConnector-shaped``: what each member
         runs over its connection — defaults to a plain ``KVConnector``; pass
         e.g. ``lambda c: QuantizedKVConnector(c, spec, model_id, max_blocks)``
         for an int8 pool (routing composes with any member that has
-        lookup/load/save/drop)."""
+        lookup/load/save/drop).
+
+        ``replicas``: rendezvous replication factor. 1 (default) = the HRW
+        owner alone, today's behavior. 2 = saves mirror to owner + HRW
+        runner-up and reads fail over to the replica when the owner's
+        breaker is open or its op errors (docs/robustness.md).
+
+        ``breaker_factory(member_index) -> CircuitBreaker``: per-member
+        breaker construction (tunables, injected clocks in tests). The
+        default seeds each member's jitter differently so probes
+        decorrelate."""
         if not conns:
             raise ValueError("cluster needs at least one connection")
         if member_ids is None:
@@ -90,160 +301,404 @@ class ClusterKVConnector:
             )
         if len(set(member_ids)) != len(member_ids):
             raise ValueError(f"member_ids must be unique, got {member_ids}")
+        if not 1 <= replicas <= len(conns):
+            raise ValueError(
+                f"replicas={replicas} outside 1..{len(conns)} members"
+            )
         self.member_ids = list(member_ids)
         if member_factory is None:
             member_factory = lambda c: KVConnector(c, spec, model_id, max_blocks)
+        if breaker_factory is None:
+            breaker_factory = lambda i: CircuitBreaker(seed=i)
         self.members = [member_factory(c) for c in conns]
         self.spec = spec
         self.model_id = model_id
         self.max_blocks = max_blocks
         self.degrade = degrade
-        self.degraded_ops = 0
+        self.replicas = replicas
+        self.degraded_ops = 0  # aggregate (back-compat; per-member in stats())
+        self._health = [
+            _MemberHealth(breaker=breaker_factory(i)) for i in range(len(conns))
+        ]
 
     # -- routing -------------------------------------------------------------
 
     def owner_index(self, token_ids: Sequence[int]) -> Optional[int]:
         """Which member owns this prompt's prefix tree (None when the prompt
         has no complete block — nothing to route)."""
+        chain = self.replica_indices(token_ids)
+        return chain[0] if chain else None
+
+    def replica_indices(self, token_ids) -> List[int]:
+        """The ``replicas`` member indices responsible for this prompt, HRW
+        rank order: ``[owner, successor, ...]`` (empty when the prompt has
+        no complete block)."""
         chains = token_chain_hashes(token_ids, self.spec.block_tokens)
         if not chains:
+            return []
+        return rendezvous_ranked(self.member_ids, chains[0])[: self.replicas]
+
+    # -- failure-domain plumbing ---------------------------------------------
+
+    def _begin(self, i: int, heal: bool = True) -> Optional[bool]:
+        """Admission through member ``i``'s breaker: None = denied (the op
+        fast-fails locally without touching the member), else whether this
+        call is the half-open probe. A probe first heals a dead connection
+        (``reconnect``) so recovery covers the async data plane, whose ops
+        have no auto-reconnect decorator. Async callers pass ``heal=False``
+        and run :meth:`_probe_heal` in an executor themselves — the native
+        reconnect blocks up to the connect timeout, and paying that ON the
+        event loop would stall every other request exactly the way the
+        breaker exists to prevent."""
+        h = self._health[i]
+        if not h.breaker.allow():
+            h.fast_fails += 1
             return None
-        return rendezvous_owner(self.member_ids, chains[0])
+        probe = h.breaker.state == CircuitBreaker.HALF_OPEN
+        if probe:
+            h.probes += 1
+            if heal:
+                self._probe_heal(i)
+        return probe
 
-    def _owner(self, token_ids) -> Optional[KVConnector]:
-        i = self.owner_index(token_ids)
-        return None if i is None else self.members[i]
+    async def _begin_async(self, i: int) -> Optional[bool]:
+        """``_begin`` for coroutine paths: the probe's connection heal runs
+        in an executor so the event loop keeps serving other requests."""
+        probe = self._begin(i, heal=False)
+        if probe:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._probe_heal, i
+            )
+        return probe
 
-    def _absorb(self, exc: InfiniStoreException) -> None:
-        """The failure policy, in one place: strict mode re-raises the
-        member's error; degrade mode counts it (caller then returns its
-        miss value)."""
+    def _probe_heal(self, i: int):
+        """Best-effort reconnect of a dead member connection before its
+        probe op runs; a failed reconnect just lets the probe op fail and
+        re-open the breaker with doubled backoff."""
+        conn = getattr(self.members[i], "conn", None)
+        if conn is None:
+            return
+        try:
+            if not getattr(conn, "is_connected", True):
+                conn.reconnect()
+        except (InfiniStoreException, AttributeError):
+            pass
+
+    def _done(self, i: int, exc: Optional[BaseException]):
+        """Record an op outcome against member ``i``'s breaker/counters.
+        Semantic errors (miss / pressure) count as SUCCESS for liveness —
+        the member answered."""
+        h = self._health[i]
+        if exc is not None and _is_transport(exc):
+            h.errors += 1
+            h.last_error = repr(exc)
+            h.breaker.record_failure()
+        else:
+            if h.breaker.record_success():
+                h.recoveries += 1
+
+    def _degrade(self, candidates: Sequence[int], exc: Optional[BaseException]):
+        """The failure policy, in one place, applied when NO replica served
+        an op: strict mode re-raises (or synthesizes a typed error when
+        every breaker fast-failed); degrade mode counts it — aggregate and
+        against the OWNER (the attributable counter) — and the caller
+        returns its miss value."""
         if not self.degrade:
-            raise exc
+            if exc is not None:
+                raise exc
+            open_ids = [
+                self.member_ids[i]
+                for i in candidates
+                if self._health[i].breaker.state != CircuitBreaker.CLOSED
+            ]
+            raise InfiniStoreException(
+                f"no replica available (circuit open for {open_ids or candidates})"
+            )
         self.degraded_ops += 1
+        if candidates:
+            self._health[candidates[0]].degraded_ops += 1
+
+    def _read_failover(self, candidates: Sequence[int], call, miss_value):
+        """Sync read path: try each replica in HRW order under its breaker;
+        first success wins. Only when EVERY candidate is open or errors does
+        the failure policy apply."""
+        last: Optional[InfiniStoreException] = None
+        for rank, i in enumerate(candidates):
+            if self._begin(i) is None:
+                continue
+            try:
+                res = call(self.members[i])
+            except InfiniStoreException as e:
+                self._done(i, e)
+                last = e
+                continue
+            except BaseException:
+                # Non-store failures (StagingPoolExhausted backpressure,
+                # cancellation, caller bugs) propagate — but the breaker
+                # must still see an outcome, or a half-open probe escaping
+                # this way would wedge the breaker HALF_OPEN and fast-fail
+                # the member forever. They are not transport evidence, so
+                # they count as liveness.
+                self._done(i, None)
+                raise
+            self._done(i, None)
+            if rank:
+                self._health[i].replica_serves += 1
+            return res
+        self._degrade(candidates, last)
+        return miss_value
 
     # -- engine surface (KVConnector-shaped) ---------------------------------
 
     def lookup(self, token_ids: Sequence[int]) -> int:
-        member = self._owner(token_ids)
-        if member is None:
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
             return 0
-        try:
-            return member.lookup(token_ids)
-        except InfiniStoreException as e:
-            self._absorb(e)
-            return 0
+        return self._read_failover(
+            candidates, lambda m: m.lookup(token_ids), 0
+        )
 
     def start_fetch(
         self, token_ids, first_block: int = 0, limit_blocks=None
     ):
         """Two-phase admission over the pool: route the gate-free fetch to
-        the prefix owner (same rendezvous as load). Returns the member's
-        prefetch handle, or None when nothing is fetchable / the owner is
-        down under the degrade policy — callers then use the one-phase
-        ``load``. StagingPoolExhausted propagates (backpressure, not
-        failure)."""
-        member = self._owner(token_ids)
-        if member is None:
+        the prefix owner (same rendezvous as load), failing over to the
+        replica when the owner is open/erroring. Returns the serving
+        member's prefetch handle, or None when nothing is fetchable / no
+        replica is up under the degrade policy — callers then use the
+        one-phase ``load``. StagingPoolExhausted propagates (backpressure,
+        not failure)."""
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
             return None
-        try:
-            return member.start_fetch(
+        return self._read_failover(
+            candidates,
+            lambda m: m.start_fetch(
                 token_ids, first_block=first_block, limit_blocks=limit_blocks
-            )
-        except InfiniStoreException as e:
-            self._absorb(e)
-            return None
+            ),
+            None,
+        )
 
     async def load(
         self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
         on_layer=None,
     ):
-        member = self._owner(token_ids)
-        if member is None:
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
             return list(caches), 0
-        try:
-            return await member.load(
-                token_ids, caches, block_ids, first_block=first_block,
-                on_layer=on_layer,
-            )
-        except PartialReadError as e:
-            # The member died mid-read AFTER some layers' scatters donated
-            # their input buffers: the partial list is the only live one.
-            self._absorb(e)
-            return e.caches, 0
-        except InfiniStoreException as e:
-            self._absorb(e)
-            return list(caches), 0
+        last: Optional[InfiniStoreException] = None
+        for rank, i in enumerate(candidates):
+            if await self._begin_async(i) is None:
+                continue
+            try:
+                res = await self.members[i].load(
+                    token_ids, caches, block_ids, first_block=first_block,
+                    on_layer=on_layer,
+                )
+            except PartialReadError as e:
+                # The member died mid-read AFTER some layers' scatters
+                # donated their input buffers: e.caches is the ONLY live
+                # cache list, so no replica retry is possible — handing the
+                # originals (now deleted buffers on TPU) to another member
+                # would read freed memory. Policy applies directly.
+                self._done(i, e)
+                self._degrade(candidates, e)
+                return e.caches, 0
+            except InfiniStoreException as e:
+                # Failed before any scatter (probe/fetch): caches are
+                # intact — the replica may still serve the read whole.
+                self._done(i, e)
+                last = e
+                continue
+            except BaseException:
+                self._done(i, None)  # see _read_failover: never wedge a probe
+                raise
+            self._done(i, None)
+            if rank:
+                self._health[i].replica_serves += 1
+            return res
+        self._degrade(candidates, last)
+        return list(caches), 0
 
     async def save(
         self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0
     ) -> int:
-        member = self._owner(token_ids)
-        if member is None:
+        """Save to EVERY responsible replica (R=2: owner + successor), so a
+        later owner death degrades to replica reads instead of recompute.
+        Returns the blocks written to the fullest successful copy. Strict
+        mode treats under-replication (any replica skipped or failed) as an
+        error AFTER attempting the rest — a mirror outage is visible, not
+        silent; degrade mode counts it and keeps the surviving copy."""
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
             return 0
-        try:
-            return await member.save(
-                token_ids, caches, block_ids, first_block=first_block
-            )
-        except InfiniStoreException as e:
-            self._absorb(e)
-            return 0
+        written = 0
+        served = 0
+        last: Optional[InfiniStoreException] = None
+        for i in candidates:
+            if await self._begin_async(i) is None:
+                continue
+            try:
+                n = await self.members[i].save(
+                    token_ids, caches, block_ids, first_block=first_block
+                )
+            except InfiniStoreException as e:
+                self._done(i, e)
+                last = e
+                continue
+            except BaseException:
+                self._done(i, None)  # see _read_failover: never wedge a probe
+                raise
+            self._done(i, None)
+            served += 1
+            written = max(written, n)
+        if served < len(candidates):
+            if last is None and served:
+                # Every failure was a local fast-fail, yet a copy WAS
+                # written: strict mode still raises (under-replication must
+                # be visible), but the error must say so — not claim the
+                # save found no replica at all.
+                last = InfiniStoreException(
+                    f"under-replicated save: {served}/{len(candidates)} "
+                    "replicas took the write (remaining members' circuits "
+                    "open)"
+                )
+            self._degrade(candidates, last)
+        return written
 
     def stage_layer_save(
         self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
         first_block: int = 0,
     ):
         """Layer-granular save, routed: the whole request's blocks share a
-        chain root, so every layer's put lands on the SAME owner — routing
-        composes with layer-by-layer streaming for free. The returned
-        ``ship`` applies the cluster's failure policy (degrade mode turns a
-        dead owner into 0 blocks written)."""
-        member = self._owner(token_ids)
-        if member is None:
-            async def noop() -> int:
-                return 0
+        chain root, so every layer's put lands on the SAME serving member —
+        routing composes with layer-by-layer streaming for free.
 
-            return noop
-        ship = member.stage_layer_save(
-            token_ids, layer, kv_pair, block_ids, first_block=first_block
-        )
-
-        async def routed() -> int:
+        Staging (device gather + D2H) happens ONCE, on the first healthy
+        replica in HRW order — the layer-streaming path is latency-critical
+        and does not mirror (each additional replica would pay a full
+        device gather; use ``save`` for mirrored whole-request writes). The
+        failure policy covers BOTH phases: a stage-time member error obeys
+        degrade (returning the noop ship) instead of bypassing ``_absorb``
+        and crashing the engine, and the returned ``ship`` applies the same
+        policy to the network puts."""
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
+            return self._noop_ship()
+        last: Optional[InfiniStoreException] = None
+        for rank, i in enumerate(candidates):
+            if self._begin(i) is None:
+                continue
             try:
-                return await ship()
+                ship = self.members[i].stage_layer_save(
+                    token_ids, layer, kv_pair, block_ids, first_block=first_block
+                )
             except InfiniStoreException as e:
-                self._absorb(e)
-                return 0
+                # The stage-time failure path (pool/register/gather against
+                # a dead member) used to escape the failure policy entirely.
+                self._done(i, e)
+                last = e
+                continue
+            except BaseException:
+                self._done(i, None)  # see _read_failover: never wedge a probe
+                raise
+            self._done(i, None)
+            if rank:
+                self._health[i].replica_serves += 1
+            member_idx = i
 
-        return routed
+            async def routed() -> int:
+                try:
+                    n = await ship()
+                except InfiniStoreException as e:
+                    self._done(member_idx, e)
+                    self._degrade(candidates, e)
+                    return 0
+                self._done(member_idx, None)
+                return n
+
+            return routed
+        self._degrade(candidates, last)
+        return self._noop_ship()
+
+    @staticmethod
+    def _noop_ship():
+        async def noop() -> int:
+            return 0
+
+        return noop
 
     def drop(self, token_ids) -> int:
-        member = self._owner(token_ids)
-        if member is None:
+        """Remove this prompt's blocks from every responsible replica;
+        returns the largest per-member deletion count (replicas hold the
+        same keys)."""
+        candidates = self.replica_indices(token_ids)
+        if not candidates:
             return 0
-        try:
-            return member.drop(token_ids)
-        except InfiniStoreException as e:
-            self._absorb(e)
-            return 0
+        best = 0
+        served = 0
+        last: Optional[InfiniStoreException] = None
+        for i in candidates:
+            if self._begin(i) is None:
+                continue
+            try:
+                n = self.members[i].drop(token_ids)
+            except InfiniStoreException as e:
+                self._done(i, e)
+                last = e
+                continue
+            except BaseException:
+                self._done(i, None)  # see _read_failover: never wedge a probe
+                raise
+            self._done(i, None)
+            served += 1
+            best = max(best, n)
+        if served < len(candidates):
+            self._degrade(candidates, last)
+        return best
 
     # -- observability -------------------------------------------------------
 
+    def health(self) -> dict:
+        """Cheap, network-free failure-domain snapshot: the aggregate
+        degrade counter plus every member's breaker state and attributable
+        counters (errors / fast_fails / probes / recoveries / degraded_ops
+        / replica_serves / last_error). The engine harness surfaces this as
+        ``store_health`` in its metrics."""
+        return {
+            "degraded_ops": self.degraded_ops,
+            "replicas": self.replicas,
+            "degrade": self.degrade,
+            "members": [
+                {"member_id": mid, **h.as_dict()}
+                for mid, h in zip(self.member_ids, self._health)
+            ],
+        }
+
     def stats(self) -> List[dict]:
-        """Per-member connection stats with the member id attached; an
-        unreachable member reports ``{"unreachable": True}`` instead of
-        killing the listing (the cluster's own counter is
-        ``degraded_ops``)."""
+        """Per-member connection stats with the member id and failure-domain
+        health attached. A member with an OPEN breaker is reported
+        ``{"unreachable": True}`` WITHOUT touching it (the breaker exists so
+        a dead node costs no timeouts — including here); a closed member
+        that fails the stat query is likewise reported unreachable (and the
+        failure feeds its breaker)."""
         out = []
-        for mid, m in zip(self.member_ids, self.members):
-            # Members expose get_stats() themselves (KVConnector and the
-            # quantized connector both do) — the cluster stays blind to
-            # member internals; a member without it just reports its id.
-            getter = getattr(m, "get_stats", None)
-            try:
-                s = dict(getter()) if getter is not None else {}
-            except InfiniStoreException:
+        for i, (mid, m) in enumerate(zip(self.member_ids, self.members)):
+            h = self._health[i]
+            if h.breaker.state == CircuitBreaker.OPEN:
                 s = {"unreachable": True}
+            else:
+                # Members expose get_stats() themselves (KVConnector and the
+                # quantized connector both do) — the cluster stays blind to
+                # member internals; a member without it just reports its id.
+                getter = getattr(m, "get_stats", None)
+                try:
+                    s = dict(getter()) if getter is not None else {}
+                    self._done(i, None)
+                except InfiniStoreException as e:
+                    self._done(i, e)
+                    s = {"unreachable": True}
             s["member_id"] = mid
+            s.update(h.as_dict())
             out.append(s)
         return out

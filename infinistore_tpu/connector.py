@@ -213,13 +213,20 @@ class FetchCoalescer:
         try:
             await self.conn.read_cache_async(merged, self.block_size, self.base_ptr)
         except Exception as e:
-            if len(batch) == 1:
-                blocks, fut = batch[0]
-                if not fut.done():
-                    fut.set_exception(e)
+            # Per-submission retry exists to isolate ONE evicted/pressured
+            # key from its group-mates. A transport error is different: the
+            # whole connection is sick, and re-driving N submissions into it
+            # would burn N more timeouts against a dead store — fail the
+            # group fast instead (the store's own failover/breaker layers
+            # decide what happens next).
+            retryable = isinstance(
+                e, (InfiniStoreKeyNotFound, InfiniStoreResourcePressure)
+            )
+            if len(batch) == 1 or not retryable:
+                for blocks, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
                 return
-            # One member's evicted key must not doom its group-mates: retry
-            # each submission alone so only the genuinely missing one fails.
             for blocks, fut in batch:
                 if fut.done():
                     continue

@@ -955,4 +955,21 @@ class ContinuousBatchingHarness:
             "all_verified": all(
                 s.verified for s in self.stats if s.verified is not None
             ),
+            **self._store_health(),
         }
+
+    def _store_health(self) -> dict:
+        """Failure-domain visibility at the engine's own dashboard: when the
+        connector under the adapter is a self-healing pool
+        (ClusterKVConnector.health), surface its per-member breaker states
+        and degrade counters — the operator reading engine metrics is the
+        one who needs to know WHICH cache node is sick."""
+        health = getattr(
+            getattr(self.adapter, "connector", None), "health", None
+        )
+        if not callable(health):
+            return {}
+        try:
+            return {"store_health": health()}
+        except Exception:  # noqa: BLE001 - metrics must never kill the engine
+            return {}

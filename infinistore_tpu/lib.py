@@ -950,12 +950,24 @@ class StripedConnection:
     MAX_CHUNK_BLOCKS = 256
     EWMA_ALPHA = 0.3  # per-chunk throughput smoothing
 
-    def __init__(self, config: ClientConfig, streams: int = 4, adaptive: bool = True):
+    def __init__(
+        self,
+        config: ClientConfig,
+        streams: int = 4,
+        adaptive: bool = True,
+        conn_factory=None,
+    ):
+        """``conn_factory(config, stripe_index) -> InfinityConnection-shaped``
+        builds each stripe's connection (default: a plain
+        ``InfinityConnection``) — the seam chaos tests use to wrap individual
+        stripes in ``faults.FaultyConnection``."""
         if streams < 1:
             raise ValueError("streams must be >= 1")
         self.config = config
         self.adaptive = adaptive
-        self.conns = [InfinityConnection(config) for _ in range(streams)]
+        if conn_factory is None:
+            conn_factory = lambda cfg, i: InfinityConnection(cfg)
+        self.conns = [conn_factory(config, i) for i in range(streams)]
         # Per-stripe measured throughput EWMA in bytes/s (0 = unmeasured).
         # Persists across batches: the second batch starts from the first
         # batch's measured rates instead of re-probing.
@@ -968,7 +980,25 @@ class StripedConnection:
             "steals": 0,  # pulls beyond each worker's first (stolen share)
             "stripe_chunks": [0] * streams,
             "stripe_blocks": [0] * streams,
+            # Failure-domain counters (docs/robustness.md): per-stripe
+            # transport errors, spans handed back to the shared queue by a
+            # dying stripe, quarantine entries/exits, and sibling errors a
+            # raised batch suppressed (visible here instead of only in a
+            # log line).
+            "stripe_errors": [0] * streams,
+            "requeued_blocks": 0,
+            "quarantines": 0,
+            "rejoins": 0,
+            "suppressed_errors": 0,
         }
+        # Stripe quarantine: a stripe whose batched op dies with a TRANSPORT
+        # error hands its claimed span back to the shared queue, stops
+        # pulling, and reconnects in the background while the survivors
+        # drain the batch — one dead stream degrades throughput, never the
+        # op. _revive_tasks maps stripe index -> live reconnect task.
+        self._quarantined = [False] * streams
+        self._revive_tasks: dict = {}
+        self._striped_closed = False
         # Stripe 0 owns the shm segments the other stripes alias. WHENEVER it
         # reconnects — including a self-heal inside the auto_reconnect
         # decorator that this object never sees — the segments are unmapped
@@ -993,14 +1023,22 @@ class StripedConnection:
         await asyncio.gather(*(c.connect_async() for c in self.conns))
 
     def close(self):
-        """Close every stripe (unmaps stripe 0's shm segments)."""
+        """Close every stripe (unmaps stripe 0's shm segments) and stop any
+        background quarantine-reconnect tasks."""
+        self._striped_closed = True
+        for t in list(self._revive_tasks.values()):
+            t.cancel()
+        self._revive_tasks.clear()
         for c in self.conns:
             c.close()
 
     @property
     def is_connected(self) -> bool:
-        """True only when EVERY stripe's reactor is live (batched ops fan
-        out, so one dead stripe fails the batch)."""
+        """True only when EVERY stripe's reactor is live — full capacity.
+        Batched ops survive partial death (a dead stripe is quarantined and
+        the survivors drain the batch), so False here means degraded, not
+        necessarily down; ``data_plane_stats()["quarantined"]`` says which
+        stripes are out."""
         return all(c.is_connected for c in self.conns)
 
     def reconnect(self):
@@ -1083,21 +1121,132 @@ class StripedConnection:
         take = min(max(q, want), self.MAX_CHUNK_BLOCKS, max(q, fair), remaining)
         return max(1, (take // q) * q if take >= q else take)
 
+    @staticmethod
+    def _is_stripe_transport_error(e: BaseException) -> bool:
+        """Quarantine only on TRANSPORT failures: a semantic error
+        (KeyNotFound / pressure / no-match) means the server ANSWERED — the
+        same answer awaits on every sibling stripe, so requeueing the span
+        would just re-fail it; the batch aborts as one op instead."""
+        return isinstance(e, InfiniStoreException) and not isinstance(
+            e,
+            (
+                InfiniStoreKeyNotFound,
+                InfiniStoreResourcePressure,
+                InfiniStoreNoMatch,
+            ),
+        )
+
+    def _quarantine(self, idx: int, exc: BaseException, op_name: str):
+        """Remove stripe ``idx`` from the fan-out and start its background
+        reconnect (one task per stripe; idempotent across repeat failures)."""
+        stats = self._sched_stats
+        stats["stripe_errors"][idx] += 1
+        if not self._quarantined[idx]:
+            self._quarantined[idx] = True
+            stats["quarantines"] += 1
+        Logger.warn(
+            f"striped {op_name}: stripe {idx} failed ({exc!r}); quarantined, "
+            "reconnecting in background — survivors drain the batch"
+        )
+        live = self._revive_tasks.get(idx)
+        if live is not None and not live.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # no loop (sync teardown): the next op's sweep retries
+        task = loop.create_task(self._revive(idx))
+        self._revive_tasks[idx] = task
+
+    async def _revive(self, idx: int, base_delay: float = 0.05, max_delay: float = 2.0):
+        """Background reconnect loop for a quarantined stripe: exponential
+        backoff until the server takes the connection again, then re-alias
+        stripe 0's live shm segments (the reconnect dropped this stripe's
+        registrations of them) and rejoin the fan-out."""
+        delay = base_delay
+        conn = self.conns[idx]
+        loop = asyncio.get_running_loop()
+        while self._quarantined[idx] and not self._striped_closed:
+            if getattr(conn, "_closed", False):
+                return  # operator close() is final; stay quarantined
+            try:
+                await loop.run_in_executor(None, conn.reconnect)
+            except InfiniStoreException:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2.0, max_delay)
+                continue
+            if self._rejoin(idx):
+                Logger.warn(
+                    f"striped: stripe {idx} reconnected; rejoining the fan-out"
+                )
+            return
+
+    def _rejoin(self, idx: int) -> bool:
+        """Restore a reconnected stripe to the fan-out: re-register any of
+        stripe 0's live shm segments this stripe lost (its reconnect dropped
+        the alias registrations; ones it still holds are skipped, so a
+        rejoin after a non-reset error never double-registers), then clear
+        the quarantine flag. Shared by the background revive and the
+        op-entry sweep — without the alias step on BOTH paths, an
+        externally-reconnected stripe would rejoin, fail its first shm-base
+        chunk, and flap back into quarantine every batch."""
+        conn = self.conns[idx]
+        if idx != 0:
+            have = {p for p, _ in getattr(conn, "_segment_aliases", [])}
+            for buf in list(self.conns[0]._shm_bufs):
+                if buf.ctypes.data in have:
+                    continue
+                try:
+                    conn._register_segment_alias(buf.ctypes.data, buf.nbytes)
+                except InfiniStoreException:
+                    return False  # died again; stay quarantined, revive retries
+        if self._quarantined[idx]:
+            self._quarantined[idx] = False
+            self._sched_stats["rejoins"] += 1
+        return True
+
+    def _sweep_quarantine(self):
+        """Op-entry sweep: pick up stripes healed out-of-band (an external
+        reconnect) and restart revive tasks that died without rejoining."""
+        for idx, bad in enumerate(self._quarantined):
+            if not bad:
+                continue
+            if self.conns[idx].is_connected and self._rejoin(idx):
+                continue
+            live = self._revive_tasks.get(idx)
+            if live is None or live.done():
+                try:
+                    loop = asyncio.get_running_loop()
+                except RuntimeError:
+                    continue
+                self._revive_tasks[idx] = loop.create_task(self._revive(idx))
+
+    def _live_stripes(self) -> List[int]:
+        return [i for i, bad in enumerate(self._quarantined) if not bad]
+
     async def _adaptive_op(self, meth_name: str, blocks, block_size: int, ptr: int):
-        """Fan one batched op out over the stripes via the shared descriptor
-        queue. Every worker settles (its in-flight native op completes)
-        before this raises: a fail-fast would hand control back to a caller
-        who may free the staging buffer while sibling stripes are still
-        scatter/gathering from it in the native reactor."""
+        """Fan one batched op out over the live stripes via the shared
+        descriptor queue. Every worker settles (its in-flight native op
+        completes) before this raises: a fail-fast would hand control back
+        to a caller who may free the staging buffer while sibling stripes
+        are still scatter/gathering from it in the native reactor.
+
+        A stripe that dies with a TRANSPORT error hands its claimed span
+        back to the queue and is quarantined (background reconnect); the
+        survivors drain the remainder, so the batch completes — byte-
+        complete — whenever at least one stripe lives. Only when EVERY
+        stripe is gone with work still queued does the op raise."""
+        self._sweep_quarantine()
         descs = deque(wire.chunk_spans(len(blocks), self.CHUNK_QUANTUM_BLOCKS))
         remaining = [len(blocks)]  # cell: workers mutate between awaits
         stats = self._sched_stats
-        errors: list = []
+        fatal: list = []  # (idx, exc): semantic failure — abort the batch
+        handed_off: list = []  # (idx, exc): quarantined, span requeued
 
         async def worker(idx: int, conn: InfinityConnection):
             bound = getattr(conn, meth_name)
             pulls = 0
-            while descs and not errors:
+            while descs and not fatal:
                 take = self._pull_blocks(idx, remaining[0], block_size)
                 # Pop whole quanta without yielding: consecutive descriptors
                 # are contiguous by construction, so the merged span is one
@@ -1112,7 +1261,23 @@ class StripedConnection:
                 try:
                     await bound(chunk, block_size, ptr)
                 except BaseException as e:
-                    errors.append(e)
+                    if self._is_stripe_transport_error(e):
+                        # Give the claimed span back (quantum granularity,
+                        # so the survivors' tail splitting stays fine) and
+                        # leave the pool.
+                        for d in reversed(wire.chunk_spans(
+                            count, self.CHUNK_QUANTUM_BLOCKS
+                        )):
+                            descs.appendleft(wire.ChunkDesc(
+                                seq=first.seq, start=start + d.start,
+                                count=d.count,
+                            ))
+                        remaining[0] += count
+                        stats["requeued_blocks"] += count
+                        handed_off.append((idx, e))
+                        self._quarantine(idx, e, meth_name)
+                    else:
+                        fatal.append((idx, e))
                     return
                 dt = time.perf_counter() - t0
                 if dt > 0:
@@ -1129,25 +1294,66 @@ class StripedConnection:
             if pulls > 1:
                 stats["steals"] += pulls - 1
 
-        await asyncio.gather(*(worker(i, c) for i, c in enumerate(self.conns)))
-        if errors:
-            for extra in errors[1:]:  # don't silently drop sibling failures
-                Logger.warn(f"striped op: suppressed sibling stripe error: {extra!r}")
-            raise errors[0]
-        return wire.STATUS_OK
+        if not self._live_stripes():
+            raise InfiniStoreException(
+                f"{meth_name}: all {len(self.conns)} stripes quarantined "
+                "(reconnects pending)"
+            )
+        # Rounds, not one pass: a sibling that drained the visible queue and
+        # exited cannot see the span a still-in-flight dying stripe hands
+        # back AFTERWARDS — so while spans remain and live stripes exist,
+        # the survivors re-enter. Each extra round implies a fresh
+        # quarantine (that is the only way spans outlive a round), so this
+        # terminates within `streams` rounds.
+        while True:
+            live = self._live_stripes()
+            if not live:
+                _, err0 = handed_off[-1]
+                raise InfiniStoreException(
+                    f"{meth_name}: batch incomplete — every stripe failed "
+                    f"({remaining[0]} of {len(blocks)} blocks undelivered)"
+                ) from err0
+            await asyncio.gather(*(worker(i, self.conns[i]) for i in live))
+            if fatal:
+                idx0, err0 = fatal[0]
+                for idx, e in fatal[1:] + handed_off:
+                    stats["suppressed_errors"] += 1
+                    Logger.warn(
+                        f"striped {meth_name}: suppressed stripe-{idx} error "
+                        f"behind stripe-{idx0}'s: {e!r}"
+                    )
+                raise err0
+            if not descs:
+                return wire.STATUS_OK
 
-    @staticmethod
-    async def _gather_settled(coros):
+    async def _gather_settled(self, coros, meth_name: str):
         """Run the per-stripe chunk ops to completion — ALL of them — before
         raising (see _adaptive_op for why; this is the static-split
         variant's settle barrier)."""
         results = await asyncio.gather(*coros, return_exceptions=True)
-        errors = [r for r in results if isinstance(r, BaseException)]
+        errors = [
+            (i, r) for i, r in enumerate(results) if isinstance(r, BaseException)
+        ]
         if errors:
-            for extra in errors[1:]:  # don't silently drop sibling failures
-                Logger.warn(f"striped op: suppressed sibling stripe error: {extra!r}")
-            raise errors[0]
+            idx0, err0 = errors[0]
+            for idx, e in errors[1:]:  # don't silently drop sibling failures
+                self._sched_stats["suppressed_errors"] += 1
+                Logger.warn(
+                    f"striped {meth_name}: suppressed stripe-{idx} error "
+                    f"behind stripe-{idx0}'s: {e!r}"
+                )
+            raise err0
         return results[0]
+
+    def _first_live_conn(self) -> "InfinityConnection":
+        """Stripe 0 unless it is quarantined, else the first live stripe —
+        a small op must not fail just because one PARTICULAR stripe is down
+        while siblings live. With every stripe quarantined, stripe 0 takes
+        the op (and its transport error) as the honest answer."""
+        for i, bad in enumerate(self._quarantined):
+            if not bad:
+                return self.conns[i]
+        return self.conns[0]
 
     async def _batched(self, meth_name: str, blocks, block_size: int, ptr: int):
         stats = self._sched_stats
@@ -1156,7 +1362,10 @@ class StripedConnection:
             # Too small to be worth splitting: fan-out would only add per-op
             # round trips.
             stats["small_ops"] += 1
-            return await getattr(self.conns[0], meth_name)(blocks, block_size, ptr)
+            self._sweep_quarantine()
+            return await getattr(self._first_live_conn(), meth_name)(
+                blocks, block_size, ptr
+            )
         if self.adaptive:
             if self.memcpy_bound():
                 # Same host, memcpy data plane: one stream IS the ceiling —
@@ -1167,8 +1376,11 @@ class StripedConnection:
             return await self._adaptive_op(meth_name, blocks, block_size, ptr)
         chunks = self._split(blocks)
         return await self._gather_settled(
-            getattr(c, meth_name)(chunk, block_size, ptr)
-            for c, chunk in zip(self.conns, chunks)
+            (
+                getattr(c, meth_name)(chunk, block_size, ptr)
+                for c, chunk in zip(self.conns, chunks)
+            ),
+            meth_name,
         )
 
     async def rdma_write_cache_async(self, blocks, block_size: int, ptr: int):
@@ -1195,8 +1407,11 @@ class StripedConnection:
 
     def data_plane_stats(self) -> dict:
         """Scheduler observability: per-stripe chunk/block counts, steal
-        count, measured per-stripe EWMA rates, and how often the same-host
-        detector collapsed ops to stripe 0."""
+        count, measured per-stripe EWMA rates, how often the same-host
+        detector collapsed ops to stripe 0, and the failure-domain ledger
+        (per-stripe errors, requeued blocks, quarantine entries/exits,
+        current quarantine flags, suppressed sibling errors) — the counters
+        the bench's chaos receipts and the quarantine tests pin."""
         s = self._sched_stats
         return {
             "streams": len(self.conns),
@@ -1209,6 +1424,12 @@ class StripedConnection:
             "stripe_chunks": list(s["stripe_chunks"]),
             "stripe_blocks": list(s["stripe_blocks"]),
             "stripe_ewma_gbps": [round(b / (1 << 30), 4) for b in self._ewma_bps],
+            "stripe_errors": list(s["stripe_errors"]),
+            "requeued_blocks": s["requeued_blocks"],
+            "quarantines": s["quarantines"],
+            "rejoins": s["rejoins"],
+            "quarantined": list(self._quarantined),
+            "suppressed_errors": s["suppressed_errors"],
         }
 
     def completion_stats(self) -> dict:

@@ -18,6 +18,7 @@ it to ONE host copy per direction:
 """
 
 import math
+import weakref
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -146,11 +147,49 @@ class HostStagingPool:
         self.num_slots = nbytes // block_size
         self.conn = conn
         self.server_mapped = False
+        self._nbytes = nbytes
+        self._align = align
+        self._shm_backed = False
+        self._allocate(conn, nbytes, align)
+        # Self-heal across reconnects: an ``alloc_shm_mr``-backed pool dies
+        # with its connection's old segment (reconnect() unmaps it), which
+        # would leave every later read/write of this pool raising against an
+        # unregistered (worse: unmapped) buffer FOREVER on an otherwise
+        # healed member. Re-back the pool on the fresh connection instead.
+        # Weakly bound so a short-lived pool never pins itself to the
+        # connection through its own listener. Consumers are safe across the
+        # swap because they read ``pool.buf``/``base_ptr`` per op (and the
+        # connector's coalescer re-keys on base_ptr); ops in flight across a
+        # reconnect fail out with typed errors regardless.
+        # A StripedConnection has no listener list of its own: its shm
+        # segments live on stripe 0, so that is the reconnect that kills
+        # them — attach there (alloc_shm_mr on the striped surface then
+        # re-aliases stripes 1..N itself). Appended after the striped
+        # connection's own _on_owner_reconnect listener, so the stale
+        # sibling aliases are invalidated before this pool re-allocates.
+        owner = conn
+        if getattr(conn, "_reconnect_listeners", None) is None:
+            stripes = getattr(conn, "conns", None)
+            if stripes:
+                owner = stripes[0]
+        listeners = getattr(owner, "_reconnect_listeners", None)
+        if listeners is not None:
+            ref = weakref.WeakMethod(self._refresh_after_reconnect)
+            listeners.append(lambda: (lambda m: m() if m is not None else None)(ref()))
+        # Slot reservation state (reserve/release): a per-slot taken flag.
+        # Reservation is OPT-IN — legacy users (_LayerRegions, benches) carve
+        # the pool by fixed layout on a pool they own outright; a pool shared
+        # by reservers must only be used through reserve().
+        self._taken = bytearray(self.num_slots)
+        self._reserved_slots = 0
+
+    def _allocate(self, conn, nbytes: int, align: int):
         buf = None
         if conn is not None:
             buf = conn.alloc_shm_mr(nbytes)  # mmap: page-aligned by nature
             if buf is not None:
                 self.server_mapped = conn.shm_active
+                self._shm_backed = True
         if buf is None:
             # Over-allocate to align the base: DCN readv/writev and mlock both
             # like page-aligned bases.
@@ -158,15 +197,21 @@ class HostStagingPool:
             base_off = (-raw.ctypes.data) % align
             self._raw = raw  # keep alive
             buf = raw[base_off : base_off + nbytes]
+            self._shm_backed = False
             if conn is not None:
                 conn.register_mr(buf.ctypes.data, nbytes)
         self.buf = buf
-        # Slot reservation state (reserve/release): a per-slot taken flag.
-        # Reservation is OPT-IN — legacy users (_LayerRegions, benches) carve
-        # the pool by fixed layout on a pool they own outright; a pool shared
-        # by reservers must only be used through reserve().
-        self._taken = bytearray(self.num_slots)
-        self._reserved_slots = 0
+
+    def _refresh_after_reconnect(self):
+        """Reconnect listener: a plain registered buffer survived (the
+        reconnect re-registered it), but an shm segment did not — replace it
+        on the fresh connection. Slot accounting is untouched: leases stay
+        valid as accounting; their STAGED BYTES are gone, exactly like the
+        in-flight ops the reconnect already failed."""
+        if not self._shm_backed:
+            return
+        self.server_mapped = False
+        self._allocate(self.conn, self._nbytes, self._align)
 
     @property
     def slots_in_use(self) -> int:

@@ -19,6 +19,7 @@ ROUNDS = 4
 OPS_PER_ROUND = 60
 
 
+@pytest.mark.chaos
 @pytest.mark.parametrize("enable_shm", [False, True], ids=["socket", "shm"])
 def test_ops_stay_correct_across_repeated_restarts(enable_shm):
     srv = its.start_local_server(prealloc_bytes=32 << 20, block_bytes=BLOCK)
@@ -105,3 +106,307 @@ def test_ops_stay_correct_across_repeated_restarts(enable_shm):
     assert len(c._dead_handles) >= 1, "no reconnect ever happened"
     c.close()
     srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cluster chaos: one member killed/restarted mid-workload (ISSUE 3).
+# The invariant is unchanged from above, lifted to the pool: every read
+# returns CORRECT bytes or a typed error/miss — never wrong data, never a
+# hang — and the self-healing layer (breakers + R=2 replication) turns the
+# outage into replica reads instead of recompute under degrade=True.
+# ---------------------------------------------------------------------------
+
+
+def _restart_on_port(port, tries=50):
+    for _ in range(tries):
+        try:
+            return its.start_local_server(
+                host="127.0.0.1", service_port=port,
+                prealloc_bytes=64 << 20, block_bytes=BLOCK,
+            )
+        except its.InfiniStoreException:
+            time.sleep(0.1)
+    pytest.skip("could not rebind the chaos port")
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("degrade", [False, True], ids=["strict", "degrade"])
+def test_cluster_member_kill_restart_mid_workload(degrade):
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.cluster import CircuitBreaker, ClusterKVConnector
+    from infinistore_tpu.tpu import PagedKVCacheSpec, gather_blocks
+
+    spec = PagedKVCacheSpec(
+        num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+        head_dim=32, dtype=jnp.bfloat16,
+    )
+    servers, conns = [], []
+    try:
+        for _ in range(3):
+            srv = its.start_local_server(
+                prealloc_bytes=64 << 20, block_bytes=BLOCK
+            )
+            conn = its.InfinityConnection(
+                its.ClientConfig(
+                    host_addr="127.0.0.1", service_port=srv.port,
+                    log_level="error", auto_reconnect=True,
+                    connect_timeout_ms=500, op_timeout_ms=2000,
+                )
+            )
+            conn.connect()
+            servers.append(srv)
+            conns.append(conn)
+        cluster = ClusterKVConnector(
+            conns, spec, "chaos", max_blocks=8, degrade=degrade, replicas=2,
+            breaker_factory=lambda i: CircuitBreaker(
+                fail_threshold=2, probe_backoff_s=0.05, max_backoff_s=0.4,
+                seed=i,
+            ),
+        )
+
+        def mk_caches(seed):
+            out = []
+            for layer in range(spec.num_layers):
+                k = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + layer), spec.cache_shape,
+                    jnp.float32,
+                ).astype(spec.dtype)
+                v = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + 50 + layer),
+                    spec.cache_shape, jnp.float32,
+                ).astype(spec.dtype)
+                out.append((k, v))
+            return out
+
+        rng = np.random.default_rng(5)
+        prompts = [
+            rng.integers(0, 1000, size=2 * spec.block_tokens).tolist()
+            for _ in range(6)
+        ]
+        contents = {i: mk_caches(i) for i in range(len(prompts))}
+        src = np.array([3, 9], np.int32)
+        for i, p in enumerate(prompts):
+            asyncio.run(cluster.save(p, contents[i], src))
+
+        victim = cluster.owner_index(prompts[0])
+        port = servers[victim].port
+        servers[victim].stop()  # mid-workload node death
+
+        def read_all(expect_full: bool):
+            """One read pass over every prompt; verifies every delivered
+            byte. Returns (served, misses)."""
+            served = misses = 0
+            for i, p in enumerate(prompts):
+                dst = np.array([6, 2], np.int32)
+                try:
+                    hit = cluster.lookup(p)
+                    loaded, n = asyncio.run(
+                        cluster.load(p, spec.make_caches(), dst)
+                    )
+                except its.InfiniStoreException:
+                    assert not degrade, "degrade mode must absorb, not raise"
+                    misses += 1
+                    continue
+                assert n in (0, 2) and hit in (0, 2)
+                if n == 0:
+                    misses += 1
+                    continue
+                served += 1
+                for layer in range(spec.num_layers):
+                    for kind in (0, 1):
+                        got = np.asarray(
+                            gather_blocks(loaded[layer][kind], jnp.asarray(dst)),
+                            np.float32,
+                        )
+                        want = np.asarray(
+                            gather_blocks(
+                                contents[i][layer][kind], jnp.asarray(src)
+                            ),
+                            np.float32,
+                        )
+                        np.testing.assert_array_equal(got, want)
+            if expect_full:
+                assert misses == 0, "R=2: one node death must not cost a read"
+            return served, misses
+
+        # During the outage: with replicas=2 EVERY prompt is still served
+        # byte-correct — its surviving replica holds the mirror (3 members,
+        # R=2: the victim is never both replicas). Two passes so the opened
+        # breaker's fast-fail path serves reads too.
+        for _ in range(2):
+            served, _ = read_all(expect_full=True)
+            assert served == len(prompts)
+
+        # A save during the outage is under-replicated: typed error in
+        # strict mode, absorbed + counted in degrade mode — never a crash.
+        if degrade:
+            before = cluster.degraded_ops
+            assert asyncio.run(
+                cluster.save(prompts[0], contents[0], src)
+            ) == 2 * 2 * spec.num_layers  # surviving replica took it
+            assert cluster.degraded_ops == before + 1
+        else:
+            with pytest.raises(its.InfiniStoreException):
+                asyncio.run(cluster.save(prompts[0], contents[0], src))
+
+        # Restart: the half-open probe must re-admit the member within one
+        # probe window (asserted via per-member stats).
+        servers[victim] = _restart_on_port(port)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            cluster.lookup(prompts[0])
+            if (
+                cluster.health()["members"][victim]["breaker_state"]
+                == "closed"
+            ):
+                break
+            time.sleep(0.02)
+        h = cluster.health()["members"][victim]
+        assert h["breaker_state"] == "closed", h
+        assert h["probes"] >= 1 and h["recoveries"] >= 1
+
+        # Fully healed: saves mirror again and every read still verifies.
+        for i, p in enumerate(prompts):
+            asyncio.run(cluster.save(p, contents[i], src))
+        read_all(expect_full=True)
+    finally:
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for s in servers:
+            s.stop()
+
+
+# ---------------------------------------------------------------------------
+# Striped chaos: one stripe dies mid-batch (ISSUE 3). All stripes speak to
+# ONE server, so "this stripe's server died" is, as the client observes it,
+# its transport dropping mid-op — injected deterministically with
+# faults.FaultRule(action="reset"). The batch must complete byte-correct on
+# the survivors, the dead stripe must be quarantined and then rejoin after
+# its background reconnect (asserted via data_plane_stats()).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_striped_one_stripe_killed_mid_batch_completes_and_rejoins():
+    import asyncio
+
+    from infinistore_tpu.faults import FaultRule, FaultyConnection
+
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=BLOCK)
+    cfg = its.ClientConfig(
+        host_addr="127.0.0.1", service_port=srv.port, log_level="error",
+        enable_shm=False,  # no same-host collapse: the fan-out must run
+        connect_timeout_ms=1000, op_timeout_ms=5000,
+    )
+    victim = 2
+    # Stripe 2's transport is severed on its SECOND pull: mid-batch, after
+    # it already delivered one chunk.
+    rules = [FaultRule(op_indices=[1], action="reset", max_fires=1)]
+
+    def factory(config, i):
+        c = its.InfinityConnection(config)
+        return FaultyConnection(c, rules) if i == victim else c
+
+    sc = its.StripedConnection(cfg, streams=4, conn_factory=factory)
+    sc.connect()
+    n_blocks = 128
+    src = np.zeros(n_blocks * BLOCK, dtype=np.uint8)
+    dst = np.zeros(n_blocks * BLOCK, dtype=np.uint8)
+    rng = np.random.default_rng(11)
+    src[:] = rng.integers(0, 256, size=src.size, dtype=np.uint8)
+    sc.register_mr(src)
+    sc.register_mr(dst)
+    blocks = [(f"sq-{i}", i * BLOCK) for i in range(n_blocks)]
+
+    async def drive():
+        # The faulted batch: stripe 2 dies mid-op; survivors must drain the
+        # requeued spans and complete the WHOLE write.
+        await sc.write_cache_async(blocks, BLOCK, src.ctypes.data)
+        st = sc.data_plane_stats()
+        assert st["quarantines"] == 1
+        assert st["stripe_errors"][victim] == 1
+        assert st["requeued_blocks"] >= 1
+        # Read it all back (survivors again, or post-rejoin — both legal).
+        await sc.read_cache_async(blocks, BLOCK, dst.ctypes.data)
+        # Quarantine exits via the background reconnect: wait for rejoin.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if not any(sc.data_plane_stats()["quarantined"]):
+                break
+            await asyncio.sleep(0.05)
+        st = sc.data_plane_stats()
+        assert st["quarantined"] == [False] * 4, st
+        assert st["rejoins"] >= 1
+        # A post-rejoin batch runs on all four stripes again.
+        chunks_before = sc.data_plane_stats()["stripe_chunks"][victim]
+        await sc.write_cache_async(blocks, BLOCK, src.ctypes.data)
+        assert sc.data_plane_stats()["stripe_chunks"][victim] > chunks_before
+
+    asyncio.run(drive())
+    np.testing.assert_array_equal(dst, src)  # byte-correct despite the death
+    assert sc.is_connected  # full capacity restored
+    sc.close()
+    srv.stop()
+
+
+@pytest.mark.chaos
+def test_striped_whole_server_death_is_typed_error_then_recovers():
+    """Every stripe dying (the server itself is gone) must surface as ONE
+    typed error — never a hang, never partial silent success presented as
+    completion — and after a restart + reconnect the striped connection
+    serves verified bytes again (cold cache)."""
+    import asyncio
+
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=BLOCK)
+    port = srv.port
+    cfg = its.ClientConfig(
+        host_addr="127.0.0.1", service_port=port, log_level="error",
+        enable_shm=False, connect_timeout_ms=500, op_timeout_ms=2000,
+    )
+    sc = its.StripedConnection(cfg, streams=4)
+    sc.connect()
+    n_blocks = 64
+    src = np.zeros(n_blocks * BLOCK, dtype=np.uint8)
+    src[:] = 123
+    dst = np.zeros(n_blocks * BLOCK, dtype=np.uint8)
+    sc.register_mr(src)
+    sc.register_mr(dst)
+    blocks = [(f"sd-{i}", i * BLOCK) for i in range(n_blocks)]
+
+    async def doomed():
+        await sc.write_cache_async(blocks, BLOCK, src.ctypes.data)
+        srv.stop()
+        with pytest.raises(its.InfiniStoreException):
+            # Bounded: op timeouts cap every stripe's failure; quarantine
+            # must conclude "batch incomplete", not spin.
+            await asyncio.wait_for(
+                sc.read_cache_async(blocks, BLOCK, dst.ctypes.data), timeout=30
+            )
+
+    asyncio.run(doomed())
+
+    srv2 = _restart_on_port(port)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            sc.reconnect()
+            break
+        except its.InfiniStoreException:
+            time.sleep(0.2)
+
+    async def healed():
+        await sc.write_cache_async(blocks, BLOCK, src.ctypes.data)
+        dst[:] = 0
+        await sc.read_cache_async(blocks, BLOCK, dst.ctypes.data)
+
+    asyncio.run(healed())
+    np.testing.assert_array_equal(dst, src)
+    sc.close()
+    srv2.stop()

@@ -575,12 +575,16 @@ def test_striped_reconnect_after_server_restart():
     with pytest.raises(its.InfiniStoreException):
         for _ in range(10):
             asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
-    assert not c.is_connected
+    # The failed batch quarantined the dead stripes (and their background
+    # revive may already have healed some — the quarantine layer's job);
+    # reconnect() deterministically rebuilds whatever is still dead.
+    assert c.data_plane_stats()["quarantines"] >= 1
     c.reconnect()
     assert c.is_connected
     asyncio.run(c.write_cache_async(pairs, block, src.ctypes.data))
     asyncio.run(c.read_cache_async(pairs, block, dst.ctypes.data))
     assert np.array_equal(src, dst)
+    assert c.data_plane_stats()["quarantined"] == [False] * 3  # all rejoined
     c.close()
     srv2.stop()
 

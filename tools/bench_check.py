@@ -100,6 +100,33 @@ CHECKS = [
     # recorded while still catching the pathological regressions this gate
     # exists for (e.g. falling back to a per-op call_soon_threadsafe hop,
     # historically 3-5x).
+    # The self-healing invariant is binary, not a threshold: with R=2 over 3
+    # members a single node death must cost ZERO availability (every read is
+    # correct bytes from the replica or a typed miss) and ZERO wrong-data
+    # reads. Any other value means failover served lies or nothing.
+    Check(
+        "chaos_availability",
+        ["chaos_availability", "chaos_wrong_reads"],
+        lambda m: m["chaos_availability"] >= 1.0 and m["chaos_wrong_reads"] == 0,
+        lambda m: (
+            f"availability={m['chaos_availability']:.4f}, "
+            f"wrong_reads={m['chaos_wrong_reads']:.0f} under a member kill "
+            "(must be 1.0 / 0 with R=2 replication)"
+        ),
+    ),
+    # Breaker recovery: a restarted member must be re-admitted by a
+    # half-open probe, and promptly (probe backoff caps at 0.4s in the
+    # chaos leg; 5s leaves room for restart-bind retries + host weather).
+    # -1 means the member never recovered at all.
+    Check(
+        "chaos_breaker_recovery",
+        ["chaos_breaker_recovery_ms"],
+        lambda m: 0 <= m["chaos_breaker_recovery_ms"] <= 5000,
+        lambda m: (
+            f"breaker re-closed {m['chaos_breaker_recovery_ms']:.0f}ms after "
+            "restart (must be within one probe window; gate at 5s)"
+        ),
+    ),
     Check(
         "async_bridge_overhead",
         ["p50_fetch_4k_us", "sync_p50_fetch_4k_us"],

@@ -14,6 +14,7 @@ Usage: python tools/gen_api_docs.py [--check]
 
 import argparse
 import dataclasses
+import enum
 import inspect
 import os
 import sys
@@ -40,7 +41,13 @@ SURFACE = [
         "EngineKVAdapter", "ContinuousBatchingHarness", "BlockPool",
         "WaveDecoder", "DeviceGate", "RequestStats",
     ]),
-    ("infinistore_tpu.cluster", ["ClusterKVConnector", "rendezvous_owner"]),
+    ("infinistore_tpu.cluster", [
+        "ClusterKVConnector", "rendezvous_owner", "rendezvous_ranked",
+        "CircuitBreaker",
+    ]),
+    ("infinistore_tpu.faults", [
+        "FaultRule", "FaultyConnection", "kill_transport",
+    ]),
     ("infinistore_tpu.vllm_v1", [
         "KVConnectorRole",
         "KVConnectorBase_V1",
@@ -72,6 +79,12 @@ def _doc(obj) -> str:
 
 
 def _sig(obj) -> str:
+    # Enum constructor signatures are a CPython implementation detail that
+    # changed across 3.10 -> 3.12 ("(value, names=None, ...)" vs
+    # "(*values)"); rendering one would make --check depend on the
+    # interpreter that generated the file. Members are the actual surface.
+    if inspect.isclass(obj) and issubclass(obj, enum.Enum):
+        return "(" + ", ".join(m.name for m in obj) + ")"
     try:
         return str(inspect.signature(obj))
     except (ValueError, TypeError):
