@@ -1,4 +1,5 @@
-"""Operational features tour: spill tier, auto-reconnect, shaped striping.
+"""Operational features tour: spill tier, auto-reconnect, shaped striping,
+QoS service classes.
 
 Self-contained (starts its own servers); each section prints what it proves.
 
@@ -141,11 +142,52 @@ def quantized_cache():
         srv.stop()
 
 
+def qos_classes():
+    """Two-class QoS (docs/qos.md): tag a bulk save BACKGROUND so it yields
+    to decode-critical reads, then read the per-class ledger back from both
+    sides of the wire."""
+    from infinistore_tpu import wire
+
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=BLOCK)
+    c = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port, log_level="error")
+    )
+    c.connect()
+    n = 32
+    src = np.random.randint(0, 256, size=n * BLOCK, dtype=np.uint8)
+    c.register_mr(src)
+    pairs = [(f"qos-{i}", i * BLOCK) for i in range(n)]
+
+    async def tour():
+        # A prefill save is never decode-blocking: tag it BACKGROUND and it
+        # defers to foreground traffic in every queue it crosses (client
+        # gate, stripe pulls, server slice scheduler) — KVConnector.save
+        # does this automatically.
+        await c.write_cache_async(
+            pairs, BLOCK, src.ctypes.data, priority=wire.PRIORITY_BACKGROUND
+        )
+        # Untagged = FOREGROUND: byte-identical to the pre-QoS wire format.
+        await c.read_cache_async(pairs[:4], BLOCK, src.ctypes.data)
+
+    asyncio.run(tour())
+    client = c.qos_stats()
+    server_side = c.get_stats()["qos"]
+    print(f"[qos] client ledger: fg_ops={client['fg_ops']} bg_ops={client['bg_ops']} "
+          f"bg_deferred={client['bg_deferred']}")
+    print(f"[qos] server ledger: fg_ops={server_side['fg_ops']} "
+          f"bg_ops={server_side['bg_ops']} "
+          f"bg_preempted_slices={server_side['bg_preempted_slices']} "
+          f"bg_aged_slices={server_side['bg_aged_slices']}")
+    c.close()
+    srv.stop()
+
+
 def main():
     spill_tier()
     auto_reconnect()
     shaped_striping()
     quantized_cache()
+    qos_classes()
 
 
 if __name__ == "__main__":

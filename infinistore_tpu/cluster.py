@@ -260,6 +260,10 @@ class ClusterKVConnector:
     routing.
     """
 
+    # Accepts the two-class priority kwarg on start_fetch (adapters gate
+    # forwarding on this attribute — docs/qos.md).
+    QOS_AWARE = True
+
     def __init__(
         self,
         conns: Sequence,
@@ -320,6 +324,10 @@ class ClusterKVConnector:
         self._health = [
             _MemberHealth(breaker=breaker_factory(i)) for i in range(len(conns))
         ]
+        # Cluster-level QoS ledger (docs/qos.md): reads / fetches are
+        # FOREGROUND, saves (and their replica mirrors) and drops are
+        # BACKGROUND by construction. Surfaced in health().
+        self._qos = {"fg_ops": 0, "bg_ops": 0, "mirror_writes": 0}
 
     # -- routing -------------------------------------------------------------
 
@@ -454,12 +462,13 @@ class ClusterKVConnector:
         candidates = self.replica_indices(token_ids)
         if not candidates:
             return 0
+        self._qos["fg_ops"] += 1
         return self._read_failover(
             candidates, lambda m: m.lookup(token_ids), 0
         )
 
     def start_fetch(
-        self, token_ids, first_block: int = 0, limit_blocks=None
+        self, token_ids, first_block: int = 0, limit_blocks=None, priority: int = 0
     ):
         """Two-phase admission over the pool: route the gate-free fetch to
         the prefix owner (same rendezvous as load), failing over to the
@@ -471,10 +480,19 @@ class ClusterKVConnector:
         candidates = self.replica_indices(token_ids)
         if not candidates:
             return None
+        self._qos["bg_ops" if priority else "fg_ops"] += 1
         return self._read_failover(
             candidates,
+            # Forward the tag only to members that advertise the kwarg
+            # (wire.qos_kwargs convention: a pre-QoS member drops the tag,
+            # never TypeErrors).
             lambda m: m.start_fetch(
-                token_ids, first_block=first_block, limit_blocks=limit_blocks
+                token_ids, first_block=first_block, limit_blocks=limit_blocks,
+                **(
+                    {"priority": priority}
+                    if priority and getattr(m, "QOS_AWARE", False)
+                    else {}
+                ),
             ),
             None,
         )
@@ -486,6 +504,7 @@ class ClusterKVConnector:
         candidates = self.replica_indices(token_ids)
         if not candidates:
             return list(caches), 0
+        self._qos["fg_ops"] += 1
         last: Optional[InfiniStoreException] = None
         for rank, i in enumerate(candidates):
             if await self._begin_async(i) is None:
@@ -532,6 +551,7 @@ class ClusterKVConnector:
         candidates = self.replica_indices(token_ids)
         if not candidates:
             return 0
+        self._qos["bg_ops"] += 1
         written = 0
         served = 0
         last: Optional[InfiniStoreException] = None
@@ -551,6 +571,11 @@ class ClusterKVConnector:
                 raise
             self._done(i, None)
             served += 1
+            if served > 1:
+                # A non-first successful copy is the replication mirror —
+                # BACKGROUND traffic by construction (each member's
+                # KVConnector.save already tags its puts).
+                self._qos["mirror_writes"] += 1
             written = max(written, n)
         if served < len(candidates):
             if last is None and served:
@@ -669,6 +694,7 @@ class ClusterKVConnector:
             "degraded_ops": self.degraded_ops,
             "replicas": self.replicas,
             "degrade": self.degrade,
+            "qos": dict(self._qos),
             "members": [
                 {"member_id": mid, **h.as_dict()}
                 for mid, h in zip(self.member_ids, self._health)

@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import wire
 from .lib import (
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
@@ -167,11 +168,14 @@ class FetchCoalescer:
         self.submissions = 0  # logical submits merged into them
         self.max_batch = 0
 
-    def submit(self, blocks) -> "asyncio.Future":
+    def submit(self, blocks, priority: int = 0) -> "asyncio.Future":
         """Queue one logical read (list of (key, offset-from-base) pairs);
-        returns a future resolving when those bytes are staged."""
+        returns a future resolving when those bytes are staged.
+        ``priority``: QoS class (wire.PRIORITY_*) — submissions merge only
+        with same-class peers, so a BACKGROUND speculative prefetch never
+        drags a FOREGROUND admission fetch into its service class."""
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((blocks, fut))
+        self._pending.append((blocks, fut, priority))
         self.submissions += 1
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -183,18 +187,25 @@ class FetchCoalescer:
     def _group(self, batch):
         """Pack this tick's submissions into merged-call groups of at most
         ``max_merge_blocks`` blocks (a single oversized submission still
-        rides alone — the data plane chunks it internally)."""
-        if not self.max_merge_blocks:
-            return [batch]
-        groups, cur, cur_blocks = [], [], 0
-        for blocks, fut in batch:
-            if cur and cur_blocks + len(blocks) > self.max_merge_blocks:
-                groups.append(cur)
-                cur, cur_blocks = [], 0
-            cur.append((blocks, fut))
-            cur_blocks += len(blocks)
-        if cur:
-            groups.append(cur)
+        rides alone — the data plane chunks it internally), partitioned by
+        QoS class first so each merged call carries one honest tag."""
+        by_class: dict = {}
+        for blocks, fut, priority in batch:
+            by_class.setdefault(priority, []).append((blocks, fut))
+        groups = []
+        for priority, items in by_class.items():
+            if not self.max_merge_blocks:
+                groups.append((priority, items))
+                continue
+            cur, cur_blocks = [], 0
+            for blocks, fut in items:
+                if cur and cur_blocks + len(blocks) > self.max_merge_blocks:
+                    groups.append((priority, cur))
+                    cur, cur_blocks = [], 0
+                cur.append((blocks, fut))
+                cur_blocks += len(blocks)
+            if cur:
+                groups.append((priority, cur))
         return groups
 
     async def _flush(self):
@@ -204,14 +215,17 @@ class FetchCoalescer:
         self._flush_scheduled = False
         if not batch:
             return
-        await asyncio.gather(*(self._issue(g) for g in self._group(batch)))
+        await asyncio.gather(*(self._issue(g, p) for p, g in self._group(batch)))
 
-    async def _issue(self, batch):
+    async def _issue(self, batch, priority: int = 0):
         self.calls += 1
         self.max_batch = max(self.max_batch, len(batch))
         merged = [b for blocks, _ in batch for b in blocks]
+        pri_kw = wire.qos_kwargs(self.conn, priority)
         try:
-            await self.conn.read_cache_async(merged, self.block_size, self.base_ptr)
+            await self.conn.read_cache_async(
+                merged, self.block_size, self.base_ptr, **pri_kw
+            )
         except Exception as e:
             # Per-submission retry exists to isolate ONE evicted/pressured
             # key from its group-mates. A transport error is different: the
@@ -233,7 +247,7 @@ class FetchCoalescer:
                 self.calls += 1
                 try:
                     await self.conn.read_cache_async(
-                        blocks, self.block_size, self.base_ptr
+                        blocks, self.block_size, self.base_ptr, **pri_kw
                     )
                 except Exception as e2:
                     fut.set_exception(e2)
@@ -248,6 +262,10 @@ class FetchCoalescer:
 class KVConnector:
     """Bind one model's paged KV cache to a store connection.
 
+    ``QOS_AWARE``: this connector accepts the two-class priority kwarg on
+    ``start_fetch`` (adapters gate forwarding on the attribute so pre-QoS
+    connector stand-ins keep working — see docs/qos.md).
+
     The engine calls, per request:
       - ``lookup(tokens)`` -> how many leading blocks are already cached
       - ``load(tokens, caches, block_ids)`` -> scatter those blocks into the
@@ -255,6 +273,8 @@ class KVConnector:
       - ``save(tokens, caches, block_ids)`` -> stream the request's blocks
         out, layer by layer, overlapping D2H with the network
     """
+
+    QOS_AWARE = True
 
     def __init__(
         self,
@@ -375,11 +395,18 @@ class KVConnector:
             return 0
 
     async def save(
-        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
+        priority: int = wire.PRIORITY_BACKGROUND,
     ) -> int:
         """Stream the request's KV blocks to the store. ``block_ids[i]`` is
         the engine's physical block holding logical block ``first_block + i``
         of this prompt. Returns blocks written (K+V across layers).
+
+        Saves are BACKGROUND class by default (docs/qos.md): a prefill save
+        is never decode-blocking, so its store puts yield to concurrent
+        foreground reads in every queue they cross. Pass
+        ``priority=wire.PRIORITY_FOREGROUND`` to opt a save out (e.g. a
+        handoff the consumer is already waiting on).
 
         ``first_block`` serves sharded producers: under sequence-parallel
         prefill (models/long_context.py) each host holds only its chunk's
@@ -398,7 +425,8 @@ class KVConnector:
         if n == 0:
             return 0
         return await self._writer.write(
-            caches, np.asarray(block_ids[:n]), self._key_fn(chains)
+            caches, np.asarray(block_ids[:n]), self._key_fn(chains),
+            priority=priority,
         )
 
     async def load(
@@ -462,6 +490,7 @@ class KVConnector:
         first_block: int = 0,
         limit_blocks: Optional[int] = None,
         prefetch_pool: Optional[HostStagingPool] = None,
+        priority: int = wire.PRIORITY_FOREGROUND,
     ) -> LayerwisePrefetch:
         """Begin the GATE-FREE half of a load: probe the store (one control
         round trip) and immediately start streaming the hit prefix's layers
@@ -476,6 +505,13 @@ class KVConnector:
         Concurrent admissions' fetches coalesce into shared batched store
         reads (:class:`FetchCoalescer`), so a wave of requests splits
         striped connections instead of queueing serially.
+
+        ``priority``: QoS class of the fetch's store reads. Admission-
+        blocking fetches stay FOREGROUND (the default, untagged);
+        engines tag a speculative prefetch for a request beyond the next
+        wave ``wire.PRIORITY_BACKGROUND`` so it never delays
+        decode-blocking reads (docs/qos.md). Same-class submissions still
+        coalesce; classes never merge.
 
         Raises :class:`~.tpu.staging.StagingPoolExhausted` when the
         prefetch arena cannot hold another pipeline — callers treat that
@@ -496,6 +532,16 @@ class KVConnector:
             n = min(n, limit_blocks)
         pool = prefetch_pool or self._ensure_prefetch_pool()
         span = chains[first_block : first_block + n]
+        # Mutable class cell so promote() upgrades LATER submissions even
+        # on the coalescer path (the closure reads it per call).
+        pri_cell = {"value": priority}
+        if prefetch_pool is None:
+            coalescer = self._ensure_coalescer(pool)
+            submit = lambda blocks: coalescer.submit(
+                blocks, priority=pri_cell["value"]
+            )
+        else:
+            submit = None
         try:
             handle = LayerwisePrefetch(
                 self.conn,
@@ -504,9 +550,11 @@ class KVConnector:
                 self._key_fn(span),
                 n,
                 self.spec.num_layers,
-                submit=self._ensure_coalescer(pool).submit
-                if prefetch_pool is None
-                else None,
+                submit=submit,
+                priority=priority,
+                # One shared cell: promote() on the handle flips the class
+                # the coalescer closure reads too.
+                priority_cell=pri_cell,
             )
         except StagingPoolExhausted as e:
             # The probe already ran — hand its answer to the fallback so a
@@ -588,6 +636,10 @@ class KVConnector:
         ])
         keys_k = [(self.block_key(layer, "k", chains[i]), i * bn) for i in range(n)]
         keys_v = [(self.block_key(layer, "v", chains[i]), (n + i) * bn) for i in range(n)]
+        # Layer-streamed saves are BACKGROUND by construction (docs/qos.md):
+        # they run behind the engine's forward pass and must never delay a
+        # decode-blocking fetch.
+        pri_kw = wire.qos_kwargs(self.conn, wire.PRIORITY_BACKGROUND)
 
         async def ship() -> int:
             loop = asyncio.get_running_loop()
@@ -595,8 +647,8 @@ class KVConnector:
             base = kv_host.ctypes.data
             try:
                 await asyncio.gather(
-                    self.conn.write_cache_async(keys_k, bn, base),
-                    self.conn.write_cache_async(keys_v, bn, base),
+                    self.conn.write_cache_async(keys_k, bn, base, **pri_kw),
+                    self.conn.write_cache_async(keys_v, bn, base, **pri_kw),
                 )
             finally:
                 tr.release()
@@ -687,7 +739,13 @@ class KVConnector:
                         "([axis_size, num_blocks, *block]) require src and dst "
                         "shard indices so the transfer rides the interconnect."
                     )
-        await self.save(token_ids, caches, np.asarray(src_block_ids)[:n])
+        # FOREGROUND: a handoff's consumer is actively waiting on this save
+        # (it loads the same blocks next) — background class would delay
+        # exactly the reader it feeds.
+        await self.save(
+            token_ids, caches, np.asarray(src_block_ids)[:n],
+            priority=wire.PRIORITY_FOREGROUND,
+        )
         return await self.load(token_ids, caches, np.asarray(dst_block_ids)[:n])
 
     def get_stats(self) -> dict:

@@ -57,6 +57,7 @@ import numpy as np
 from .models.llama import prefill, prefill_continue, verify_step_batched
 from .tpu.paged import gather_blocks
 from .tpu.staging import StagingPoolExhausted
+from .wire import PRIORITY_BACKGROUND
 
 
 class BlockPool:
@@ -323,6 +324,11 @@ class EngineKVAdapter:
     """vLLM-TPU-style connector surface over ``KVConnector`` (engine terms:
     token counts in, engine-owned physical block tables in, caches out)."""
 
+    # This adapter can forward the two-class QoS tag (wire.PRIORITY_*) on
+    # start_fetch; the harness gates tagging on the attribute so duck-typed
+    # adapter stand-ins without the kwarg keep working.
+    QOS_AWARE = True
+
     def __init__(self, connector):
         self.connector = connector
         self.block_tokens = connector.spec.block_tokens
@@ -332,17 +338,34 @@ class EngineKVAdapter:
         store already holds (block-aligned; one control round trip)."""
         return self.connector.lookup(token_ids) * self.block_tokens
 
-    def start_fetch(self, token_ids, limit_blocks: Optional[int] = None):
+    def start_fetch(
+        self, token_ids, limit_blocks: Optional[int] = None, priority: int = 0
+    ):
         """Speculative, gate-free half of a load: probe + start streaming
         the hit prefix into host staging NOW (before the engine has even
         allocated blocks). Returns a prefetch handle (``hit_blocks``,
         ``install``, ``discard`` — KVConnector.start_fetch), or None when
         the underlying connector has no two-phase path (the caller then
         uses the one-phase ``load_kv``). StagingPoolExhausted propagates —
-        it is admission backpressure, not failure."""
+        it is admission backpressure, not failure.
+
+        ``priority``: QoS class for the fetch's store reads
+        (wire.PRIORITY_*) — the harness tags a prefetch BACKGROUND when
+        the request cannot make the next wave anyway (docs/qos.md). The
+        kwarg is forwarded only when nonzero and the connector advertises
+        ``QOS_AWARE`` — a pre-QoS duck-typed connector keeps its old
+        signature and the tag is dropped, never TypeError'd (the
+        wire.qos_kwargs convention)."""
         if not hasattr(self.connector, "start_fetch"):
             return None
-        return self.connector.start_fetch(token_ids, limit_blocks=limit_blocks)
+        kw = (
+            {"priority": priority}
+            if priority and getattr(self.connector, "QOS_AWARE", False)
+            else {}
+        )
+        return self.connector.start_fetch(
+            token_ids, limit_blocks=limit_blocks, **kw
+        )
 
     async def install_kv(self, prefetch, caches, block_table: np.ndarray):
         """The short exclusive half: scatter a prefetch's staged layers
@@ -673,8 +696,19 @@ class ContinuousBatchingHarness:
         # simply keep the one-phase gated load below.
         starter = getattr(self.adapter, "start_fetch", None)
         if starter is not None:
+            # QoS: a request the block pool cannot admit right now is beyond
+            # the next wave — its speculative fetch is opportunistic, so it
+            # rides BACKGROUND class and never delays the current wave's
+            # decode-blocking reads. Requests that can start immediately
+            # keep the FOREGROUND (untagged) fetch. Only adapters that
+            # advertise the kwarg (QOS_AWARE) are tagged.
+            fetch_kw = {}
+            if getattr(self.adapter, "QOS_AWARE", False) and (
+                self.pool.available < total_blocks
+            ):
+                fetch_kw["priority"] = PRIORITY_BACKGROUND
             try:
-                prefetch = starter(token_ids, limit_blocks=n_blocks)
+                prefetch = starter(token_ids, limit_blocks=n_blocks, **fetch_kw)
             except StagingPoolExhausted as e:
                 # Admission backpressure: the staging arena is carrying a
                 # full wave already — this request takes the gated load,
@@ -686,6 +720,13 @@ class ContinuousBatchingHarness:
         table = None
         try:
             table = await self.pool.alloc(total_blocks)
+            if prefetch is not None:
+                # Admitted: a background-tagged speculative fetch is
+                # decode-blocking from here — upgrade its remaining
+                # submissions to foreground (no-op when already untagged).
+                promote = getattr(prefetch, "promote", None)
+                if promote is not None:
+                    promote()
             prompt_table = table[:n_blocks]  # tail blocks (if any) are for generation
             gate_hold_us = fetch_us = 0.0
             overlap = None

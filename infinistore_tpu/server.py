@@ -117,6 +117,28 @@ def _prometheus_text(stats: dict) -> bytes:
             "# TYPE infinistore_spill_dropped counter",
             f"infinistore_spill_dropped {spill['dropped']}",
         ]
+    # Data-plane queue depth + two-class QoS scheduler counters
+    # (docs/qos.md): suspended sliced ops by class, per-class dispatch and
+    # slice counts, and the scheduler's preempt/age decisions.
+    qos = stats.get("qos")
+    if qos is not None:
+        lines += [
+            "# TYPE infinistore_dataplane_suspended_ops gauge",
+            f"infinistore_dataplane_suspended_ops {stats.get('suspended_ops', 0)}",
+            "# TYPE infinistore_qos_queued gauge",
+            f'infinistore_qos_queued{{class="fg"}} {qos["fg_queued"]}',
+            f'infinistore_qos_queued{{class="bg"}} {qos["bg_queued"]}',
+            "# TYPE infinistore_qos_ops counter",
+            f'infinistore_qos_ops{{class="fg"}} {qos["fg_ops"]}',
+            f'infinistore_qos_ops{{class="bg"}} {qos["bg_ops"]}',
+            "# TYPE infinistore_qos_slices counter",
+            f'infinistore_qos_slices{{class="fg"}} {qos["fg_slices"]}',
+            f'infinistore_qos_slices{{class="bg"}} {qos["bg_slices"]}',
+            "# TYPE infinistore_qos_bg_preempted_slices counter",
+            f"infinistore_qos_bg_preempted_slices {qos['bg_preempted_slices']}",
+            "# TYPE infinistore_qos_bg_aged_slices counter",
+            f"infinistore_qos_bg_aged_slices {qos['bg_aged_slices']}",
+        ]
     # Exposition format requires all samples of a family in one uninterrupted
     # group after its TYPE line — one pass per family, not per op.
     ops = sorted(stats.get("ops", {}).items())

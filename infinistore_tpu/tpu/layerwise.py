@@ -22,6 +22,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from .. import wire
 from ..lib import (
     InfiniStoreException,
     InfiniStoreKeyNotFound,
@@ -132,8 +133,12 @@ class LayerwiseKVWriter:
         caches: Sequence[Tuple[jax.Array, jax.Array]],
         block_ids: np.ndarray,
         key_fn: KeyFn,
+        priority: int = wire.PRIORITY_FOREGROUND,
     ) -> int:
-        """Returns total blocks written (K+V across layers)."""
+        """Returns total blocks written (K+V across layers). ``priority``:
+        QoS class for the network puts — connectors tag whole-request saves
+        BACKGROUND (prefill saves must not delay decode-blocking reads;
+        docs/qos.md) while the default stays untagged."""
         n = len(block_ids)
         if n == 0:
             return 0
@@ -200,13 +205,14 @@ class LayerwiseKVWriter:
                         total += await drain_one()
                 (kv_host,) = tr.wait()  # registers the packed buffer
                 base = kv_host.ctypes.data
+                pri_kw = wire.qos_kwargs(self.conn, priority)
                 futs = (
                     asyncio.ensure_future(self.conn.write_cache_async(
                         [(key_fn(layer, "k", i), i * bn) for i in range(n)],
-                        bn, base)),
+                        bn, base, **pri_kw)),
                     asyncio.ensure_future(self.conn.write_cache_async(
                         [(key_fn(layer, "v", i), i * bn) for i in range(n)],
-                        bn, base + n * bn)),
+                        bn, base + n * bn, **pri_kw)),
                 )
                 inflight.append((futs, tr, 2 * n))
                 top_up()  # refill the D2H pipeline before blocking again
@@ -386,10 +392,17 @@ class LayerwisePrefetch:
         num_layers: int,
         regions: Optional[int] = None,
         submit=None,
+        priority: int = wire.PRIORITY_FOREGROUND,
+        priority_cell: Optional[dict] = None,
     ):
         """``submit(blocks)``: optional override for the store read (the
         connector's fetch coalescer batches concurrent admissions' reads
         into shared calls); default is a direct ``read_cache_async``.
+        ``priority``: QoS class for the default submit's store reads —
+        admission-blocking fetches stay FOREGROUND (untagged); a
+        speculative prefetch beyond the next wave may be tagged
+        BACKGROUND (docs/qos.md). Ignored when ``submit`` is given (the
+        coalescer owns tagging there).
         Raises :class:`~..tpu.staging.StagingPoolExhausted` when the pool
         cannot hold even a double-buffered pipeline."""
         self.conn = conn
@@ -398,6 +411,17 @@ class LayerwisePrefetch:
         self.n_blocks = n_blocks
         self.num_layers = num_layers
         self.hit_blocks = n_blocks  # overridden by the connector's lookup
+        # QoS class cell read per submission (not captured once): promote()
+        # flips it when the request is ADMITTED — a speculative background
+        # prefetch whose request made it into the engine is decode-blocking
+        # from that moment, and leaving it background would serve the
+        # install at the aged background trickle. A caller whose ``submit``
+        # override tags its own store calls shares ITS cell via
+        # ``priority_cell`` so promote() flips that closure too (the
+        # connector's coalescer path).
+        self._pri_cell = (
+            priority_cell if priority_cell is not None else {"value": priority}
+        )
         self.blocks_fetched = 0  # K+V blocks landed in staging
         self.blocks_installed = 0  # K+V blocks scattered to the device
         self.fetch_started_s = time.perf_counter()
@@ -434,8 +458,12 @@ class LayerwisePrefetch:
                 if r <= (1 if num_layers == 1 else 2):
                     raise
         self._lease = lease
+        pri_cell = self._pri_cell  # closure reads the LIVE class (promote())
         self._submit = submit or (
-            lambda blocks: conn.read_cache_async(blocks, bn, pool.base_ptr)
+            lambda blocks: conn.read_cache_async(
+                blocks, bn, pool.base_ptr,
+                **wire.qos_kwargs(conn, pri_cell["value"]),
+            )
         )
         loop = asyncio.get_running_loop()
         self._staged = [loop.create_future() for _ in range(num_layers)]
@@ -550,6 +578,17 @@ class LayerwisePrefetch:
         """Blocks fetched into staging that never reached the device —
         meaningful once the prefetch settled (installed or discarded)."""
         return max(0, self.blocks_fetched - self.blocks_installed)
+
+    def promote(self) -> None:
+        """Upgrade the remaining fetch to FOREGROUND class. Engines call
+        this the moment the request is ADMITTED (block pool allocated): a
+        speculative BACKGROUND prefetch is opportunistic only while its
+        request waits beyond the next wave — once admitted, its remaining
+        layer fetches are decode-blocking and must not drain at the aged
+        background trickle. Submissions already in flight finish at their
+        original class (bounded by the aging escapes); later ones go out
+        untagged. No-op on an already-foreground prefetch. Idempotent."""
+        self._pri_cell["value"] = wire.PRIORITY_FOREGROUND
 
     async def primed(self) -> None:
         """Wait (gate-free) until the fetch pipeline is full: every staging

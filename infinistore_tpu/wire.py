@@ -49,6 +49,27 @@ STATUS_OOM = STATUS_OUT_OF_MEMORY
 _REQ_HEADER = struct.Struct("<IBI")  # magic, op, body_size (9 bytes)
 _RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 bytes)
 
+# Two-class QoS service model (docs/qos.md). FOREGROUND is the default and
+# encodes as NO wire bytes (the priority-off path stays byte-identical);
+# BACKGROUND rides an optional trailing tag byte on the batch/segment
+# metadata bodies, which pre-QoS decoders never read (the body length is
+# explicit) and pre-QoS encoders never produce.
+PRIORITY_FOREGROUND = 0
+PRIORITY_BACKGROUND = 1
+
+
+def qos_kwargs(conn, priority: int) -> dict:
+    """Kwargs for tagging a batched op on ``conn`` with ``priority``.
+
+    Empty when the op is FOREGROUND (untagged — the default path must stay
+    byte-identical AND signature-compatible with priority-unaware
+    connection stand-ins) or when ``conn`` does not advertise ``QOS_AWARE``
+    (a tag it cannot carry is dropped, not TypeError'd — QoS degrades to
+    FIFO, never breaks the data plane)."""
+    if priority and getattr(conn, "QOS_AWARE", False):
+        return {"priority": priority}
+    return {}
+
 
 def pack_req_header(op: int, body_size: int) -> bytes:
     return _REQ_HEADER.pack(MAGIC, op, body_size)
@@ -127,18 +148,26 @@ class Reader:
 @dataclass
 class BatchMeta:
     """Batched block metadata (native BatchMeta; reference RemoteMetaRequest,
-    reference src/meta_request.fbs:2-8)."""
+    reference src/meta_request.fbs:2-8). ``priority`` is the QoS class tag:
+    FOREGROUND (0) encodes nothing — byte-identical to the pre-QoS format —
+    and BACKGROUND appends one trailing byte."""
 
     block_size: int = 0
     keys: List[str] = field(default_factory=list)
+    priority: int = PRIORITY_FOREGROUND
 
     def encode(self) -> bytes:
-        return struct.pack("<I", self.block_size) + encode_str_list(self.keys)
+        out = struct.pack("<I", self.block_size) + encode_str_list(self.keys)
+        if self.priority:
+            out += struct.pack("<B", self.priority)
+        return out
 
     @classmethod
     def decode(cls, data: bytes) -> "BatchMeta":
         r = Reader(data)
         m = cls(block_size=r.u32(), keys=r.str_list())
+        if not r.done:
+            m.priority = r.u8()
         return m
 
 
@@ -221,18 +250,22 @@ class SegMeta:
 @dataclass
 class SegBatchMeta:
     """One-RTT batched op against a registered segment (native SegBatchMeta:
-    PutFrom / GetInto); block i lives at segment offset offsets[i]."""
+    PutFrom / GetInto); block i lives at segment offset offsets[i].
+    ``priority`` follows BatchMeta's optional-trailing-byte scheme."""
 
     block_size: int = 0
     seg_id: int = 0
     keys: List[str] = field(default_factory=list)
     offsets: List[int] = field(default_factory=list)
+    priority: int = PRIORITY_FOREGROUND
 
     def encode(self) -> bytes:
         out = [struct.pack("<IH", self.block_size, self.seg_id)]
         out.append(encode_str_list(self.keys))
         out.append(struct.pack("<I", len(self.offsets)))
         out.extend(struct.pack("<Q", off) for off in self.offsets)
+        if self.priority:
+            out.append(struct.pack("<B", self.priority))
         return b"".join(out)
 
     @classmethod
@@ -240,6 +273,8 @@ class SegBatchMeta:
         r = Reader(data)
         m = cls(block_size=r.u32(), seg_id=r.u16(), keys=r.str_list())
         m.offsets = [r.u64() for _ in range(r.u32())]
+        if not r.done:
+            m.priority = r.u8()
         return m
 
 

@@ -86,14 +86,19 @@ class Connection {
     // Async batched block write: for each i, send block_size bytes from
     // base_ptr+offsets[i] under keys[i]. cb fires from the reactor thread with
     // an HTTP-like status. Returns 0 on submit, -1 if not connected /
-    // unregistered base.
+    // unregistered base. ``priority`` is the QoS class tag (protocol.h
+    // Priority): kPriorityForeground (default) leaves the wire bytes
+    // untouched; kPriorityBackground marks the op for the server's
+    // two-level slice scheduler (docs/qos.md).
     int put_batch_async(const std::vector<std::string>& keys,
                         const std::vector<uint64_t>& offsets, uint32_t block_size,
-                        void* base_ptr, CompletionCb cb, void* ctx);
+                        void* base_ptr, CompletionCb cb, void* ctx,
+                        uint8_t priority = kPriorityForeground);
     // Async batched block read into base_ptr+offsets[i].
     int get_batch_async(const std::vector<std::string>& keys,
                         const std::vector<uint64_t>& offsets, uint32_t block_size,
-                        void* base_ptr, CompletionCb cb, void* ctx);
+                        void* base_ptr, CompletionCb cb, void* ctx,
+                        uint8_t priority = kPriorityForeground);
 
     // Sync batched ops: same pipeline, but the calling thread blocks on the
     // completion (promise wait — no event-loop hop). This is the low-latency
@@ -105,9 +110,11 @@ class Connection {
     // still complete server-side, and the base region must stay registered
     // and alive until close() (true for staging pools by construction).
     int put_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-                  uint32_t block_size, void* base_ptr);
+                  uint32_t block_size, void* base_ptr,
+                  uint8_t priority = kPriorityForeground);
     int get_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-                  uint32_t block_size, void* base_ptr);
+                  uint32_t block_size, void* base_ptr,
+                  uint8_t priority = kPriorityForeground);
 
     // Sync ops (safe to call from any thread; they ride the same pipeline).
     int tcp_put(const std::string& key, const void* data, size_t size);
@@ -171,10 +178,12 @@ class Connection {
     // Shared request construction for the batched data plane (async + sync).
     std::unique_ptr<Request> build_put(const std::vector<std::string>& keys,
                                        const std::vector<uint64_t>& offsets,
-                                       uint32_t block_size, void* base_ptr);
+                                       uint32_t block_size, void* base_ptr,
+                                       uint8_t priority);
     std::unique_ptr<Request> build_get(const std::vector<std::string>& keys,
                                        const std::vector<uint64_t>& offsets,
-                                       uint32_t block_size, void* base_ptr);
+                                       uint32_t block_size, void* base_ptr,
+                                       uint8_t priority);
     void shm_handshake();
     char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);
     // Reactor-side: handle a PutAlloc/GetLoc response. Returns the request

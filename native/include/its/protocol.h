@@ -57,6 +57,17 @@ enum Op : uint8_t {
     kOpGetInto = 'I',        // push stored blocks into client segment offsets
 };
 
+// Two-class QoS service model (docs/qos.md): FOREGROUND (decode-blocking
+// reads) vs BACKGROUND (saves, replica mirrors, spill-feeding churn).
+// FOREGROUND is the default and encodes NOTHING — the priority-off wire
+// format is byte-identical to the pre-QoS one; BACKGROUND rides an optional
+// trailing tag byte on BatchMeta/SegBatchMeta, which old decoders never read
+// (body length is explicit) and old encoders never produce.
+enum Priority : uint8_t {
+    kPriorityForeground = 0,
+    kPriorityBackground = 1,
+};
+
 // HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
 enum Status : uint32_t {
     kStatusOk = 200,
@@ -169,17 +180,20 @@ class WireReader {
 struct BatchMeta {
     uint32_t block_size = 0;
     std::vector<std::string> keys;
+    uint8_t priority = kPriorityForeground;  // optional trailing byte; 0 = untagged
 
     void encode(std::vector<uint8_t>& out) const {
         WireWriter w(out);
         w.u32(block_size);
         w.str_list(keys);
+        if (priority != kPriorityForeground) w.u8(priority);
     }
     static BatchMeta decode(const uint8_t* data, size_t size) {
         WireReader r(data, size);
         BatchMeta m;
         m.block_size = r.u32();
         m.keys = r.str_list();
+        if (!r.done()) m.priority = r.u8();
         return m;
     }
 };
@@ -327,6 +341,7 @@ struct SegBatchMeta {
     uint16_t seg_id = 0;
     std::vector<std::string> keys;
     std::vector<uint64_t> offsets;
+    uint8_t priority = kPriorityForeground;  // optional trailing byte; 0 = untagged
 
     void encode(std::vector<uint8_t>& out) const {
         WireWriter w(out);
@@ -335,6 +350,7 @@ struct SegBatchMeta {
         w.str_list(keys);
         w.u32(static_cast<uint32_t>(offsets.size()));
         for (uint64_t off : offsets) w.u64(off);
+        if (priority != kPriorityForeground) w.u8(priority);
     }
     static SegBatchMeta decode(const uint8_t* data, size_t size) {
         WireReader r(data, size);
@@ -345,6 +361,7 @@ struct SegBatchMeta {
         uint32_t n = r.u32();
         m.offsets.reserve(n);
         for (uint32_t i = 0; i < n; i++) m.offsets.push_back(r.u64());
+        if (!r.done()) m.priority = r.u8();
         return m;
     }
 };

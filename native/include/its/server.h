@@ -69,6 +69,19 @@ struct ServerConfig {
     // spill-heavy batch churns (bench.py contended_* keys). Internal tuning
     // knob (C++-level; not surfaced through the CLI).
     size_t slice_bytes = 128ull << 10;
+    // QoS two-level slice scheduler (docs/qos.md). While FOREGROUND work is
+    // live — a foreground sliced op pending, or any foreground op seen
+    // within the last bg_cooldown_us (hysteresis: engine reads arrive in
+    // waves; without the cooldown, background work resumes into the tail of
+    // a wave and its completions wake the background client mid-wave) — a
+    // BACKGROUND-tagged op's slices are deferred, EXCEPT that one
+    // background slice always runs per bg_aging_us of deferral: the
+    // starvation-proof aging escape guarantees background >= slice_bytes
+    // per bg_aging_us of progress under a permanent foreground flood, so
+    // it always drains. Only engages when a tagged background op exists;
+    // an all-untagged workload runs the exact pre-QoS FIFO round-robin.
+    uint64_t bg_cooldown_us = 500;
+    uint64_t bg_aging_us = 500;
 };
 
 // Per-op service counters (SURVEY.md §5.1: the reference has no tracing at
@@ -171,9 +184,41 @@ class Server {
     std::vector<std::function<void()>> posted_;
 
     std::unordered_map<int, std::unique_ptr<Conn>> conns_;
-    // Connections with a suspended sliced segment op; round-robined one
-    // slice each per loop tick (epoll timeout drops to 0 while non-empty).
-    std::deque<Conn*> cont_queue_;
+    // Connections with a suspended sliced segment op, split by QoS class.
+    // With no BACKGROUND op suspended the foreground queue behaves exactly
+    // like the old single cont_queue_; with one, foreground slices run
+    // first and background slices run only when foreground is quiet
+    // (cont_fg_ empty AND the bg_cooldown_us window expired) or the
+    // time-based aging escape fires (see ServerConfig::bg_aging_us and
+    // run_cont_pass).
+    std::deque<Conn*> cont_fg_;
+    std::deque<Conn*> cont_bg_;
+    // Monotonic stamps driving the two-level scheduler: the last moment
+    // foreground work was seen (op dispatch or fg slice — starts the
+    // cooldown window) and the last background slice (drives the
+    // time-based aging guarantee).
+    uint64_t last_fg_us_ = 0;
+    uint64_t last_bg_slice_us_ = 0;
+    // Per-class QoS counters, exported under "qos" in stats_json().
+    struct QosCounters {
+        uint64_t fg_ops = 0;          // tagged-or-default foreground ops dispatched
+        uint64_t bg_ops = 0;          // background-tagged ops dispatched
+        uint64_t fg_slices = 0;       // sliced-work quanta run per class
+        uint64_t bg_slices = 0;
+        uint64_t bg_preempted = 0;    // slice slots (passes) bg sat out behind fg
+        uint64_t bg_aged = 0;         // bg slices run via the aging escape
+        void note(uint8_t prio) {
+            (prio == kPriorityBackground ? bg_ops : fg_ops)++;
+        }
+    } qos_;
+    // Count an op dispatch against its class; a foreground op also starts
+    // the background-deferral cooldown window.
+    void note_op(uint8_t prio);
+    void run_cont_pass(int epoll_events_seen, int* idle_streak);
+    void run_one_slice(Conn* c, std::deque<Conn*>* queue);
+    // True while background work must yield: a foreground sliced op is
+    // pending, or foreground activity was seen within the cooldown window.
+    bool bg_must_defer() const;
     // Reclaim budgeting for sliced allocations: when slice_mode_ is set,
     // alloc_blocks skips the ratio sweep, caps demote iterations at
     // slice_reclaim_left_, and reports a cap-hit via slice_capped_ (the

@@ -155,44 +155,51 @@ void its_conn_completion_counters(void* c, uint64_t* pushed, uint64_t* signalled
     static_cast<Connection*>(c)->completion_counters(pushed, signalled);
 }
 
+// ``priority``: QoS class tag (its::Priority) — 0 foreground (default
+// scheduling, wire bytes unchanged), 1 background (yields to foreground in
+// the server's two-level slice scheduler; see docs/qos.md).
 int its_conn_put_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,
-                       its::CompletionCb cb, void* ctx) {
+                       its::CompletionCb cb, void* ctx, int priority) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->put_batch_async(keys, offs, block_size, base_ptr,
-                                                            cb, ctx);
+                                                            cb, ctx,
+                                                            static_cast<uint8_t>(priority));
     }, -1);
 }
 int its_conn_get_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,
-                       its::CompletionCb cb, void* ctx) {
+                       its::CompletionCb cb, void* ctx, int priority) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->get_batch_async(keys, offs, block_size, base_ptr,
-                                                            cb, ctx);
+                                                            cb, ctx,
+                                                            static_cast<uint8_t>(priority));
     }, -1);
 }
 // Sync batched ops: calling thread blocks on completion (no asyncio hop) —
 // the low-latency path for small fetches. Returns 0 or -status.
 int its_conn_put_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
                             uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
-                            void* base_ptr) {
+                            void* base_ptr, int priority) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
-        return static_cast<Connection*>(c)->put_batch(keys, offs, block_size, base_ptr);
+        return static_cast<Connection*>(c)->put_batch(keys, offs, block_size, base_ptr,
+                                                      static_cast<uint8_t>(priority));
     }, -static_cast<int>(its::kStatusInvalidReq));
 }
 int its_conn_get_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
                             uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
-                            void* base_ptr) {
+                            void* base_ptr, int priority) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
-        return static_cast<Connection*>(c)->get_batch(keys, offs, block_size, base_ptr);
+        return static_cast<Connection*>(c)->get_batch(keys, offs, block_size, base_ptr,
+                                                      static_cast<uint8_t>(priority));
     }, -static_cast<int>(its::kStatusInvalidReq));
 }
 int its_conn_tcp_put(void* c, const char* key, const void* data, uint64_t size) {

@@ -399,7 +399,7 @@ int Connection::submit(std::unique_ptr<Request> req) {
 
 std::unique_ptr<Connection::Request> Connection::build_put(
     const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-    uint32_t block_size, void* base_ptr) {
+    uint32_t block_size, void* base_ptr, uint8_t priority) {
     if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
@@ -416,6 +416,7 @@ std::unique_ptr<Connection::Request> Connection::build_put(
         m.block_size = block_size;
         m.seg_id = seg->id;
         m.keys = keys;
+        m.priority = priority;
         m.offsets.reserve(offsets.size());
         uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
         for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
@@ -425,7 +426,7 @@ std::unique_ptr<Connection::Request> Connection::build_put(
         bool shm = shm_ok_.load();
         req->op = shm ? kOpPutAlloc : kOpPutBatch;
         req->payload_on_wire = !shm;  // shm: blocks are memcpy'd after PutAlloc
-        BatchMeta meta{block_size, keys};
+        BatchMeta meta{block_size, keys, priority};
         meta.encode(req->body);
         req->tx_payload.reserve(keys.size());
         for (uint64_t off : offsets)
@@ -436,8 +437,9 @@ std::unique_ptr<Connection::Request> Connection::build_put(
 
 int Connection::put_batch_async(const std::vector<std::string>& keys,
                                 const std::vector<uint64_t>& offsets, uint32_t block_size,
-                                void* base_ptr, CompletionCb cb, void* ctx) {
-    auto req = build_put(keys, offsets, block_size, base_ptr);
+                                void* base_ptr, CompletionCb cb, void* ctx,
+                                uint8_t priority) {
+    auto req = build_put(keys, offsets, block_size, base_ptr, priority);
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
@@ -446,8 +448,8 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
 
 int Connection::put_batch(const std::vector<std::string>& keys,
                           const std::vector<uint64_t>& offsets, uint32_t block_size,
-                          void* base_ptr) {
-    auto req = build_put(keys, offsets, block_size, base_ptr);
+                          void* base_ptr, uint8_t priority) {
+    auto req = build_put(keys, offsets, block_size, base_ptr, priority);
     if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
     uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
     return status == kStatusOk ? 0 : -static_cast<int>(status);
@@ -455,7 +457,7 @@ int Connection::put_batch(const std::vector<std::string>& keys,
 
 std::unique_ptr<Connection::Request> Connection::build_get(
     const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-    uint32_t block_size, void* base_ptr) {
+    uint32_t block_size, void* base_ptr, uint8_t priority) {
     if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
@@ -471,13 +473,14 @@ std::unique_ptr<Connection::Request> Connection::build_get(
         m.block_size = block_size;
         m.seg_id = seg->id;
         m.keys = keys;
+        m.priority = priority;
         m.offsets.reserve(offsets.size());
         uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
         for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
         m.encode(req->body);
     } else {
         req->op = shm_ok_.load() ? kOpGetLoc : kOpGetBatch;
-        BatchMeta meta{block_size, keys};
+        BatchMeta meta{block_size, keys, priority};
         meta.encode(req->body);
         req->block_size = block_size;
         req->rx_addrs.reserve(keys.size());
@@ -489,8 +492,9 @@ std::unique_ptr<Connection::Request> Connection::build_get(
 
 int Connection::get_batch_async(const std::vector<std::string>& keys,
                                 const std::vector<uint64_t>& offsets, uint32_t block_size,
-                                void* base_ptr, CompletionCb cb, void* ctx) {
-    auto req = build_get(keys, offsets, block_size, base_ptr);
+                                void* base_ptr, CompletionCb cb, void* ctx,
+                                uint8_t priority) {
+    auto req = build_get(keys, offsets, block_size, base_ptr, priority);
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
@@ -499,8 +503,8 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
 
 int Connection::get_batch(const std::vector<std::string>& keys,
                           const std::vector<uint64_t>& offsets, uint32_t block_size,
-                          void* base_ptr) {
-    auto req = build_get(keys, offsets, block_size, base_ptr);
+                          void* base_ptr, uint8_t priority) {
+    auto req = build_get(keys, offsets, block_size, base_ptr, priority);
     if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
     uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
     return status == kStatusOk ? 0 : -static_cast<int>(status);

@@ -129,6 +129,9 @@ struct Server::Conn {
     // progress (demotions, promotions) persists across retries.
     struct SegCont {
         uint8_t op = 0;
+        // QoS class of the op this continuation slices (protocol.h Priority):
+        // decides which cont queue the conn waits in between slices.
+        uint8_t prio = kPriorityForeground;
         SegBatchMeta m;
         enum class Phase { kAlloc, kPin, kCopy } phase = Phase::kAlloc;
         size_t idx = 0;     // blocks allocated (PutFrom) / pinned (GetInto)
@@ -328,6 +331,20 @@ std::string Server::stats_json() {
               ",\"capacity\":" + std::to_string(kv_->spill_capacity()) +
               ",\"promotions\":" + std::to_string(kv_->spill_promotions()) +
               ",\"dropped\":" + std::to_string(kv_->spill_drops()) + "}" +
+              // Two-class QoS scheduler counters (docs/qos.md): per-class
+              // dispatch + slice counts, the scheduler's preempt/age
+              // decisions, and the live suspended-op queue depths.
+              ",\"qos\":{\"fg_ops\":" + std::to_string(qos_.fg_ops) +
+              ",\"bg_ops\":" + std::to_string(qos_.bg_ops) +
+              ",\"fg_slices\":" + std::to_string(qos_.fg_slices) +
+              ",\"bg_slices\":" + std::to_string(qos_.bg_slices) +
+              ",\"bg_preempted_slices\":" + std::to_string(qos_.bg_preempted) +
+              ",\"bg_aged_slices\":" + std::to_string(qos_.bg_aged) +
+              ",\"fg_queued\":" + std::to_string(cont_fg_.size()) +
+              ",\"bg_queued\":" + std::to_string(cont_bg_.size()) +
+              ",\"bg_cooldown_us\":" + std::to_string(config_.bg_cooldown_us) +
+              ",\"bg_aging_us\":" + std::to_string(config_.bg_aging_us) + "}" +
+              ",\"suspended_ops\":" + std::to_string(cont_fg_.size() + cont_bg_.size()) +
               ",\"ops\":{";
         bool first = true;
         for (const auto& [op, s] : stats_) {
@@ -350,17 +367,26 @@ std::string Server::stats_json() {
 void Server::loop() {
     constexpr int kMaxEvents = 64;
     epoll_event events[kMaxEvents];
-    // Consecutive event-free ticks with sliced work pending. Slicing costs
-    // ~6% of solo batch throughput in loop overhead; when NOBODY else is
-    // talking (a streak of empty polls) and exactly one op is suspended, we
-    // run several chunks per pass instead of one. Any ready event resets
-    // the streak, so a contending connection immediately restores strict
-    // one-chunk fairness.
+    // Consecutive event-free ticks with sliced work pending (see
+    // run_cont_pass for how the streak boosts a lone suspended op).
     int idle_streak = 0;
     while (!stop_requested_.load(std::memory_order_relaxed)) {
         // Pending sliced ops: poll without blocking so their next slice runs
-        // right after any ready events (fairness: events first, then slices).
-        int n = epoll_wait(epoll_fd_, events, kMaxEvents, cont_queue_.empty() ? 200 : 0);
+        // right after any ready events (fairness: events first, then
+        // slices). Exception: when the ONLY pending work is background
+        // slices currently deferred by the foreground cooldown, sleep ~1ms
+        // instead of spinning — a busy-polling reactor would burn the
+        // single core exactly while the foreground wave it deferred FOR is
+        // still running (events still interrupt the sleep instantly, and
+        // the aging clock tolerates millisecond granularity).
+        int timeout = 200;
+        if (!cont_fg_.empty()) {
+            timeout = 0;
+        } else if (!cont_bg_.empty()) {
+            timeout =
+                now_us() - last_fg_us_ < config_.bg_cooldown_us ? 1 : 0;
+        }
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
         if (n < 0) {
             if (errno == EINTR) continue;
             ITS_LOG_ERROR("epoll_wait: %s", strerror(errno));
@@ -393,26 +419,7 @@ void Server::loop() {
                 if (!c->dead && (events[i].events & EPOLLIN)) conn_readable(c);
             }
         }
-        // One slice per suspended conn per tick (round-robin). Snapshot the
-        // count: a slice that finishes re-arms reads but never re-queues
-        // itself within this pass. With an idle streak and a single
-        // suspended conn, run up to 1+streak chunks back-to-back (bounded
-        // extra arrival latency; see idle_streak above).
-        idle_streak = (n == 0 && !cont_queue_.empty())
-                          ? std::min(idle_streak + 1, 8)
-                          : 0;
-        int rounds = 1 + (cont_queue_.size() == 1 ? idle_streak : 0);
-        for (int r = 0; r < rounds && !cont_queue_.empty(); r++) {
-            for (size_t i = 0, n0 = cont_queue_.size(); i < n0 && !cont_queue_.empty();
-                 i++) {
-                Conn* c = cont_queue_.front();
-                cont_queue_.pop_front();
-                c->queued_cont = false;
-                if (c->dead || c->cont == nullptr) continue;
-                run_cont_slice(c);
-                if (!c->dead && c->cont != nullptr) queue_cont(c);
-            }
-        }
+        run_cont_pass(n, &idle_streak);
         graveyard_.clear();
     }
     // Drain control closures posted during shutdown so no caller hangs.
@@ -458,8 +465,10 @@ void Server::close_conn(Conn* c) {
     if (c->dead) return;
     c->dead = true;
     if (c->cont != nullptr) {
-        cont_queue_.erase(std::remove(cont_queue_.begin(), cont_queue_.end(), c),
-                          cont_queue_.end());
+        cont_fg_.erase(std::remove(cont_fg_.begin(), cont_fg_.end(), c),
+                       cont_fg_.end());
+        cont_bg_.erase(std::remove(cont_bg_.begin(), cont_bg_.end(), c),
+                       cont_bg_.end());
     }
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
     close(c->fd);
@@ -472,8 +481,90 @@ void Server::close_conn(Conn* c) {
 
 void Server::queue_cont(Conn* c) {
     if (!c->queued_cont) {
-        cont_queue_.push_back(c);
+        bool bg = c->cont != nullptr && c->cont->prio == kPriorityBackground;
+        (bg ? cont_bg_ : cont_fg_).push_back(c);
         c->queued_cont = true;
+    }
+}
+
+// Pop + run one budget slice for the conn at the front of ``queue``,
+// re-queueing it (by its op's class) when more slices remain.
+void Server::run_one_slice(Conn* c, std::deque<Conn*>* queue) {
+    queue->pop_front();
+    c->queued_cont = false;
+    if (c->dead || c->cont == nullptr) return;
+    (c->cont->prio == kPriorityBackground ? qos_.bg_slices : qos_.fg_slices)++;
+    run_cont_slice(c);
+    if (!c->dead && c->cont != nullptr) queue_cont(c);
+}
+
+void Server::note_op(uint8_t prio) {
+    qos_.note(prio);
+    if (prio != kPriorityBackground) last_fg_us_ = now_us();
+}
+
+bool Server::bg_must_defer() const {
+    return !cont_fg_.empty() || now_us() - last_fg_us_ < config_.bg_cooldown_us;
+}
+
+// One scheduling pass over the suspended sliced ops, run after each tick's
+// epoll events (fairness: events first, then slices).
+//
+// Two-level discipline: FOREGROUND conts round-robin one slice each — with
+// no background op suspended this is EXACTLY the pre-QoS single-queue
+// behavior. BACKGROUND conts run a full round-robin only while foreground
+// is quiet: no foreground slice pending AND no foreground op seen within
+// the last bg_cooldown_us (the wave hysteresis — a decode wave's reads
+// arrive microseconds apart, and resuming background between them would
+// land its slices, and its completion wakeups, inside the wave).
+// While deferred, background still gets ONE slice per bg_aging_us — the
+// time-based, starvation-proof aging escape: background always makes
+// >= slice_bytes per bg_aging_us of progress, so it drains under ANY
+// foreground flood.
+//
+// Idle-streak boost (pre-existing): slicing costs ~6% of solo batch
+// throughput in loop overhead; with exactly one suspended op and a streak
+// of event-free polls, run up to 1+streak slices back-to-back. For a
+// BACKGROUND cont each extra boost round first peeks epoll with zero
+// timeout and stops on any ready event — a foreground request arriving
+// mid-boost waits at most one slice, not the whole burst (level-triggered
+// epoll re-reports the peeked event to the main loop).
+void Server::run_cont_pass(int events_seen, int* idle_streak) {
+    size_t total = cont_fg_.size() + cont_bg_.size();
+    if (total == 0) {
+        *idle_streak = 0;
+        return;
+    }
+    *idle_streak = events_seen == 0 ? std::min(*idle_streak + 1, 8) : 0;
+    int rounds = 1 + (total == 1 ? *idle_streak : 0);
+    for (int r = 0; r < rounds && !(cont_fg_.empty() && cont_bg_.empty()); r++) {
+        if (r > 0 && !cont_bg_.empty()) {
+            epoll_event peek;
+            if (epoll_wait(epoll_fd_, &peek, 1, 0) > 0) break;
+        }
+        uint64_t now = now_us();
+        bool fg_pending = !cont_fg_.empty();
+        if (fg_pending) last_fg_us_ = now;
+        for (size_t i = 0, n0 = cont_fg_.size(); i < n0 && !cont_fg_.empty(); i++)
+            run_one_slice(cont_fg_.front(), &cont_fg_);
+        if (cont_bg_.empty()) continue;
+        if (fg_pending || now - last_fg_us_ < config_.bg_cooldown_us) {
+            if (now - last_bg_slice_us_ >= config_.bg_aging_us) {
+                qos_.bg_aged++;
+                last_bg_slice_us_ = now;
+                run_one_slice(cont_bg_.front(), &cont_bg_);
+            } else {
+                // One per deferred pass (a pass is one slice slot background
+                // sat out), NOT per queued conn — the loop spins fast while
+                // foreground slices run, and multiplying by queue depth
+                // would inflate the counter by orders of magnitude.
+                qos_.bg_preempted++;
+            }
+        } else {
+            last_bg_slice_us_ = now;
+            for (size_t i = 0, n0 = cont_bg_.size(); i < n0 && !cont_bg_.empty(); i++)
+                run_one_slice(cont_bg_.front(), &cont_bg_);
+        }
     }
 }
 
@@ -954,6 +1045,7 @@ void Server::handle_put_batch(Conn* c) {
         send_status(c, kStatusInvalidReq);
         return;
     }
+    note_op(m.priority);
     uint64_t need = static_cast<uint64_t>(n) * m.block_size;
     std::vector<Lease> leases;
     if (!alloc_blocks(m.block_size, n, &leases)) {
@@ -1047,14 +1139,23 @@ void Server::handle_shm(Conn* c) {
             // allocating concurrently — the op completes, or reclaim runs
             // genuinely dry (507). The no-pressure case completes in its
             // first slice, same reactor tick as this dispatch.
+            note_op(m.priority);
             auto cont = std::make_unique<Conn::SegCont>();
             cont->op = kOpPutAlloc;
+            cont->prio = m.priority;
             cont->m.keys = std::move(m.keys);
             cont->m.block_size = m.block_size;
             cont->blocks.reserve(n);
             c->cont = std::move(cont);
             // First slice inline: the free-RAM case completes right here
-            // with no suspension (no epoll re-arms, no extra tick).
+            // with no suspension (no epoll re-arms, no extra tick) — unless
+            // the op is BACKGROUND class and foreground work is live, in
+            // which case it queues for the two-level scheduler instead of
+            // jumping it.
+            if (m.priority == kPriorityBackground && bg_must_defer()) {
+                suspend_for_cont(c);
+                return;
+            }
             run_putalloc_slice(c);
             if (!c->dead && c->cont != nullptr) suspend_for_cont(c);
             return;
@@ -1100,15 +1201,22 @@ void Server::handle_shm(Conn* c) {
             // Promotion (pin) work runs budget-sliced (run_cont_slice):
             // pins persist in the continuation, so progress is monotone —
             // the op either completes or genuinely exhausts reclaim (507).
+            note_op(m.priority);
             auto cont = std::make_unique<Conn::SegCont>();
             cont->op = kOpGetLoc;
+            cont->prio = m.priority;
             cont->m.keys = std::move(m.keys);
             cont->m.block_size = m.block_size;
             cont->phase = Conn::SegCont::Phase::kPin;
             cont->blocks.reserve(cont->m.keys.size());
             c->cont = std::move(cont);
             // First slice inline: a RAM-resident batch completes right here
-            // with no suspension (no epoll re-arms, no extra tick).
+            // with no suspension (no epoll re-arms, no extra tick) — same
+            // BACKGROUND deferral as PutAlloc above.
+            if (m.priority == kPriorityBackground && bg_must_defer()) {
+                suspend_for_cont(c);
+                return;
+            }
             run_getloc_slice(c);
             if (!c->dead && c->cont != nullptr) suspend_for_cont(c);
             return;
@@ -1173,8 +1281,10 @@ void Server::handle_shm(Conn* c) {
                     return;
                 }
             }
+            note_op(m.priority);
             auto cont = std::make_unique<Conn::SegCont>();
             cont->op = kOpPutFrom;
+            cont->prio = m.priority;
             cont->m = std::move(m);
             cont->blocks.reserve(n);
             c->cont = std::move(cont);
@@ -1201,8 +1311,10 @@ void Server::handle_shm(Conn* c) {
                     return;
                 }
             }
+            note_op(m.priority);
             auto cont = std::make_unique<Conn::SegCont>();
             cont->op = kOpGetInto;
+            cont->prio = m.priority;
             cont->m = std::move(m);
             cont->phase = Conn::SegCont::Phase::kPin;
             cont->blocks.reserve(cont->m.keys.size());
@@ -1238,6 +1350,7 @@ void Server::handle_get_batch(Conn* c) {
         send_status(c, kStatusInvalidReq);
         return;
     }
+    note_op(m.priority);
     // All keys must exist (reference read_rdma_cache,
     // /root/reference/src/infinistore.cpp:612-617)...
     for (const auto& key : m.keys) {

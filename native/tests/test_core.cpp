@@ -435,6 +435,120 @@ static void test_completion_ring(bool enable_shm) {
     server.stop();
 }
 
+static void test_qos_wire_priority_tag() {
+    // The QoS class tag is an OPTIONAL trailing byte: an untagged
+    // (foreground) body must be byte-identical to the pre-QoS encoding,
+    // and a tagged body is that encoding plus exactly one byte.
+    BatchMeta m;
+    m.block_size = 4096;
+    m.keys = {"a", "b"};
+    std::vector<uint8_t> untagged;
+    m.encode(untagged);
+    m.priority = kPriorityBackground;
+    std::vector<uint8_t> tagged;
+    m.encode(tagged);
+    CHECK(tagged.size() == untagged.size() + 1);
+    CHECK(memcmp(tagged.data(), untagged.data(), untagged.size()) == 0);
+    CHECK(tagged.back() == kPriorityBackground);
+    CHECK(BatchMeta::decode(untagged.data(), untagged.size()).priority ==
+          kPriorityForeground);
+    CHECK(BatchMeta::decode(tagged.data(), tagged.size()).priority ==
+          kPriorityBackground);
+
+    SegBatchMeta sm;
+    sm.block_size = 4096;
+    sm.seg_id = 3;
+    sm.keys = {"k"};
+    sm.offsets = {65536};
+    std::vector<uint8_t> s0;
+    sm.encode(s0);
+    sm.priority = kPriorityBackground;
+    std::vector<uint8_t> s1;
+    sm.encode(s1);
+    CHECK(s1.size() == s0.size() + 1 && s1.back() == kPriorityBackground);
+    CHECK(SegBatchMeta::decode(s0.data(), s0.size()).priority ==
+          kPriorityForeground);
+    SegBatchMeta sd = SegBatchMeta::decode(s1.data(), s1.size());
+    CHECK(sd.priority == kPriorityBackground && sd.offsets == sm.offsets);
+}
+
+static long long stat_counter(const std::string& json, const char* key) {
+    std::string needle = std::string("\"") + key + "\":";
+    size_t at = json.find(needle);
+    if (at == std::string::npos) return -1;
+    return atoll(json.c_str() + at + needle.size());
+}
+
+static void test_qos_two_level_scheduler() {
+    // Reactor-level QoS: a BACKGROUND-tagged batch must (a) complete under
+    // a PERMANENT foreground flood — the time-based aging escape makes
+    // starvation impossible by construction — (b) be byte-correct despite
+    // running entirely from preempted/aged slices, and (c) show up in the
+    // scheduler's per-class counters.
+    ServerConfig scfg;
+    scfg.bind_addr = "127.0.0.1";
+    scfg.service_port = 0;
+    scfg.prealloc_bytes = 32 << 20;
+    scfg.block_size = 16 << 10;
+    scfg.pin_memory = false;
+    scfg.enable_shm = true;
+    Server server(scfg);
+    CHECK(server.start());
+
+    ClientConfig ccfg;
+    ccfg.host = "127.0.0.1";
+    ccfg.port = server.port();
+    Connection bg(ccfg), fg(ccfg);
+    CHECK(bg.connect() == 0 && fg.connect() == 0);
+
+    const size_t n = 64, bs = 16 << 10;
+    std::vector<char> bgbuf(n * bs), rdbuf(n * bs, 0), fgbuf(bs, 'f');
+    for (size_t i = 0; i < bgbuf.size(); i++)
+        bgbuf[i] = static_cast<char>(i * 13 + 5);
+    bg.register_mr(bgbuf.data(), bgbuf.size());
+    bg.register_mr(rdbuf.data(), rdbuf.size());
+    fg.register_mr(fgbuf.data(), fgbuf.size());
+    std::vector<std::string> keys;
+    std::vector<uint64_t> offs;
+    for (size_t i = 0; i < n; i++) {
+        keys.push_back("bgk" + std::to_string(i));
+        offs.push_back(i * bs);
+    }
+    CHECK(fg.put_batch({"hot"}, {0}, bs, fgbuf.data()) == 0);
+
+    std::atomic<bool> stop{false};
+    std::thread flood([&] {
+        while (!stop.load())
+            fg.get_batch({"hot"}, {0}, bs, fgbuf.data());
+    });
+
+    std::atomic<int> code{-1};
+    auto cb = [](void* ctx, int c) { static_cast<std::atomic<int>*>(ctx)->store(c); };
+    CHECK(bg.put_batch_async(keys, offs, bs, bgbuf.data(), cb, &code,
+                             kPriorityBackground) == 0);
+    for (int i = 0; i < 2500 && code.load() == -1; i++)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    CHECK(code.load() == 200);  // completed DURING the flood (aging)
+    stop.store(true);
+    flood.join();
+
+    // Byte-correctness under preemption: every block survived intact.
+    CHECK(bg.get_batch(keys, offs, bs, rdbuf.data(), kPriorityBackground) == 0);
+    CHECK(memcmp(bgbuf.data(), rdbuf.data(), bgbuf.size()) == 0);
+
+    std::string st = server.stats_json();
+    CHECK(stat_counter(st, "bg_ops") >= 2);  // the tagged put + read-back
+    CHECK(stat_counter(st, "fg_ops") >= 2);  // seed put + flood reads
+    // The scheduler actually deferred (or aged) background work at least
+    // once under the flood — the mechanism ran, not just the bookkeeping.
+    CHECK(stat_counter(st, "bg_preempted_slices") +
+              stat_counter(st, "bg_aged_slices") > 0);
+
+    bg.close();
+    fg.close();
+    server.stop();
+}
+
 static void test_opstats_percentile_accuracy() {
     // The HDR-style histogram must report percentiles within ~10% — the
     // BASELINE latency metric is p50, so 2x power-of-two quantization is
@@ -469,6 +583,8 @@ int main() {
     test_kvstore_lru_eviction();
     test_spill_tier_demote_promote();
     test_wire_codec_roundtrip();
+    test_qos_wire_priority_tag();
+    test_qos_two_level_scheduler();
     test_loopback_end_to_end(/*enable_shm=*/true);
     test_loopback_end_to_end(/*enable_shm=*/false);
     test_completion_ring(/*enable_shm=*/true);

@@ -139,9 +139,9 @@ def test_writer_commits_layer0_last(connector, conn):
     order = []
     orig = conn.write_cache_async
 
-    async def spy(blocks, block_size, ptr):
+    async def spy(blocks, block_size, ptr, **kw):
         order.extend(k for k, _ in blocks)
-        return await orig(blocks, block_size, ptr)
+        return await orig(blocks, block_size, ptr, **kw)
 
     conn.write_cache_async = spy
     try:

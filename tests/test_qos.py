@@ -1,0 +1,432 @@
+"""Two-class QoS data plane (docs/qos.md): wire tag, scheduler behavior,
+starvation-proofing, and byte-correctness under preemption.
+
+The contract under test, end to end:
+- FOREGROUND (untagged) is byte-identical to the pre-QoS wire format and
+  runs the pre-QoS FIFO scheduling — tagging is strictly additive.
+- A BACKGROUND-tagged op yields to foreground work in every queue it
+  crosses (client sub-batch gate, stripe scheduler, server slice
+  scheduler) but can never starve: time-based aging guarantees progress
+  under a permanent foreground flood.
+- Preemption/deferral never costs bytes: everything a background op wrote
+  reads back exactly.
+"""
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu import lib as libmod
+from infinistore_tpu import wire
+
+pytestmark = pytest.mark.qos
+
+BLOCK = 64 << 10
+
+
+@pytest.fixture
+def server():
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=BLOCK)
+    yield srv
+    srv.stop()
+
+
+def _connect(port, **kw):
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=port,
+                         log_level="error", **kw)
+    )
+    conn.connect()
+    return conn
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+# ---------------------------------------------------------------------------
+
+
+def test_priority_tag_is_optional_trailing_byte():
+    m0 = wire.BatchMeta(block_size=4096, keys=["a", "b"])
+    m1 = wire.BatchMeta(block_size=4096, keys=["a", "b"],
+                        priority=wire.PRIORITY_BACKGROUND)
+    assert m1.encode() == m0.encode() + b"\x01"
+    assert wire.BatchMeta.decode(m0.encode()).priority == wire.PRIORITY_FOREGROUND
+    assert wire.BatchMeta.decode(m1.encode()).priority == wire.PRIORITY_BACKGROUND
+
+    s0 = wire.SegBatchMeta(block_size=4096, seg_id=7, keys=["k"], offsets=[65536])
+    s1 = wire.SegBatchMeta(block_size=4096, seg_id=7, keys=["k"], offsets=[65536],
+                           priority=wire.PRIORITY_BACKGROUND)
+    assert s1.encode() == s0.encode() + b"\x01"
+    d = wire.SegBatchMeta.decode(s1.encode())
+    assert d.priority == wire.PRIORITY_BACKGROUND and d.offsets == [65536]
+    # Round-trips through the tagged encoding preserve every other field.
+    assert d.keys == ["k"] and d.seg_id == 7 and d.block_size == 4096
+
+
+def test_qos_kwargs_gates_on_awareness():
+    class Aware:
+        QOS_AWARE = True
+
+    class Naive:
+        pass
+
+    assert wire.qos_kwargs(Aware(), wire.PRIORITY_BACKGROUND) == {"priority": 1}
+    assert wire.qos_kwargs(Aware(), wire.PRIORITY_FOREGROUND) == {}
+    assert wire.qos_kwargs(Naive(), wire.PRIORITY_BACKGROUND) == {}
+
+
+# ---------------------------------------------------------------------------
+# Single connection: tagged ops, counters, byte-correctness
+# ---------------------------------------------------------------------------
+
+
+def test_tagged_ops_roundtrip_and_count(server):
+    conn = _connect(server.port)
+    try:
+        buf = conn.alloc_shm_mr(32 * BLOCK)
+        if buf is None:
+            buf = np.zeros(32 * BLOCK, dtype=np.uint8)
+            conn.register_mr(buf)
+        rng = np.random.default_rng(7)
+        buf[:] = rng.integers(0, 256, size=buf.size, dtype=np.uint8)
+        want = buf.copy()
+        pairs = [(f"q{i}", i * BLOCK) for i in range(32)]
+
+        async def go():
+            await conn.write_cache_async(
+                pairs, BLOCK, buf.ctypes.data, priority=wire.PRIORITY_BACKGROUND
+            )
+            buf[:] = 0
+            await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
+
+        asyncio.run(go())
+        assert np.array_equal(buf, want)
+
+        qs = conn.qos_stats()
+        assert qs["bg_ops"] == 1 and qs["fg_ops"] == 1
+        srv_qos = conn.get_stats()["qos"]
+        # The 2MB background write rides sub-batches; every one is tagged.
+        assert srv_qos["bg_ops"] >= 1
+        assert srv_qos["fg_ops"] >= 1
+    finally:
+        conn.close()
+
+
+def test_sync_tagged_ops(server):
+    conn = _connect(server.port)
+    try:
+        buf = conn.alloc_shm_mr(4096)
+        if buf is None:
+            buf = np.zeros(4096, dtype=np.uint8)
+            conn.register_mr(buf)
+        buf[:] = 9
+        conn.write_cache([("sk", 0)], 4096, buf.ctypes.data,
+                         priority=wire.PRIORITY_BACKGROUND)
+        buf[:] = 0
+        conn.read_cache([("sk", 0)], 4096, buf.ctypes.data)
+        assert (np.asarray(buf) == 9).all()
+        assert conn.qos_stats()["bg_ops"] == 1
+    finally:
+        conn.close()
+
+
+def test_bg_subbatch_split_bounds_inflight_bytes(server):
+    conn = _connect(server.port)
+    try:
+        per = max(1, conn.BG_SUBBATCH_BYTES // 2 // BLOCK)
+        blocks = [(f"s{i}", i * BLOCK) for i in range(3 * per + 1)]
+        subs = conn._bg_subbatches(blocks, BLOCK)
+        assert sum(len(s) for s in subs) == len(blocks)
+        assert all(len(s) * BLOCK <= conn.BG_SUBBATCH_BYTES // 2 for s in subs)
+        # Order-preserving, contiguous split.
+        assert [b for s in subs for b in s] == blocks
+        # Under half the budget: no split at all (and foreground never splits).
+        assert conn._bg_subbatches(blocks[:per], BLOCK) == [blocks[:per]]
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# Starvation-proofing: a background batch completes under a permanent
+# foreground flood (acceptance criterion: impossible by construction).
+# ---------------------------------------------------------------------------
+
+
+def test_bg_completes_under_permanent_fg_flood(server):
+    bg = _connect(server.port)
+    fg = _connect(server.port)
+    try:
+        n = 64
+        bgbuf = bg.alloc_shm_mr(n * BLOCK)
+        if bgbuf is None:
+            bgbuf = np.zeros(n * BLOCK, dtype=np.uint8)
+            bg.register_mr(bgbuf)
+        bgbuf[:] = 5
+        fgbuf = fg.alloc_shm_mr(4096)
+        if fgbuf is None:
+            fgbuf = np.zeros(4096, dtype=np.uint8)
+            fg.register_mr(fgbuf)
+        fgbuf[:] = 1
+        fg.write_cache([("hot", 0)], 4096, fgbuf.ctypes.data)
+
+        stop = []
+
+        def flood():
+            while not stop:
+                fg.read_cache([("hot", 0)], 4096, fgbuf.ctypes.data)
+
+        th = threading.Thread(target=flood)
+        th.start()
+        try:
+            pairs = [(f"fl{i}", i * BLOCK) for i in range(n)]
+            t0 = time.monotonic()
+
+            async def put():
+                await bg.write_cache_async(
+                    pairs, BLOCK, bgbuf.ctypes.data,
+                    priority=wire.PRIORITY_BACKGROUND,
+                )
+
+            asyncio.run(put())  # must return while the flood still runs
+            assert time.monotonic() - t0 < 30.0
+        finally:
+            stop.append(1)
+            th.join()
+        # Bytes survived the aged/preempted slices.
+        bgbuf[:] = 0
+        asyncio.run(bg.read_cache_async(pairs, BLOCK, bgbuf.ctypes.data))
+        assert (np.asarray(bgbuf) == 5).all()
+        srv_qos = bg.get_stats()["qos"]
+        assert srv_qos["bg_preempted_slices"] + srv_qos["bg_aged_slices"] > 0
+    finally:
+        bg.close()
+        fg.close()
+
+
+def test_client_gate_ages_out():
+    """The process-wide foreground gate must release a background waiter
+    within _BG_AGING_S even if foreground never goes idle."""
+
+    class C:
+        _bg_deferred = 0
+        _bg_aged = 0
+
+    conn = C()
+    libmod._fg_gate_enter()
+    try:
+        t0 = time.monotonic()
+        libmod._bg_gate_wait_sync(conn)
+        waited = time.monotonic() - t0
+        assert conn._bg_deferred == 1 and conn._bg_aged == 1
+        assert waited >= libmod._BG_AGING_S * 0.5
+        assert waited < libmod._BG_AGING_S * 10
+    finally:
+        libmod._fg_gate_exit()
+    # Gate open (after cooldown): no deferral at all.
+    time.sleep(libmod._BG_COOLDOWN_S * 2)
+    t0 = time.monotonic()
+    libmod._bg_gate_wait_sync(conn)
+    assert time.monotonic() - t0 < libmod._BG_AGING_S / 2
+    assert conn._bg_deferred == 1
+
+
+# ---------------------------------------------------------------------------
+# Striped connection: foreground jumps the stripe queue, background ages,
+# bytes stay correct — over a shaped (paced, shm-off) connection so the
+# adaptive scheduler really stripes.
+# ---------------------------------------------------------------------------
+
+
+def _shaped_striped(port, streams=2, cap_mbps=200):
+    from infinistore_tpu.shaping import shaped_config
+
+    conn = its.StripedConnection(shaped_config(port, cap_mbps), streams=streams)
+    conn.connect()
+    return conn
+
+
+def test_striped_mixed_priority_shaped(server):
+    conn = _shaped_striped(server.port)
+    try:
+        n = 64
+        rng = np.random.default_rng(3)
+        src = rng.integers(0, 256, size=n * BLOCK, dtype=np.uint8)
+        dst = np.zeros_like(src)
+        conn.register_mr(src)
+        conn.register_mr(dst)
+        bg_pairs = [(f"bgq{i}", i * BLOCK) for i in range(n)]
+        fg_pairs = [(f"fgq{i}", i * BLOCK) for i in range(8)]
+
+        async def go():
+            # Seed foreground keys first (untagged).
+            await conn.write_cache_async(fg_pairs, BLOCK, src.ctypes.data)
+            # Launch a background write and, while it runs, a foreground
+            # read — the fg op must jump the stripe queue (bg pulls defer).
+            bg_task = asyncio.ensure_future(conn.write_cache_async(
+                bg_pairs, BLOCK, src.ctypes.data,
+                priority=wire.PRIORITY_BACKGROUND,
+            ))
+            await asyncio.sleep(0.002)  # bg is mid-flight
+            await conn.read_cache_async(fg_pairs, BLOCK, dst.ctypes.data)
+            await bg_task
+            # Read everything back (untagged) and verify bytes.
+            dst[:] = 0
+            await conn.read_cache_async(bg_pairs, BLOCK, dst.ctypes.data)
+
+        asyncio.run(go())
+        assert np.array_equal(dst[: n * BLOCK], src[: n * BLOCK])
+        stats = conn.data_plane_stats()
+        assert stats["qos"]["bg_ops"] == 1
+        assert stats["qos"]["fg_ops"] == 3
+        # The background op really deferred to the concurrent foreground op
+        # at least once (it was mid-flight when the fg read arrived).
+        assert (
+            stats["qos"]["bg_deferred_pulls"] + stats["qos"]["bg_subbatches"] > 0
+        )
+    finally:
+        conn.close()
+
+
+def test_striped_bg_aging_under_fg_flood(server):
+    """Background batch over a striped connection completes while a
+    foreground flood holds the class gate — the BG_AGING_S escape."""
+    conn = _shaped_striped(server.port)
+    try:
+        n = 48
+        src = np.full(n * BLOCK, 7, dtype=np.uint8)
+        conn.register_mr(src)
+        pairs = [(f"ag{i}", i * BLOCK) for i in range(n)]
+
+        async def go():
+            stop = []
+
+            async def fg_flood():
+                while not stop:
+                    await conn.write_cache_async(pairs[:2], BLOCK, src.ctypes.data)
+
+            flood = asyncio.ensure_future(fg_flood())
+            try:
+                await asyncio.wait_for(
+                    conn.write_cache_async(
+                        pairs, BLOCK, src.ctypes.data,
+                        priority=wire.PRIORITY_BACKGROUND,
+                    ),
+                    timeout=30.0,
+                )
+            finally:
+                stop.append(1)
+                await flood
+
+        asyncio.run(go())
+        dst = np.zeros_like(src)
+        conn.register_mr(dst)
+        asyncio.run(conn.read_cache_async(pairs, BLOCK, dst.ctypes.data))
+        assert (dst == 7).all()
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# Scheduler counters surface everywhere the ISSUE promises.
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_and_prometheus_export(server):
+    conn = _connect(server.port)
+    try:
+        buf = conn.alloc_shm_mr(BLOCK)
+        if buf is None:
+            buf = np.zeros(BLOCK, dtype=np.uint8)
+            conn.register_mr(buf)
+        buf[:] = 3
+        conn.write_cache([("m", 0)], BLOCK, buf.ctypes.data,
+                         priority=wire.PRIORITY_BACKGROUND)
+        st = conn.get_stats()
+        qos = st["qos"]
+        for key in (
+            "fg_ops", "bg_ops", "fg_slices", "bg_slices",
+            "bg_preempted_slices", "bg_aged_slices", "fg_queued", "bg_queued",
+        ):
+            assert key in qos, key
+        assert qos["bg_ops"] >= 1
+        assert "suspended_ops" in st
+
+        from infinistore_tpu.server import _prometheus_text
+
+        text = _prometheus_text(st).decode()
+        assert 'infinistore_qos_ops{class="bg"}' in text
+        assert "infinistore_qos_bg_preempted_slices" in text
+        assert "infinistore_dataplane_suspended_ops" in text
+    finally:
+        conn.close()
+
+
+def test_start_fetch_promote_upgrades_class(server):
+    """A background-tagged speculative prefetch must upgrade to foreground
+    the moment the engine admits its request (promote()) — including on the
+    coalescer path, whose submit closure reads the live class cell."""
+    import jax.numpy as jnp
+
+    from infinistore_tpu.connector import KVConnector
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+    spec = PagedKVCacheSpec(
+        num_layers=2, num_blocks=8, block_tokens=4, num_kv_heads=1,
+        head_dim=8, dtype=jnp.float32,
+    )
+    conn = _connect(server.port)
+    try:
+        kvc = KVConnector(conn, spec, "qospf", max_blocks=4)
+
+        async def go():
+            h = kvc.start_fetch(
+                list(range(8)), priority=wire.PRIORITY_BACKGROUND
+            )
+            assert h._pri_cell["value"] == wire.PRIORITY_BACKGROUND
+            h.promote()
+            assert h._pri_cell["value"] == wire.PRIORITY_FOREGROUND
+            h.promote()  # idempotent
+            assert h._pri_cell["value"] == wire.PRIORITY_FOREGROUND
+            await h.discard()
+
+        asyncio.run(go())
+    finally:
+        conn.close()
+
+
+def test_fetch_coalescer_partitions_classes(server):
+    """Same-tick submissions merge within a class but never across
+    classes — a background speculative prefetch must not drag a foreground
+    admission fetch into its service class (or vice versa)."""
+    from infinistore_tpu.connector import FetchCoalescer
+
+    conn = _connect(server.port)
+    try:
+        buf = conn.alloc_shm_mr(16 * BLOCK)
+        if buf is None:
+            buf = np.zeros(16 * BLOCK, dtype=np.uint8)
+            conn.register_mr(buf)
+        buf[:] = 8
+        pairs = [(f"c{i}", i * BLOCK) for i in range(4)]
+        asyncio.run(conn.write_cache_async(pairs, BLOCK, buf.ctypes.data))
+
+        co = FetchCoalescer(conn, BLOCK, buf.ctypes.data)
+
+        async def go():
+            futs = [
+                co.submit([pairs[0]]),
+                co.submit([pairs[1]]),
+                co.submit([pairs[2]], priority=wire.PRIORITY_BACKGROUND),
+                co.submit([pairs[3]], priority=wire.PRIORITY_BACKGROUND),
+            ]
+            await asyncio.gather(*futs)
+
+        asyncio.run(go())
+        assert co.submissions == 4
+        assert co.calls == 2  # one merged call per class, never across
+    finally:
+        conn.close()
