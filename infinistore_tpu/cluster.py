@@ -388,8 +388,12 @@ class ClusterKVConnector:
             return
         try:
             if not getattr(conn, "is_connected", True):
-                conn.reconnect()
-        except (InfiniStoreException, AttributeError):
+                # Audited: the only async caller (_begin_async) runs this
+                # whole method in an executor; sync callers may block.
+                conn.reconnect()  # its: allow[ITS-L001]
+        # Audited: a failed heal is not swallowed policy-wise — the probe
+        # op that follows fails and feeds this member's breaker (_done).
+        except (InfiniStoreException, AttributeError):  # its: allow[ITS-P001]
             pass
 
     def _done(self, i: int, exc: Optional[BaseException]):
@@ -687,8 +691,11 @@ class ClusterKVConnector:
     def health(self) -> dict:
         """Cheap, network-free failure-domain snapshot: the aggregate
         degrade counter plus every member's breaker state and attributable
-        counters (errors / fast_fails / probes / recoveries / degraded_ops
-        / replica_serves / last_error). The engine harness surfaces this as
+        counters. Each ``members`` entry carries ``member_id``,
+        ``breaker_state`` / ``breaker_consecutive_failures`` /
+        ``breaker_open_for_s`` / ``breaker_next_probe_in_s``, and the
+        counters errors / fast_fails / probes / recoveries / degraded_ops
+        / replica_serves / last_error. The engine harness surfaces this as
         ``store_health`` in its metrics."""
         return {
             "degraded_ops": self.degraded_ops,

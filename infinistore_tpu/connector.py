@@ -390,7 +390,11 @@ class KVConnector:
             return 0
         keys = self._sentinel_keys(chains)
         try:
-            return self.conn.get_match_last_index(keys) + 1
+            # Audited: the blocking probe RTT. Every async caller hops it
+            # through an executor (load()'s to_thread, start_fetch_async's
+            # known_hit handoff); the remaining inline path is sync
+            # lookup()/start_fetch(), whose docstrings own the cost.
+            return self.conn.get_match_last_index(keys) + 1  # its: allow[ITS-L001]
         except InfiniStoreNoMatch:
             return 0
 
@@ -458,7 +462,11 @@ class KVConnector:
                 f"first_block={first_block} outside the prompt's "
                 f"{len(chains)} complete blocks"
             )
-        hit = self._lookup_chains(chains)
+        # The prefix lookup is a blocking store round trip (native
+        # get_match_last_index): on a remote store that is a full RTT, which
+        # must not stall the event loop mid-wave (ITS-L001) — hop it through
+        # the default executor; the sync ``lookup()`` path stays direct.
+        hit = await asyncio.to_thread(self._lookup_chains, chains)
         n = min(hit - first_block, len(block_ids))
         if n <= 0:
             return list(caches), 0
@@ -491,6 +499,7 @@ class KVConnector:
         limit_blocks: Optional[int] = None,
         prefetch_pool: Optional[HostStagingPool] = None,
         priority: int = wire.PRIORITY_FOREGROUND,
+        known_hit: Optional[int] = None,
     ) -> LayerwisePrefetch:
         """Begin the GATE-FREE half of a load: probe the store (one control
         round trip) and immediately start streaming the hit prefix's layers
@@ -517,7 +526,10 @@ class KVConnector:
         prefetch arena cannot hold another pipeline — callers treat that
         as backpressure and fall back to the one-phase ``load``. Must be
         called from a running event loop (the loop the install/discard
-        will run on)."""
+        will run on) — which also means the inline probe BLOCKS that loop
+        for one store RTT; async callers should prefer
+        :meth:`start_fetch_async`, which hops the probe through an
+        executor (``known_hit`` is how it hands the answer back in)."""
         self._require_store("start_fetch")
         chains = self._chains(token_ids)
         if first_block < 0 or first_block > len(chains):
@@ -525,7 +537,7 @@ class KVConnector:
                 f"first_block={first_block} outside the prompt's "
                 f"{len(chains)} complete blocks"
             )
-        hit = self._lookup_chains(chains)
+        hit = self._lookup_chains(chains) if known_hit is None else known_hit
         n = max(0, hit - first_block)
         n = min(n, self.max_blocks)
         if limit_blocks is not None:
@@ -564,6 +576,30 @@ class KVConnector:
             raise
         handle.hit_blocks = hit
         return handle
+
+    async def start_fetch_async(
+        self,
+        token_ids,
+        first_block: int = 0,
+        limit_blocks: Optional[int] = None,
+        prefetch_pool: Optional[HostStagingPool] = None,
+        priority: int = wire.PRIORITY_FOREGROUND,
+    ) -> LayerwisePrefetch:
+        """:meth:`start_fetch` for event-loop callers: the probe (a full
+        store round trip) runs in the default executor, then the handle is
+        built inline on the loop via ``known_hit`` — the fetch futures it
+        starts need the running loop, so ONLY the probe may leave it.
+        Mid-wave admission (vllm_v1 phase 1, the engine's install path)
+        calls this so one request's lookup RTT never stalls the wave's
+        other reads (ITS-L001, docs/static_analysis.md)."""
+        self._require_store("start_fetch")
+        hit = await asyncio.to_thread(
+            self._lookup_chains, self._chains(token_ids)
+        )
+        return self.start_fetch(
+            token_ids, first_block=first_block, limit_blocks=limit_blocks,
+            prefetch_pool=prefetch_pool, priority=priority, known_hit=hit,
+        )
 
     def _ensure_prefetch_pool(self) -> HostStagingPool:
         if self._prefetch_pool is None:

@@ -363,9 +363,31 @@ class EngineKVAdapter:
             if priority and getattr(self.connector, "QOS_AWARE", False)
             else {}
         )
-        return self.connector.start_fetch(
+        # Audited: sync entry point — the probe RTT is the caller's
+        # documented cost; loop callers use start_fetch_async.
+        return self.connector.start_fetch(  # its: allow[ITS-L001]
             token_ids, limit_blocks=limit_blocks, **kw
         )
+
+    async def start_fetch_async(
+        self, token_ids, limit_blocks: Optional[int] = None, priority: int = 0
+    ):
+        """``start_fetch`` for event-loop callers: routes to the
+        connector's :meth:`~.connector.KVConnector.start_fetch_async`
+        (probe RTT in an executor) when it has one; a sync-only
+        duck-typed connector falls back to the inline probe, same as
+        before this method existed."""
+        sf_async = getattr(self.connector, "start_fetch_async", None)
+        if sf_async is None:
+            return self.start_fetch(
+                token_ids, limit_blocks=limit_blocks, priority=priority
+            )
+        kw = (
+            {"priority": priority}
+            if priority and getattr(self.connector, "QOS_AWARE", False)
+            else {}
+        )
+        return await sf_async(token_ids, limit_blocks=limit_blocks, **kw)
 
     async def install_kv(self, prefetch, caches, block_table: np.ndarray):
         """The short exclusive half: scatter a prefetch's staged layers
@@ -693,8 +715,14 @@ class ContinuousBatchingHarness:
         prefetch = None
         fallback_hit: Optional[int] = None  # probe answer from a failed start_fetch
         # getattr: adapters without a two-phase path (QuantizingKVAdapter)
-        # simply keep the one-phase gated load below.
-        starter = getattr(self.adapter, "start_fetch", None)
+        # simply keep the one-phase gated load below. Prefer the async
+        # variant — it hops the probe RTT through an executor instead of
+        # blocking this loop mid-wave (ITS-L001).
+        starter = getattr(
+            self.adapter, "start_fetch_async",
+            getattr(self.adapter, "start_fetch", None),
+        )
+        starter_is_async = asyncio.iscoroutinefunction(starter)
         if starter is not None:
             # QoS: a request the block pool cannot admit right now is beyond
             # the next wave — its speculative fetch is opportunistic, so it
@@ -708,7 +736,8 @@ class ContinuousBatchingHarness:
             ):
                 fetch_kw["priority"] = PRIORITY_BACKGROUND
             try:
-                prefetch = starter(token_ids, limit_blocks=n_blocks, **fetch_kw)
+                result = starter(token_ids, limit_blocks=n_blocks, **fetch_kw)
+                prefetch = await result if starter_is_async else result
             except StagingPoolExhausted as e:
                 # Admission backpressure: the staging arena is carrying a
                 # full wave already — this request takes the gated load,

@@ -54,7 +54,10 @@ def kill_transport(conn) -> bool:
         was_live = lib.its_conn_connected(conn._handle) == 1
         # Native close() is idempotent: reconnect()/close() re-closing this
         # handle later is safe, and the handle is destroyed only by close().
-        lib.its_conn_close(conn._handle)
+        # Audited: fault injection severs the transport INLINE by design —
+        # a reset fault must land at a deterministic point in the op stream,
+        # and the close is a local teardown, not a store round trip.
+        lib.its_conn_close(conn._handle)  # its: allow[ITS-L001]
         leftovers = conn._drain_ring_locked(conn._handle)
         # The native close unmapped shm segments: existing views now cover
         # unmapped memory — same bookkeeping reconnect() does.

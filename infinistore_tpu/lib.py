@@ -648,8 +648,10 @@ class InfinityConnection:
                 try:
                     if self._handle is not None:
                         self._unregister_locked(ptr)
-                except InfiniStoreException:
-                    pass  # already gone natively; the dead range still guards
+                # Audited: teardown bookkeeping — the registration is
+                # already gone natively; the dead range below still guards.
+                except InfiniStoreException:  # its: allow[ITS-P001]
+                    pass
                 self._dead_shm_ranges.append((ptr, nbytes))
             self._segment_aliases = []
 
@@ -1117,13 +1119,13 @@ class InfinityConnection:
 
     def completion_stats(self) -> dict:
         """Async-bridge coalescing counters for this connection's lifetime:
-        how many completions the native reactor pushed into the ring, how
-        many eventfd writes it took (one per empty->non-empty transition —
-        completions landing while a wakeup is armed piggyback on it), and
-        the loop-side drain counts. ``completion_batch_size`` =
-        completions / signals: 1.0 means every op paid its own wakeup;
-        higher means pipelined ops shared them (the bench's
-        ``completion_batch_size`` key)."""
+        ``completions`` (ring pushes by the native reactor),
+        ``wakeups_signalled`` (eventfd writes — one per empty->non-empty
+        transition; completions landing while a wakeup is armed piggyback
+        on it), and the loop-side ``loop_wakeups``/``loop_drained`` drain
+        counts. ``completion_batch_size`` = completions / signals: 1.0
+        means every op paid its own wakeup; higher means pipelined ops
+        shared them (the bench's ``completion_batch_size`` key)."""
         pushed = ctypes.c_uint64()
         signalled = ctypes.c_uint64()
         with self._lock:
@@ -1145,9 +1147,11 @@ class InfinityConnection:
     def qos_stats(self) -> dict:
         """Client-side per-class batched-op counters (the QoS ledger's
         client half; the server's scheduler counters are
-        ``get_stats()["qos"]``). ``bg_deferred``/``bg_aged``: this
-        connection's background sub-batches held at / aged past the
-        process-wide foreground gate."""
+        ``get_stats()["qos"]``): ``fg_ops``/``bg_ops`` per-class op
+        counts, ``bg_deferred``/``bg_aged`` — this connection's background
+        sub-batches held at / aged past the process-wide foreground gate —
+        and ``fg_inflight``, the live process-wide foreground count the
+        gate blocks on."""
         return {
             "fg_ops": self._qos_ops[0],
             "bg_ops": self._qos_ops[1],
@@ -1159,7 +1163,25 @@ class InfinityConnection:
     @_reconnecting()
     def get_stats(self) -> dict:
         """Server-side per-op latency/throughput counters — first-class
-        observability the reference lacks (SURVEY.md §5.1)."""
+        observability the reference lacks (SURVEY.md §5.1).
+
+        Snapshot keys (the manage plane serves the same dict at ``/stats``
+        and summarizes it at ``/metrics``; tools/analysis ``counters``
+        keeps all three surfaces in sync):
+
+        - ``kvmap_len``, ``usage``, ``total_bytes``, ``used_bytes``,
+          ``pools``, ``pinned`` — store occupancy and pool directory size;
+        - ``connections``, ``conns_accepted`` — live vs lifetime-accepted
+          data-plane connections;
+        - ``spill``: ``entries``, ``bytes``, ``capacity``, ``promotions``,
+          ``dropped`` — the disk spill tier;
+        - ``qos``: ``fg_ops``/``bg_ops``, ``fg_slices``/``bg_slices``,
+          ``bg_preempted_slices``, ``bg_aged_slices``, ``fg_queued``/
+          ``bg_queued``, plus the ``bg_cooldown_us``/``bg_aging_us``
+          tunables — the two-class slice scheduler (docs/qos.md);
+        - ``suspended_ops`` — sliced ops parked in the reactor;
+        - ``ops``: per-opcode ``count``, ``errors``, ``bytes_in``,
+          ``bytes_out``, ``total_us``, ``p50_us``, ``p99_us``."""
         self._require()
         buf = ctypes.create_string_buffer(64 << 10)
         n = lib.its_conn_stat_json(self._handle, buf, len(buf))
@@ -1502,7 +1524,9 @@ class StripedConnection:
                 return  # operator close() is final; stay quarantined
             try:
                 await loop.run_in_executor(None, conn.reconnect)
-            except InfiniStoreException:
+            # Audited: this loop IS the degrade policy — the stripe stays
+            # quarantined and the reconnect retries on exponential backoff.
+            except InfiniStoreException:  # its: allow[ITS-P001]
                 await asyncio.sleep(delay)
                 delay = min(delay * 2.0, max_delay)
                 continue
@@ -1529,7 +1553,9 @@ class StripedConnection:
                     continue
                 try:
                     conn._register_segment_alias(buf.ctypes.data, buf.nbytes)
-                except InfiniStoreException:
+                # Audited: returning False keeps the stripe quarantined and
+                # the revive loop retrying — the degrade policy for stripes.
+                except InfiniStoreException:  # its: allow[ITS-P001]
                     return False  # died again; stay quarantined, revive retries
         if self._quarantined[idx]:
             self._quarantined[idx] = False
@@ -1800,12 +1826,20 @@ class StripedConnection:
         return len(self.conns) * self.MAX_CHUNK_BLOCKS
 
     def data_plane_stats(self) -> dict:
-        """Scheduler observability: per-stripe chunk/block counts, steal
-        count, measured per-stripe EWMA rates, how often the same-host
-        detector collapsed ops to stripe 0, and the failure-domain ledger
-        (per-stripe errors, requeued blocks, quarantine entries/exits,
-        current quarantine flags, suppressed sibling errors) — the counters
-        the bench's chaos receipts and the quarantine tests pin."""
+        """Scheduler observability — the counters the bench's chaos
+        receipts and the quarantine tests pin:
+
+        - ``streams``, ``adaptive`` — fan-out shape;
+        - ``batched_ops``, ``collapsed_ops`` (same-host detector sent the
+          op to stripe 0), ``small_ops`` (below the split threshold),
+          ``chunks``, ``steals`` (pulls beyond each worker's first),
+          ``stripe_chunks``/``stripe_blocks`` per stripe,
+          ``stripe_ewma_gbps`` measured per-stripe rates;
+        - failure domain: ``stripe_errors``, ``requeued_blocks``,
+          ``quarantines``/``rejoins``, current ``quarantined`` flags,
+          ``suppressed_errors`` (sibling failures a raised batch absorbed);
+        - ``qos``: ``fg_ops``/``bg_ops``, ``bg_deferred_pulls``,
+          ``bg_aged_pulls``, ``bg_subbatches``, live ``fg_pending``."""
         s = self._sched_stats
         return {
             "streams": len(self.conns),

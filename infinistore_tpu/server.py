@@ -103,6 +103,12 @@ def _prometheus_text(stats: dict) -> bytes:
         f'infinistore_pool_bytes{{kind="used"}} {stats["used_bytes"]}',
         "# TYPE infinistore_connections gauge",
         f"infinistore_connections {stats['connections']}",
+        "# TYPE infinistore_connections_accepted counter",
+        f"infinistore_connections_accepted {stats['conns_accepted']}",
+        "# TYPE infinistore_pools gauge",
+        f"infinistore_pools {stats['pools']}",
+        "# TYPE infinistore_pool_pinned gauge",
+        f"infinistore_pool_pinned {1 if stats['pinned'] else 0}",
     ]
     spill = stats.get("spill", {})
     if spill.get("capacity", 0) > 0:
@@ -138,6 +144,12 @@ def _prometheus_text(stats: dict) -> bytes:
             f"infinistore_qos_bg_preempted_slices {qos['bg_preempted_slices']}",
             "# TYPE infinistore_qos_bg_aged_slices counter",
             f"infinistore_qos_bg_aged_slices {qos['bg_aged_slices']}",
+            # Scheduler tunables as gauges: config drift across a fleet is
+            # an operational incident dashboards should be able to show.
+            "# TYPE infinistore_qos_bg_cooldown_us gauge",
+            f"infinistore_qos_bg_cooldown_us {qos['bg_cooldown_us']}",
+            "# TYPE infinistore_qos_bg_aging_us gauge",
+            f"infinistore_qos_bg_aging_us {qos['bg_aging_us']}",
         ]
     # Exposition format requires all samples of a family in one uninterrupted
     # group after its TYPE line — one pass per family, not per op.
@@ -151,9 +163,17 @@ def _prometheus_text(stats: dict) -> bytes:
     for op, s in ops:
         lines.append(f'infinistore_op_bytes{{op="{op}",dir="in"}} {s["bytes_in"]}')
         lines.append(f'infinistore_op_bytes{{op="{op}",dir="out"}} {s["bytes_out"]}')
+    lines.append("# TYPE infinistore_op_time_us counter")
+    for op, s in ops:
+        lines.append(f'infinistore_op_time_us{{op="{op}"}} {s["total_us"]}')
     lines.append("# TYPE infinistore_op_p50_latency_us gauge")
     for op, s in ops:
         lines.append(f'infinistore_op_p50_latency_us{{op="{op}"}} {s["p50_us"]}')
+    # p99 is the number the QoS gates regression-check (tools/bench_check.py)
+    # — exporting only p50 hid tail inflation from dashboards (ITS-C001).
+    lines.append("# TYPE infinistore_op_p99_latency_us gauge")
+    for op, s in ops:
+        lines.append(f'infinistore_op_p99_latency_us{{op="{op}"}} {s["p99_us"]}')
     body = ("\n".join(lines) + "\n").encode()
     return (
         f"HTTP/1.1 200 OK\r\n"
@@ -280,7 +300,8 @@ async def periodic_evict(config: ServerConfig):
 
 async def serve(config: ServerConfig) -> None:
     register_server(None, config)
-    prevent_oom()
+    # /proc write = file IO; keep it off the event loop (ITS-L002).
+    await asyncio.to_thread(prevent_oom)
     manage = ManageServer(config)
     await manage.start()
     tasks = []

@@ -14,6 +14,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from . import wire
 from .config import ClientConfig
 from .lib import InfinityConnection, StripedConnection
 
@@ -80,8 +81,11 @@ def shaped_roundtrip_mbps(
     pairs = [(f"{key_prefix}{streams}-{i}", i * BLOCK) for i in range(n)]
 
     async def once():
-        await conn.write_cache_async(pairs, BLOCK, src.ctypes.data)
-        await conn.read_cache_async(pairs, BLOCK, dst.ctypes.data)
+        # Explicitly FOREGROUND (qos_kwargs encodes nothing for class 0):
+        # the shaped roundtrip measures the untagged wire path byte-for-byte.
+        fg = wire.qos_kwargs(conn, wire.PRIORITY_FOREGROUND)
+        await conn.write_cache_async(pairs, BLOCK, src.ctypes.data, **fg)
+        await conn.read_cache_async(pairs, BLOCK, dst.ctypes.data, **fg)
 
     t0 = time.perf_counter()
     asyncio.run(once())

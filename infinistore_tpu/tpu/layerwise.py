@@ -250,8 +250,16 @@ class LayerwiseKVReader:
         block_ids: np.ndarray,
         key_fn: KeyFn,
         on_layer=None,
+        priority: int = wire.PRIORITY_FOREGROUND,
     ) -> List[Tuple[jax.Array, jax.Array]]:
         """Returns the updated per-layer (K, V) cache list.
+
+        ``priority``: QoS class of the per-layer store reads
+        (wire.PRIORITY_*). The one-phase load is decode-blocking, so
+        FOREGROUND is the default; a speculative caller may tag
+        BACKGROUND so the fetches yield to live decode traffic
+        (docs/qos.md). The tag is dropped on QoS-unaware connections
+        (wire.qos_kwargs).
 
         ``on_layer(layer, (k, v))``: optional hook invoked as each layer's
         scatter is ISSUED (layers complete in order 0..L-1) with that
@@ -280,7 +288,10 @@ class LayerwiseKVReader:
                 (key_fn(layer, "v", i), base + (n + i) * bn) for i in range(n)
             ]
             return asyncio.ensure_future(
-                self.conn.read_cache_async(blocks, bn, pool.base_ptr)
+                self.conn.read_cache_async(
+                    blocks, bn, pool.base_ptr,
+                    **wire.qos_kwargs(self.conn, priority),
+                )
             )
 
         # Pipeline: with R regions, keep W = R-2 network fetches in flight

@@ -1,0 +1,601 @@
+"""Tests for the in-repo static-analysis suite (tools/analysis).
+
+Two layers of guarantee:
+
+1. **Seeded violations**: for every checker, fixtures carrying a deliberate
+   violation of each drift/violation class must FIRE. The wire-drift
+   fixtures are mutated copies of the REAL protocol.h / wire.py (changed
+   field width, reordered field, missing Priority value, drifted opcode,
+   missing struct, header-layout drift), so the parser is exercised
+   against production text, not toy grammars.
+2. **Clean tree**: `python -m tools.analysis --all` exits 0 on the
+   repository as committed — the acceptance gate CI's `analysis` job runs.
+
+Plus the framework mechanics: inline `# its: allow[ID]` suppressions,
+the committed-baseline flow, and machine-readable JSON output.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis import core, counters, loop_block, policy, wire_drift  # noqa: E402
+
+
+def make_tree(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return core.Context(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# wire_drift (ITS-W*)
+# ---------------------------------------------------------------------------
+
+def drifted_ctx(tmp_path, header_sub=None, wire_sub=None, wire_append=""):
+    """Context over copies of the real protocol.h / wire.py with one
+    targeted mutation applied (asserting the anchor text exists, so a
+    refactor that moves it fails loudly here instead of silently testing
+    nothing)."""
+    hdr = (REPO / wire_drift.HEADER_REL).read_text()
+    wr = (REPO / wire_drift.WIRE_REL).read_text()
+    if header_sub is not None:
+        old, new = header_sub
+        assert old in hdr, f"fixture anchor missing from protocol.h: {old!r}"
+        hdr = hdr.replace(old, new, 1)
+    if wire_sub is not None:
+        old, new = wire_sub
+        assert old in wr, f"fixture anchor missing from wire.py: {old!r}"
+        wr = wr.replace(old, new, 1)
+    wr += wire_append
+    return make_tree(tmp_path, {wire_drift.HEADER_REL: hdr, wire_drift.WIRE_REL: wr})
+
+
+class TestWireDrift:
+    def test_real_tree_is_clean(self):
+        assert wire_drift.compare(core.Context(str(REPO))) == []
+
+    def test_parser_inventory(self):
+        """The parsers must see the full protocol surface — a parser that
+        silently skips half the header would also 'find no drift'."""
+        ctx = core.Context(str(REPO))
+        cpp = wire_drift.parse_header(ctx)
+        py = wire_drift.parse_wire(ctx)
+        ops = [k for k in cpp.constants if k.startswith("OP_")]
+        assert len(ops) == 16
+        assert len([k for k in cpp.constants if k.startswith("STATUS_")]) == 8
+        assert cpp.constants["PRIORITY_BACKGROUND"] == 1
+        assert cpp.header_asserts == {"ReqHeader": 9, "RespHeader": 16}
+        for name in ("BatchMeta", "SegBatchMeta", "ShmLocResp", "SegMeta",
+                     "TcpPutMeta", "TicketMeta", "KeyMeta", "KeyListMeta"):
+            assert name in cpp.structs and name in py.structs
+        # The QoS tag is an OPTIONAL trailing byte on both batch metas.
+        assert cpp.structs["BatchMeta"][-1] == "u8?"
+        assert cpp.structs["SegBatchMeta"][-1] == "u8?"
+
+    def test_changed_field_width_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "w.u32(block_size);\n        w.str_list(keys);",
+            "w.u16(block_size);\n        w.str_list(keys);",
+        ))
+        rules = {(f.rule, "BatchMeta" in f.message) for f in wire_drift.compare(ctx)}
+        assert ("ITS-W002", True) in rules
+
+    def test_reordered_field_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "w.u32(block_size);\n        w.u16(seg_id);",
+            "w.u16(seg_id);\n        w.u32(block_size);",
+        ))
+        found = [f for f in wire_drift.compare(ctx) if f.rule == "ITS-W002"]
+        assert any("SegBatchMeta" in f.message for f in found)
+
+    def test_missing_priority_value_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "kPriorityBackground = 1,", "",
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W001" and "PRIORITY_BACKGROUND" in f.message
+            for f in found
+        )
+
+    def test_opcode_value_drift_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_sub=(
+            'OP_STAT = ord("S")', 'OP_STAT = ord("T")',
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W001" and "OP_STAT" in f.message for f in found
+        )
+
+    def test_missing_struct_mirror_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_sub=(
+            "class TicketMeta:", "class TicketMetaRenamed:",
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W003" and "TicketMeta" in f.message for f in found
+        )
+
+    def test_fixed_header_drift_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_sub=(
+            '_REQ_HEADER = struct.Struct("<IBI")',
+            '_REQ_HEADER = struct.Struct("<IBH")',
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W004" and "ReqHeader" in f.message for f in found
+        )
+
+    def test_header_static_assert_drift_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "uint32_t body_size;\n};\nstruct RespHeader",
+            "uint16_t body_size;\n};\nstruct RespHeader",
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(f.rule == "ITS-W004" for f in found)
+
+    def test_python_only_struct_is_caught(self, tmp_path):
+        """The diff is bidirectional: a wire-encoding dataclass added only
+        to wire.py (not registered as client-side framing) must fire —
+        the native server could never parse its bytes."""
+        ctx = drifted_ctx(tmp_path, wire_append=(
+            "\n\n@dataclass\nclass RogueMeta:\n"
+            "    n: int = 0\n\n"
+            "    def encode(self) -> bytes:\n"
+            '        return struct.pack("<I", self.n)\n'
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W003" and "RogueMeta" in f.message for f in found
+        )
+
+    def test_python_only_header_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_append=(
+            '\n_ROGUE_HEADER = struct.Struct("<IQ")\n'
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W004" and "_ROGUE_HEADER" in f.message for f in found
+        )
+
+    def test_block_comment_preserves_line_anchors(self, tmp_path):
+        """/* */ comments must not shift finding lines: suppression markers
+        index into the ORIGINAL file."""
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "#pragma once",
+            "/* a\n block\n comment\n */\n#pragma once",
+        ))
+        base = {
+            k: v for k, v in wire_drift.parse_header(
+                core.Context(str(REPO))).const_lines.items()
+        }
+        shifted = wire_drift.parse_header(ctx, wire_drift.HEADER_REL).const_lines
+        # Original file line 14 is `#pragma once`; the fixture adds exactly
+        # 4 lines before it, so every constant's anchor shifts by exactly 4.
+        assert shifted["MAGIC"] == base["MAGIC"] + 4
+
+
+# ---------------------------------------------------------------------------
+# loop_block (ITS-L*)
+# ---------------------------------------------------------------------------
+
+LOOP_FIXTURE = '''\
+import asyncio
+import threading
+import time
+
+
+def helper():
+    time.sleep(2)
+
+
+async def direct():
+    time.sleep(1)
+
+
+async def transitive():
+    helper()
+
+
+async def escaped():
+    await asyncio.to_thread(helper)
+
+
+async def allowed():
+    time.sleep(3)  # its: allow[ITS-L002]
+
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.conn = None
+
+    async def locked(self):
+        with self._lock:
+            pass
+
+    async def native(self):
+        lib.its_conn_connect(None)
+
+    async def store(self):
+        self.conn.read_cache([], 0, 0)
+'''
+
+
+class TestLoopBlock:
+    @pytest.fixture()
+    def fixture_ctx(self, tmp_path):
+        return make_tree(tmp_path, {"pkg/mod.py": LOOP_FIXTURE})
+
+    def test_seeded_violations_fire(self, fixture_ctx):
+        found = loop_block.scan(fixture_ctx, package_rel="pkg", audited={})
+        by_slug = {(f.rule, f.key.rsplit(":", 2)[-2:][0]) for f in found}
+        # direct sleep in async body
+        assert any(f.rule == "ITS-L002" and ":direct:" in f.key for f in found)
+        # transitive through a sync helper, with the path in the message
+        trans = [f for f in found if ":helper:" in f.key]
+        assert trans and "transitive -> helper" in trans[0].message
+        # lock acquire, native call, blocking store method
+        assert any(f.rule == "ITS-L003" and "C.locked" in f.key for f in found)
+        assert any(f.rule == "ITS-L001" and "its_conn_connect" in f.key for f in found)
+        assert any(f.rule == "ITS-L001" and "read_cache" in f.key for f in found)
+        del by_slug  # documented-above assertions are the contract
+
+    def test_executor_hop_escapes(self, fixture_ctx):
+        found = loop_block.scan(fixture_ctx, package_rel="pkg", audited={})
+        # helper IS flagged via transitive(); the to_thread reference in
+        # escaped() must not add an entry of its own (same site dedup) nor
+        # flag escaped() itself.
+        assert not any("escaped" in f.message for f in found)
+
+    def test_inline_allow_suppresses(self, fixture_ctx):
+        found = loop_block.scan(fixture_ctx, package_rel="pkg", audited={})
+        allowed = [f for f in found if ":allowed:" in f.key]
+        assert allowed  # the checker still SEES it...
+        assert fixture_ctx.suppressed(allowed[0])  # ...but the marker wins
+
+    def test_same_basename_modules_all_scanned(self, tmp_path):
+        """Modules are keyed by path: two __init__.py (or same-named)
+        files in different subpackages must BOTH be scanned."""
+        bad = "import time\n\n\nasync def tick():\n    time.sleep(1)\n"
+        ctx = make_tree(tmp_path, {
+            "pkg/a/__init__.py": bad,
+            "pkg/b/__init__.py": bad,
+        })
+        found = loop_block.scan(ctx, package_rel="pkg", audited={})
+        files = {f.file for f in found}
+        assert files == {"pkg/a/__init__.py", "pkg/b/__init__.py"}
+
+    def test_start_fetch_is_a_blocking_name(self, tmp_path):
+        """start_fetch embeds a probe RTT; an un-hopped call in an async
+        body must fire (the vllm phase-1 regression class)."""
+        ctx = make_tree(tmp_path, {"pkg/m.py": (
+            "async def wave(kv):\n"
+            "    return kv.start_fetch([1, 2])\n"
+        )})
+        found = loop_block.scan(ctx, package_rel="pkg", audited={})
+        assert any(
+            f.rule == "ITS-L001" and "start_fetch" in f.key for f in found
+        )
+
+    def test_audited_fg_gate_seed_is_active(self):
+        """The committed allowlist must cover exactly the audited QoS
+        foreground gate in lib.py: with the seed the real tree is clean,
+        without it the gate's condition-variable ops surface."""
+        ctx = core.Context(str(REPO))
+        with_seed = loop_block.scan(ctx)
+        assert not [f for f in with_seed if not ctx.suppressed(f)]
+        bare = loop_block.scan(ctx, audited={})
+        gate = [f for f in bare if "_fg_gate_" in f.key]
+        assert gate, "fg gate sites should surface without the audit seed"
+
+
+# ---------------------------------------------------------------------------
+# counters (ITS-C*)
+# ---------------------------------------------------------------------------
+
+FIXTURE_CPP = '''
+#include <string>
+std::string Server::stats_json() {
+    std::string out;
+    out = "{\\"alpha\\":" + std::to_string(a_) +
+          ",\\"grp\\":{\\"beta\\":" + std::to_string(b_) + "}" +
+          ",\\"ops\\":{";
+    for (const auto& [op, s] : stats_) {
+        out += "\\"" + std::string(1, op) + "\\":{" +
+               "\\"count\\":" + std::to_string(s.count) + "}";
+    }
+    out += "}}";
+    return out;
+}
+'''
+
+FIXTURE_MANAGE = '''
+def _prometheus_text(stats):
+    lines = [f"alpha {stats['alpha']}", f"gamma {stats['gamma']}"]
+    for op, s in sorted(stats.get("ops", {}).items()):
+        lines.append(f"count {s['count']}")
+    return "\\n".join(lines)
+
+
+def route(path):
+    if path == "/stats":
+        return get_server_stats()
+'''
+
+
+class TestCounters:
+    @pytest.fixture()
+    def fixture_ctx(self, tmp_path):
+        return make_tree(tmp_path, {
+            "native/server.cpp": FIXTURE_CPP,
+            "manage.py": FIXTURE_MANAGE,
+            "docs.md": "documented: alpha, count, gamma.\n",
+        })
+
+    def run_scan(self, ctx):
+        return counters.scan(
+            ctx, server_cpp_rel="native/server.cpp", manage_rel="manage.py",
+            docs_rel="docs.md", ledgers=[],
+        )
+
+    def test_native_key_tree(self, fixture_ctx):
+        keys = counters.native_stats_keys(fixture_ctx, "native/server.cpp")
+        assert keys == {"alpha", "grp.beta", "ops.*.count"}
+
+    def test_unexported_and_stale_keys_fire(self, fixture_ctx):
+        found = self.run_scan(fixture_ctx)
+        rules = {(f.rule, f.key.rsplit(":", 1)[-1]) for f in found}
+        assert ("ITS-C001", "grp.beta") in rules      # native, not exported
+        assert ("ITS-C002", "gamma") in rules         # exported, not native
+        assert any(r == "ITS-C003" and k == "grp.beta" for r, k in rules)
+
+    def test_missing_stats_route_fires(self, tmp_path):
+        ctx = make_tree(tmp_path, {
+            "native/server.cpp": FIXTURE_CPP,
+            "manage.py": FIXTURE_MANAGE.replace('"/stats"', '"/nope"'),
+            "docs.md": "alpha beta count gamma",
+        })
+        found = counters.scan(
+            ctx, server_cpp_rel="native/server.cpp", manage_rel="manage.py",
+            docs_rel="docs.md", ledgers=[],
+        )
+        assert any(f.rule == "ITS-C004" for f in found)
+
+    def test_ledger_keys_doc_checked(self, tmp_path):
+        ctx = make_tree(tmp_path, {
+            "native/server.cpp": FIXTURE_CPP,
+            "manage.py": FIXTURE_MANAGE,
+            "docs.md": "alpha count gamma grp beta documented_key",
+            "led.py": (
+                "class K:\n"
+                "    def stats(self):\n"
+                "        return {'documented_key': 1, 'mystery_key': 2}\n"
+            ),
+        })
+        found = counters.scan(
+            ctx, server_cpp_rel="native/server.cpp", manage_rel="manage.py",
+            docs_rel="docs.md", ledgers=[("led.py", "K.stats")],
+        )
+        ledger = [f for f in found if "K.stats" in f.key]
+        assert any("mystery_key" in f.key for f in ledger)
+        assert not any("documented_key" in f.key for f in ledger)
+
+    def test_real_tree_is_clean(self):
+        assert counters.scan(core.Context(str(REPO))) == []
+
+    def test_real_native_inventory(self):
+        """Pin the shape of the real stats_json parse: qos + spill + ops
+        subtrees must all be seen (a parser regression that drops a subtree
+        would otherwise pass 'clean')."""
+        keys = counters.native_stats_keys(core.Context(str(REPO)))
+        assert "qos.fg_ops" in keys and "spill.dropped" in keys
+        assert "ops.*.p99_us" in keys and "conns_accepted" in keys
+
+
+# ---------------------------------------------------------------------------
+# policy (ITS-P*)
+# ---------------------------------------------------------------------------
+
+POLICY_FIXTURE = '''\
+class InfiniStoreException(Exception):
+    pass
+
+
+def swallowed(conn):
+    try:
+        conn.op()
+    except InfiniStoreException:
+        pass
+
+
+def routed(self, conn):
+    try:
+        conn.op()
+    except InfiniStoreException as e:
+        self._degrade([0], e)
+
+
+def rethrown(conn):
+    try:
+        conn.op()
+    except InfiniStoreException:
+        raise
+
+
+def semantic_ok(conn):
+    try:
+        conn.op()
+    except InfiniStoreKeyNotFound:
+        return 0
+
+
+async def untagged(conn):
+    await conn.write_cache_async([], 0, 0)
+
+
+async def tagged(conn):
+    await conn.write_cache_async([], 0, 0, priority=1)
+
+
+async def splatted(conn, kw):
+    await conn.read_cache_async([], 0, 0, **kw)
+'''
+
+
+class TestPolicy:
+    @pytest.fixture()
+    def fixture_ctx(self, tmp_path):
+        return make_tree(tmp_path, {"pkg/mod.py": POLICY_FIXTURE})
+
+    def test_seeded_violations_fire(self, fixture_ctx):
+        found = policy.scan(fixture_ctx, package_rel="pkg",
+                            p001_exempt=set(), p002_exempt=set())
+        p1 = [f for f in found if f.rule == "ITS-P001"]
+        p2 = [f for f in found if f.rule == "ITS-P002"]
+        assert len(p1) == 1 and p1[0].line == POLICY_FIXTURE.splitlines().index(
+            "    except InfiniStoreException:"
+        ) + 1
+        assert len(p2) == 1 and "write_cache_async" in p2[0].message
+
+    def test_real_tree_is_clean_after_suppressions(self):
+        ctx = core.Context(str(REPO))
+        found = policy.scan(ctx)
+        assert not [f for f in found if not ctx.suppressed(f)]
+
+
+# ---------------------------------------------------------------------------
+# framework: baseline, suppression classification, CLI, JSON
+# ---------------------------------------------------------------------------
+
+class TestFramework:
+    def test_baseline_marks_known_findings(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/mod.py": POLICY_FIXTURE})
+        raw = policy.scan(ctx, package_rel="pkg",
+                          p001_exempt=set(), p002_exempt=set())
+        assert raw
+
+        # A run() over a checker stub: everything baselined -> not failing.
+        def stub(c):
+            return policy.scan(c, package_rel="pkg",
+                               p001_exempt=set(), p002_exempt=set())
+
+        core.CHECKERS["_stub"] = core.Checker("_stub", "test stub", stub)
+        try:
+            baseline = {f.key: "audited in test" for f in raw}
+            res = core.run(["_stub"], ctx=ctx, baseline=baseline)
+            assert not res.failed and len(res.baselined) == len(raw)
+            res2 = core.run(["_stub"], ctx=ctx, baseline={})
+            assert res2.failed
+        finally:
+            del core.CHECKERS["_stub"]
+
+    def test_stable_keys_do_not_move_with_unrelated_edits(self, tmp_path):
+        ctx1 = make_tree(tmp_path / "a", {"pkg/mod.py": POLICY_FIXTURE})
+        ctx2 = make_tree(
+            tmp_path / "b",
+            {"pkg/mod.py": "# unrelated leading comment\n\n" + POLICY_FIXTURE},
+        )
+        k1 = {f.key for f in policy.scan(ctx1, package_rel="pkg",
+                                         p001_exempt=set(), p002_exempt=set())}
+        k2 = {f.key for f in policy.scan(ctx2, package_rel="pkg",
+                                         p001_exempt=set(), p002_exempt=set())}
+        assert k1 == k2
+
+    def test_cli_all_green_with_json(self, tmp_path):
+        out = tmp_path / "analysis.json"
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", "--all", "--json", str(out)],
+            cwd=str(REPO), capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        payload = json.loads(out.read_text())
+        assert payload["failed"] is False
+        assert set(payload["per_checker"]) == {
+            "counters", "loop_block", "policy", "wire_drift",
+        }
+        assert payload["counts"]["new"] == 0
+
+    def test_cli_rejects_unknown_checker(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", "nonsense"],
+            cwd=str(REPO), capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 2
+
+    def test_committed_baseline_is_loadable(self):
+        baseline = core.load_baseline()
+        assert isinstance(baseline, dict)
+
+    def test_write_baseline_preserves_other_checkers_entries(self, tmp_path):
+        """Baselining one checker's findings must not drop another
+        checker's audited entries (prune is scoped to the ran checkers'
+        rule prefixes)."""
+        path = str(tmp_path / "baseline.json")
+        core.write_baseline(
+            [core.Finding(rule="ITS-L001", file="a.py", line=1,
+                          message="m", key="ITS-L001:a.py:f")],
+            path=path, prune_prefixes=None,
+        )
+        # A policy-only rewrite: the loop_block entry must survive.
+        core.write_baseline(
+            [core.Finding(rule="ITS-P001", file="b.py", line=1,
+                          message="m", key="ITS-P001:b.py:g")],
+            path=path, prune_prefixes=["ITS-P"],
+        )
+        entries = core.load_baseline(path)
+        assert "ITS-L001:a.py:f" in entries and "ITS-P001:b.py:g" in entries
+        # A full rewrite (prune everything) drops stale entries.
+        core.write_baseline([], path=path, prune_prefixes=None)
+        assert core.load_baseline(path) == {}
+
+    def test_baseline_path_follows_root(self, tmp_path):
+        """--root runs must use THAT tree's baseline, not this repo's."""
+        ctx = core.Context(str(tmp_path))
+        assert ctx.baseline_path.startswith(str(tmp_path))
+
+    def test_policy_keys_anchor_on_enclosing_scope(self, tmp_path):
+        """Adding a violation in one function must not re-key another
+        function's baseline entry (the unsound-baseline failure mode)."""
+        ctx1 = make_tree(tmp_path / "a", {"pkg/mod.py": POLICY_FIXTURE})
+        extra = POLICY_FIXTURE.replace(
+            "def swallowed(conn):",
+            "def earlier(conn):\n"
+            "    try:\n"
+            "        conn.op()\n"
+            "    except InfiniStoreException:\n"
+            "        pass\n\n\n"
+            "def swallowed(conn):",
+        )
+        ctx2 = make_tree(tmp_path / "b", {"pkg/mod.py": extra})
+        k1 = {f.key for f in policy.scan(ctx1, package_rel="pkg",
+                                         p001_exempt=set(), p002_exempt=set())}
+        k2 = {f.key for f in policy.scan(ctx2, package_rel="pkg",
+                                         p001_exempt=set(), p002_exempt=set())}
+        assert k1 <= k2  # old keys intact; the new function adds its own
+        assert any("earlier" in k for k in k2 - k1)
+
+    def test_cli_write_baseline_also_writes_json(self, tmp_path):
+        out = tmp_path / "analysis.json"
+        baseline_file = REPO / "tools" / "analysis" / "baseline.json"
+        snapshot = baseline_file.read_text()
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.analysis", "--all",
+                 "--json", str(out), "--write-baseline"],
+                cwd=str(REPO), capture_output=True, text=True, timeout=120,
+            )
+            assert proc.returncode == 0, proc.stdout + proc.stderr
+            assert json.loads(out.read_text())["counts"]["new"] == 0
+        finally:
+            baseline_file.write_text(snapshot)  # the test must not mutate the repo

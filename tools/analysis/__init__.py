@@ -1,0 +1,20 @@
+"""In-repo static-analysis suite: `python -m tools.analysis --all`.
+
+Four project-specific checkers over invariants unit tests can only sample
+(docs/static_analysis.md):
+
+- ``wire_drift``  (ITS-W*): native/include/its/protocol.h and
+  infinistore_tpu/wire.py must describe the same wire format.
+- ``loop_block``  (ITS-L*): no blocking operation reachable from an
+  ``async def`` body without an executor hop.
+- ``counters``    (ITS-C*): every stat counter surfaces in the manage-plane
+  exporters and the API reference — no silent observability drift.
+- ``policy``      (ITS-P*): transport-error handling routes through the
+  degrade policy; batched-op producers pass an explicit QoS class.
+
+Importing the subpackage registers every checker with core.CHECKERS.
+"""
+
+from . import core  # noqa: F401
+from . import counters, loop_block, policy, wire_drift  # noqa: F401
+from .core import CHECKERS, Context, Finding, run  # noqa: F401

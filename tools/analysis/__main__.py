@@ -1,0 +1,88 @@
+"""CLI for the in-repo static-analysis suite.
+
+    python -m tools.analysis --all                 # run every checker
+    python -m tools.analysis wire_drift policy     # run a subset
+    python -m tools.analysis --all --json out.json # machine-readable output
+    python -m tools.analysis --all --write-baseline
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when new
+findings exist, 2 on usage errors. See docs/static_analysis.md.
+"""
+
+import argparse
+import json
+import sys
+
+from . import CHECKERS
+from .core import Context, load_baseline, run, write_baseline
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="Run the project's cross-language invariant checkers.",
+    )
+    parser.add_argument("checkers", nargs="*", help="checker names (see --list)")
+    parser.add_argument("--all", action="store_true", help="run every registered checker")
+    parser.add_argument("--list", action="store_true", help="list checkers and exit")
+    parser.add_argument("--json", metavar="PATH", help="write machine-readable results (- for stdout)")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite tools/analysis/baseline.json with the current new findings",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the committed baseline (every finding counts as new)",
+    )
+    parser.add_argument("--root", default=None, help="repo root override (tests)")
+    args = parser.parse_args(argv)
+
+    if args.list:
+        for name, chk in sorted(CHECKERS.items()):
+            print(f"{name:14s} {chk.doc}")
+        return 0
+
+    names = sorted(CHECKERS) if args.all else args.checkers
+    if not names:
+        parser.print_usage()
+        print("error: name at least one checker or pass --all", file=sys.stderr)
+        return 2
+    unknown = [n for n in names if n not in CHECKERS]
+    if unknown:
+        print(f"error: unknown checker(s) {unknown}; see --list", file=sys.stderr)
+        return 2
+
+    ctx = Context(args.root) if args.root else Context()
+    baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
+    result = run(names, ctx=ctx, baseline=baseline)
+
+    if args.write_baseline:
+        # Rebuild only the ran checkers' entries (their rule prefixes);
+        # other checkers' audited entries are preserved verbatim.
+        prefixes = [CHECKERS[n].rule_prefix for n in names]
+        write_baseline(result.new + result.baselined, path=ctx.baseline_path,
+                       prune_prefixes=prefixes)
+        print(
+            f"baseline rewritten: {len(result.new) + len(result.baselined)} "
+            f"entries for {names} (other checkers' entries preserved)"
+        )
+    else:
+        for f in result.new + result.baselined:
+            print(f.render())
+        counts = result.to_json()["counts"]
+        print(
+            f"analysis: {len(names)} checker(s); {counts['new']} new, "
+            f"{counts['baselined']} baselined, {counts['suppressed']} suppressed"
+        )
+    if args.json:
+        payload = json.dumps(result.to_json(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    return 0 if args.write_baseline else (1 if result.failed else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,245 @@
+"""Shared core of the in-repo static-analysis suite.
+
+The four project checkers (wire_drift, loop_block, counters, policy — see
+docs/static_analysis.md) are exhaustive passes over invariants the unit
+tests can only sample: protocol-layout agreement between C++ and Python,
+event-loop blocking reachability, observability-export completeness, and
+the degrade/QoS policy discipline. This module owns everything they share:
+
+- ``Finding``: one diagnostic with a STABLE identity key (rule + file +
+  symbol, never a line number) so baselines and suppressions survive
+  unrelated edits.
+- ``Context``: repo-rooted file access with caching, plus the inline
+  suppression scan (``# its: allow[RULE-ID]`` on the flagged line or the
+  line above).
+- Baseline: a committed JSON file of known/audited finding keys
+  (``tools/analysis/baseline.json``); a finding in the baseline is reported
+  but does not fail the run. ``--write-baseline`` regenerates it.
+- Registry + runner + text/JSON reporting for ``python -m tools.analysis``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import asdict, dataclass, field
+from typing import Callable, Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+# Inline suppression: `# its: allow[ITS-L001]` (comma-separated IDs allowed)
+# on the finding's line or the line directly above it. The bracket payload
+# is deliberately strict — a typo'd rule id suppresses nothing.
+_ALLOW_RE = re.compile(r"its:\s*allow\[([A-Za-z0-9_,\- ]+)\]")
+
+
+@dataclass
+class Finding:
+    """One diagnostic. ``key`` is the stable identity used by baselines and
+    dedup: rule id + file + a checker-chosen symbol slug — never the line
+    number, so a baseline entry survives unrelated edits to the file."""
+
+    rule: str  # e.g. "ITS-W001"
+    file: str  # repo-relative posix path
+    line: int  # 1-based; 0 = whole file
+    message: str
+    key: str = ""
+    baselined: bool = False
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = f"{self.rule}:{self.file}"
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.line else self.file
+        tag = " [baselined]" if self.baselined else ""
+        return f"{loc}: {self.rule}{tag} {self.message}"
+
+
+class Context:
+    """Repo-rooted file access + suppression scanning for checkers."""
+
+    def __init__(self, root: str = REPO_ROOT):
+        self.root = root
+        self._text: Dict[str, str] = {}
+
+    def path(self, rel: str) -> str:
+        return os.path.join(self.root, rel)
+
+    def exists(self, rel: str) -> bool:
+        return os.path.exists(self.path(rel))
+
+    def read(self, rel: str) -> str:
+        if rel not in self._text:
+            with open(self.path(rel), "r", encoding="utf-8", errors="replace") as f:
+                self._text[rel] = f.read()
+        return self._text[rel]
+
+    def lines(self, rel: str) -> List[str]:
+        return self.read(rel).splitlines()
+
+    def walk_py(self, rel_dir: str) -> List[str]:
+        """Repo-relative paths of every .py file under ``rel_dir``, sorted
+        for deterministic finding order."""
+        out = []
+        for dirpath, dirnames, filenames in os.walk(self.path(rel_dir)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(
+                        os.path.relpath(os.path.join(dirpath, name), self.root)
+                        .replace(os.sep, "/")
+                    )
+        return sorted(out)
+
+    @property
+    def baseline_path(self) -> str:
+        """The committed baseline of THIS root — a --root run (tests,
+        foreign checkouts) must read and write its own tree's baseline,
+        never the baseline of the repo the tool is installed in."""
+        return os.path.join(self.root, "tools", "analysis", "baseline.json")
+
+    def suppressed(self, finding: Finding) -> bool:
+        """True when the finding's line (or the line above) carries an
+        ``its: allow[<rule>]`` marker naming this finding's rule."""
+        if not finding.line:
+            return False
+        try:
+            lines = self.lines(finding.file)
+        except OSError:
+            return False
+        for ln in (finding.line, finding.line - 1):
+            if 1 <= ln <= len(lines):
+                m = _ALLOW_RE.search(lines[ln - 1])
+                if m and finding.rule in [s.strip() for s in m.group(1).split(",")]:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Checker registry.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Checker:
+    name: str
+    doc: str
+    fn: Callable[[Context], List[Finding]]
+    rule_prefix: str = ""  # e.g. "ITS-W": owns every key starting with it
+
+
+CHECKERS: Dict[str, Checker] = {}
+
+
+def register(name: str, doc: str, rule_prefix: str = ""):
+    def deco(fn):
+        CHECKERS[name] = Checker(name=name, doc=doc, fn=fn, rule_prefix=rule_prefix)
+        return fn
+
+    return deco
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Dict[str, str]:
+    """Committed baseline: {finding key -> reason}. Missing file = empty."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("entries", {}))
+
+
+def write_baseline(
+    findings: List[Finding],
+    path: str = BASELINE_PATH,
+    reason: str = "baselined",
+    prune_prefixes: Optional[List[str]] = None,
+):
+    """Rewrite the baseline from ``findings``. ``prune_prefixes`` names
+    the rule prefixes of the checkers that actually RAN: only their
+    entries are rebuilt; every other checker's entries are preserved
+    verbatim, so baselining one checker's finding cannot silently drop
+    another's audited entries. ``None`` prunes everything (a full run)."""
+    old = load_baseline(path)
+    if prune_prefixes is None:
+        entries = {}
+    else:
+        entries = {
+            k: v for k, v in old.items()
+            if not any(k.startswith(p) for p in prune_prefixes if p)
+        }
+    entries.update({
+        f.key: old.get(f.key, reason) for f in sorted(findings, key=lambda f: f.key)
+    })
+    payload = {
+        "comment": (
+            "Known/audited findings of `python -m tools.analysis` keyed by "
+            "stable id (rule:file:symbol). Entries here are reported but do "
+            "not fail the run; regenerate with --write-baseline, and prefer "
+            "FIXING or inline `# its: allow[ID]`-annotating findings over "
+            "baselining new ones (docs/static_analysis.md)."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+@dataclass
+class RunResult:
+    """Outcome of one suite run, split by disposition."""
+
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    per_checker: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new)
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "failed": self.failed,
+            "counts": {
+                "new": len(self.new),
+                "baselined": len(self.baselined),
+                "suppressed": len(self.suppressed),
+            },
+            "per_checker": self.per_checker,
+            "findings": [asdict(f) for f in self.new],
+            "baselined": [asdict(f) for f in self.baselined],
+            "suppressed": [asdict(f) for f in self.suppressed],
+        }
+
+
+def run(
+    names: List[str],
+    ctx: Optional[Context] = None,
+    baseline: Optional[Dict[str, str]] = None,
+) -> RunResult:
+    """Run the named checkers; classify findings as suppressed (inline
+    allow), baselined (committed known/audited), or new (fail the run)."""
+    ctx = ctx or Context()
+    # Default to the TARGET tree's committed baseline (ctx.baseline_path),
+    # never this repo's — a --root / API run against a foreign checkout
+    # must honor that checkout's audits.
+    baseline = load_baseline(ctx.baseline_path) if baseline is None else baseline
+    result = RunResult()
+    for name in names:
+        chk = CHECKERS[name]
+        findings = sorted(chk.fn(ctx), key=lambda f: (f.file, f.line, f.rule, f.key))
+        result.per_checker[name] = 0
+        for f in findings:
+            if ctx.suppressed(f):
+                result.suppressed.append(f)
+            elif f.key in baseline:
+                f.baselined = True
+                result.baselined.append(f)
+            else:
+                result.new.append(f)
+                result.per_checker[name] += 1
+    return result

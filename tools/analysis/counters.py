@@ -1,0 +1,340 @@
+"""ITS-C*: stat-counter consistency across the observability surfaces.
+
+A counter that exists in the data plane but never reaches an exporter is
+observability drift: the operator dashboards silently stop seeing what
+the code started counting (the reference ships no metrics at all;
+SURVEY.md §5.1 made this a first-class goal here). This pass extracts:
+
+- the native server's ``stats_json()`` key tree (native/src/server.cpp) —
+  the source of truth the manage plane re-serves,
+- the keys the manage plane's Prometheus exporter
+  (``server.py _prometheus_text``) actually consumes,
+- the client-side Python ledgers' keys (``qos_stats``,
+  ``completion_stats``, ``data_plane_stats``, cluster ``health``/
+  ``as_dict``),
+- the documented vocabulary of docs/api_reference.md,
+
+and cross-checks them:
+
+- ITS-C001 native stats_json key not consumed by the /metrics exporter
+- ITS-C002 /metrics consumes a key the native stats_json no longer emits
+  (a runtime KeyError waiting for the next scrape)
+- ITS-C003 counter key absent from docs/api_reference.md
+- ITS-C004 manage plane no longer serves /stats verbatim from
+  get_server_stats
+
+Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
+both sides.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, register
+
+SERVER_CPP_REL = "native/src/server.cpp"
+MANAGE_REL = "infinistore_tpu/server.py"
+DOCS_REL = "docs/api_reference.md"
+
+# Client-side counter ledgers: (file, dotted function path). Keys are read
+# from returned/assigned dict literals and subscript stores inside them.
+LEDGERS: List[Tuple[str, str]] = [
+    ("infinistore_tpu/lib.py", "InfinityConnection.qos_stats"),
+    ("infinistore_tpu/lib.py", "InfinityConnection.completion_stats"),
+    ("infinistore_tpu/lib.py", "StripedConnection.data_plane_stats"),
+    ("infinistore_tpu/lib.py", "StripedConnection.completion_stats"),
+    ("infinistore_tpu/cluster.py", "_MemberHealth.as_dict"),
+    ("infinistore_tpu/cluster.py", "ClusterKVConnector.health"),
+]
+
+
+# ---------------------------------------------------------------------------
+# Native side: reconstruct the stats_json() key tree from the C++ string
+# concatenation. All string literals in the function body, concatenated in
+# order, form a JSON skeleton ({"kvmap_len":,"spill":{...}}...); dynamic
+# segments (the per-op keys) collapse to empty names, reported as "*".
+# ---------------------------------------------------------------------------
+
+_STR_LIT = re.compile(r'"((?:[^"\\]|\\.)*)"')
+
+
+def native_stats_keys(ctx: Context, rel: str = SERVER_CPP_REL) -> Set[str]:
+    src = ctx.read(rel)
+    m = re.search(r"std::string\s+\w+::stats_json\s*\(\)\s*\{", src)
+    if not m:
+        return set()
+    depth, end = 0, len(src)
+    for j in range(m.end() - 1, len(src)):
+        if src[j] == "{":
+            depth += 1
+        elif src[j] == "}":
+            depth -= 1
+            if depth == 0:
+                end = j
+                break
+    body = src[m.end(): end]
+    skeleton = "".join(
+        lit.replace('\\"', '"') for lit in _STR_LIT.findall(body)
+    )
+    return _skeleton_keys(skeleton)
+
+
+def _skeleton_keys(skeleton: str) -> Set[str]:
+    keys: Set[str] = set()
+    stack: List[Optional[str]] = []
+    pending: Optional[str] = None
+    i = 0
+    while i < len(skeleton):
+        c = skeleton[i]
+        if c == '"':
+            j = skeleton.find('"', i + 1)
+            if j < 0:
+                break
+            name = skeleton[i + 1: j]
+            if j + 1 < len(skeleton) and skeleton[j + 1] == ":":
+                pending = name or "*"
+                i = j + 2
+                continue
+            i = j + 1
+            continue
+        if c == "{":
+            stack.append(pending)
+            pending = None
+            i += 1
+            continue
+        if pending is not None and c not in " \t\n":
+            # A leaf value begins (or the literal skeleton jumps straight
+            # to the closing brace around a dynamic value): record the
+            # dotted path BEFORE any '}' pops the enclosing group, then
+            # re-examine the same character.
+            keys.add(".".join([s for s in stack if s] + [pending]))
+            pending = None
+            continue
+        if c == "}" and stack:
+            stack.pop()
+        i += 1
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# Exporter side: keys _prometheus_text consumes from the stats snapshot.
+# ---------------------------------------------------------------------------
+
+def metrics_consumed_keys(ctx: Context, rel: str = MANAGE_REL,
+                          fn_name: str = "_prometheus_text") -> Set[str]:
+    tree = ast.parse(ctx.read(rel))
+    fn = next(
+        (
+            node for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == fn_name
+        ),
+        None,
+    )
+    if fn is None:
+        return set()
+    arg0 = fn.args.args[0].arg if fn.args.args else "stats"
+    ctx_of: Dict[str, str] = {arg0: ""}
+    consumed: Set[str] = set()
+
+    def sub_key(node) -> Optional[Tuple[str, str]]:
+        """(var, key) for NAME["key"] / NAME.get("key", ...)"""
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)
+        ):
+            return node.value.id, node.slice.value
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            return node.func.value.id, node.args[0].value
+        return None
+
+    def path_of(var: str, key: str) -> Optional[str]:
+        if var not in ctx_of:
+            return None
+        prefix = ctx_of[var]
+        return f"{prefix}.{key}" if prefix else key
+
+    # Pass 1: context assignments (spill = stats.get("spill", {})) and loop
+    # targets over a contexted iterable (for op, s in ops: -> s is ops.*).
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            refs = []
+            for sub in ast.walk(node.value):
+                sk = sub_key(sub)
+                if sk is not None and sk[0] in ctx_of:
+                    refs.append(sk)
+            if len(refs) == 1:
+                p = path_of(*refs[0])
+                if p is not None:
+                    ctx_of[node.targets[0].id] = p
+        if isinstance(node, ast.For):
+            iter_names = {
+                n.id for n in ast.walk(node.iter) if isinstance(n, ast.Name)
+            }
+            hit = sorted(v for v in iter_names if ctx_of.get(v))
+            if hit:
+                prefix = ctx_of[hit[0]] + ".*"
+                targets = (
+                    node.target.elts if isinstance(node.target, ast.Tuple)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        ctx_of.setdefault(t.id, prefix)
+
+    # Pass 2: consumptions.
+    for node in ast.walk(fn):
+        sk = sub_key(node)
+        if sk is not None:
+            p = path_of(*sk)
+            if p is not None:
+                consumed.add(p)
+    return consumed
+
+
+# ---------------------------------------------------------------------------
+# Client-side Python ledgers.
+# ---------------------------------------------------------------------------
+
+def _find_fn(tree: ast.Module, dotted: str):
+    parts = dotted.split(".")
+    scope, node = tree.body, None
+    for part in parts:
+        node = next(
+            (
+                n for n in scope
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+                and n.name == part
+            ),
+            None,
+        )
+        if node is None:
+            return None
+        scope = node.body
+    return node
+
+
+def _dict_keys(node: ast.Dict, prefix: str = "") -> Set[str]:
+    out: Set[str] = set()
+    for k, v in zip(node.keys, node.values):
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            path = f"{prefix}.{k.value}" if prefix else k.value
+            if isinstance(v, ast.Dict):
+                out |= _dict_keys(v, path)
+            else:
+                out.add(path)
+    return out
+
+
+def ledger_keys(ctx: Context, rel: str, dotted: str) -> Tuple[Set[str], int]:
+    tree = ast.parse(ctx.read(rel))
+    fn = _find_fn(tree, dotted)
+    if fn is None:
+        return set(), 0
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            keys |= _dict_keys(node)
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].slice, ast.Constant)
+            and isinstance(node.targets[0].slice.value, str)
+        ):
+            keys.add(node.targets[0].slice.value)
+    return keys, fn.lineno
+
+
+# ---------------------------------------------------------------------------
+# Checks.
+# ---------------------------------------------------------------------------
+
+def scan(
+    ctx: Context,
+    server_cpp_rel: str = SERVER_CPP_REL,
+    manage_rel: str = MANAGE_REL,
+    docs_rel: str = DOCS_REL,
+    ledgers: Optional[List[Tuple[str, str]]] = None,
+) -> List[Finding]:
+    ledgers = LEDGERS if ledgers is None else ledgers
+    findings: List[Finding] = []
+    native = native_stats_keys(ctx, server_cpp_rel)
+    consumed = metrics_consumed_keys(ctx, manage_rel)
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+
+    for key in sorted(native - consumed):
+        findings.append(Finding(
+            rule="ITS-C001", file=manage_rel, line=1,
+            message=f"native stats_json key {key!r} is not exported by the "
+                    "/metrics exporter (_prometheus_text) — silent "
+                    "observability drift",
+            key=f"ITS-C001:{manage_rel}:{key}",
+        ))
+    def is_container(key: str) -> bool:
+        return any(n.startswith(key + ".") for n in native)
+
+    for key in sorted(k for k in consumed - native if not is_container(k)):
+        findings.append(Finding(
+            rule="ITS-C002", file=manage_rel, line=1,
+            message=f"/metrics consumes stats key {key!r} which the native "
+                    "stats_json no longer emits (KeyError at scrape time)",
+            key=f"ITS-C002:{manage_rel}:{key}",
+        ))
+
+    def doc_check(key: str, origin: str, file: str, line: int):
+        leaf = key.rsplit(".", 1)[-1]
+        if leaf == "*" or leaf in doc_words:
+            return
+        findings.append(Finding(
+            rule="ITS-C003", file=file, line=line,
+            message=f"counter key {key!r} ({origin}) is undocumented in "
+                    f"{docs_rel} — enumerate it in its accessor's docstring "
+                    "and regenerate the reference (tools/gen_api_docs.py)",
+            key=f"ITS-C003:{file}:{origin}:{key}",
+        ))
+
+    for key in sorted(native):
+        doc_check(key, "server stats_json", server_cpp_rel, 1)
+    for rel, dotted in ledgers:
+        keys, lineno = ledger_keys(ctx, rel, dotted)
+        for key in sorted(keys):
+            doc_check(key, dotted, rel, lineno)
+
+    manage_src = ctx.read(manage_rel)
+    if (
+        not re.search(r'[\'"]/stats[\'"]', manage_src)
+        or "get_server_stats" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C004", file=manage_rel, line=1,
+            message="manage plane must serve GET /stats verbatim from "
+                    "get_server_stats (the raw counter surface /metrics "
+                    "summarizes)",
+            key=f"ITS-C004:{manage_rel}:stats-route",
+        ))
+    return findings
+
+
+@register("counters",
+          "every stat counter reaches /stats, /metrics and the API reference (ITS-C*)",
+          rule_prefix="ITS-C")
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)

@@ -1,0 +1,395 @@
+"""ITS-L*: blocking operations reachable from the event loop.
+
+PR 4's decisive bug was exactly this shape: the background submitter's
+GIL-holding work sat inside the foreground op's completion chain on the
+event loop, and no test failed — the loop still made progress, just 10x
+slower at the tail. This pass walks every ``async def`` in the package
+and taints transitively through calls it can resolve statically
+(same-scope nested functions, same-module functions, ``self.`` methods of
+the same class, and ``module.func`` through import aliases), flagging
+blocking primitives that run ON the loop:
+
+- ITS-L001 blocking native call (``lib.its_*`` outside the audited
+  non-blocking set: async submits, ring drains, counters, logging) or a
+  blocking store-client method (``.read_cache()``, ``.connect()``, ...)
+- ITS-L002 blocking sleep / file / socket / subprocess call
+  (``time.sleep``, ``open``, ``socket.gethostbyname``, ...)
+- ITS-L003 threading lock/condition acquire (``with <lock>``,
+  ``.acquire()``, ``.wait()``) on a lock created via ``threading.*``
+
+Escapes that do NOT taint: references passed to ``asyncio.to_thread`` /
+``run_in_executor`` / ``Executor.submit`` are never *called* on the loop,
+so they fall out naturally (only ``Call`` nodes create edges).
+
+The audited allowlist (AUDITED, below) names blocking sites reviewed and
+accepted by design — chiefly the process-wide QoS foreground gate in
+lib.py, whose condition-variable ops are uncontended-bounded on the fast
+path and whose potentially-long waits run in a dedicated executor.
+Everything else needs a fix, an inline ``# its: allow[ITS-L00x]``, or a
+baseline entry.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, register
+
+PACKAGE_REL = "infinistore_tpu"
+
+# Native entry points that do NOT block the caller: pure submits (the
+# reactor completes them), ring drains, counter/status queries, logging.
+NONBLOCKING_NATIVE = {
+    "its_log",
+    "its_set_log_level",
+    "its_free",
+    "its_conn_put_batch",        # async submit; completion rides the ring
+    "its_conn_get_batch",        # async submit
+    "its_conn_set_completion_fd",
+    "its_conn_drain_completions",
+    "its_conn_completion_counters",
+    "its_conn_shm_active",
+    "its_conn_connected",
+    "its_server_port",
+}
+
+# Module-level calls that block: (module name, attr) -> description.
+BLOCKING_MODULE_CALLS = {
+    ("time", "sleep"): "time.sleep()",
+    ("socket", "gethostbyname"): "socket.gethostbyname() (DNS)",
+    ("socket", "getaddrinfo"): "socket.getaddrinfo() (DNS)",
+    ("socket", "create_connection"): "socket.create_connection()",
+    ("os", "system"): "os.system()",
+    ("os", "popen"): "os.popen()",
+    ("subprocess", "run"): "subprocess.run()",
+    ("subprocess", "check_output"): "subprocess.check_output()",
+    ("subprocess", "check_call"): "subprocess.check_call()",
+}
+
+# Store-client methods that block regardless of receiver (the sync data
+# plane surface of InfinityConnection/StripedConnection and the module
+# control plane of lib). Receiver-agnostic: ``self.conn.check_exist()`` in
+# an async body blocks the loop no matter what ``self.conn`` is bound to.
+BLOCKING_METHOD_NAMES = {
+    "write_cache", "read_cache", "tcp_write_cache", "tcp_read_cache",
+    "check_exist", "get_match_last_index", "delete_keys", "get_stats",
+    "register_mr", "unregister_mr", "alloc_shm_mr", "connect", "reconnect",
+    "close_connection",
+    "start_fetch",  # embeds a blocking probe RTT; loop callers use _async
+    "purge_kv_map", "evict_cache", "get_server_stats", "get_kvmap_len",
+}
+
+# Audited blocking sites: (file, enclosing function qualname) -> why the
+# block is accepted. The QoS foreground gate (docs/qos.md) is the seeded
+# case: its lock ops are two uncontended acquires on the fast path, and
+# every potentially-long wait (_bg_gate_block) runs in the dedicated gate
+# executor, never on the loop.
+AUDITED = {
+    ("infinistore_tpu/lib.py", "_fg_gate_enter"):
+        "QoS fg gate: one uncontended condition-lock increment, bounded",
+    ("infinistore_tpu/lib.py", "_fg_gate_exit"):
+        "QoS fg gate: one uncontended condition-lock decrement + notify",
+    ("infinistore_tpu/lib.py", "InfinityConnection._semaphore"):
+        "per-loop semaphore registry: lock taken once per loop lifetime "
+        "(slow path); steady state is a lock-free dict read",
+}
+
+
+@dataclass
+class FnInfo:
+    qualname: str
+    file: str
+    is_async: bool
+    lineno: int
+    # (line, rule, slug, description)
+    blocking: List[Tuple[int, str, str, str]] = field(default_factory=list)
+    # ("name", fn) | ("self", meth) | ("mod", alias, fn)
+    calls: List[Tuple[str, ...]] = field(default_factory=list)
+    cls: Optional[str] = None
+    parent: Optional[str] = None  # enclosing function qualname (nested defs)
+
+
+class ModuleIndex:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.fns: Dict[str, FnInfo] = {}
+        self.import_aliases: Dict[str, str] = {}  # local name -> module basename
+        self.module_locks: Set[str] = set()
+        self.class_locks: Dict[str, Set[str]] = {}
+        self._collect(tree)
+
+    def _collect(self, tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_import(node)
+        # Lock discovery first (body scanning consults it).
+        for node in tree.body:
+            cls = node.name if isinstance(node, ast.ClassDef) else None
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and _is_threading_ctor(sub.value):
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name) and cls is None:
+                            self.module_locks.add(tgt.id)
+                        elif (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                            and cls is not None
+                        ):
+                            self.class_locks.setdefault(cls, set()).add(tgt.attr)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_fn(node, qual=node.name, cls=None, parent=None)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._collect_fn(
+                            item, qual=f"{node.name}.{item.name}",
+                            cls=node.name, parent=None,
+                        )
+
+    def _collect_import(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                self.import_aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name.split(".")[-1]
+                )
+        else:
+            for a in node.names:
+                self.import_aliases[a.asname or a.name] = a.name
+
+    def _collect_fn(self, node, qual: str, cls: Optional[str], parent: Optional[str]):
+        info = FnInfo(
+            qualname=qual, file=self.rel,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            lineno=node.lineno, cls=cls, parent=parent,
+        )
+        self.fns[qual] = info
+        scanner = _BodyScanner(self, info)
+        for stmt in node.body:
+            scanner.visit(stmt)
+        # Nested defs are separate functions; they taint only when called.
+        for inner in scanner.nested:
+            self._collect_fn(inner, qual=f"{qual}.<locals>.{inner.name}",
+                             cls=cls, parent=qual)
+
+
+def _is_threading_ctor(node) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id in ("threading", "queue")
+        and node.func.attr in (
+            "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+            "Event", "Barrier", "Queue", "LifoQueue", "PriorityQueue",
+        )
+    )
+
+
+_WAIT_ATTRS = ("acquire", "wait", "wait_for", "get", "put", "join")
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """One function body: record blocking sites and resolvable call edges.
+    Nested function definitions are collected, not descended into."""
+
+    def __init__(self, mod: ModuleIndex, info: FnInfo):
+        self.mod = mod
+        self.info = info
+        self.nested: List[ast.AST] = []
+
+    def visit_FunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_Lambda(self, node):
+        pass  # runs only when called; receivers are unresolvable anyway
+
+    def visit_With(self, node):
+        for item in node.items:
+            expr = item.context_expr
+            name = self._lock_name(expr)
+            if name:
+                self.info.blocking.append((
+                    node.lineno, "ITS-L003", f"with-{name}",
+                    f"`with {name}:` acquires a threading lock",
+                ))
+        self.generic_visit(node)
+
+    def _lock_name(self, expr) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in self.mod.module_locks:
+            return expr.id
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.info.cls
+            and expr.attr in self.mod.class_locks.get(self.info.cls, set())
+        ):
+            return f"self.{expr.attr}"
+        return None
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            if fn.id == "open":
+                self.info.blocking.append(
+                    (node.lineno, "ITS-L002", "open", "open() performs file IO")
+                )
+            else:
+                self.info.calls.append(("name", fn.id))
+        elif isinstance(fn, ast.Attribute):
+            self._attr_call(node, fn)
+        self.generic_visit(node)
+
+    def _attr_call(self, node: ast.Call, fn: ast.Attribute):
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            key = (recv.id, fn.attr)
+            if key in BLOCKING_MODULE_CALLS:
+                self.info.blocking.append((
+                    node.lineno, "ITS-L002", f"{recv.id}.{fn.attr}",
+                    f"{BLOCKING_MODULE_CALLS[key]} blocks the loop",
+                ))
+                return
+            if recv.id == "lib" and fn.attr.startswith("its_"):
+                if fn.attr not in NONBLOCKING_NATIVE:
+                    self.info.blocking.append((
+                        node.lineno, "ITS-L001", fn.attr,
+                        f"native call lib.{fn.attr}() blocks until the store "
+                        "answers (not in the audited non-blocking set)",
+                    ))
+                return
+            if recv.id == "self":
+                self.info.calls.append(("self", fn.attr))
+                return
+            lock = self._lock_name(recv)
+            if lock and fn.attr in _WAIT_ATTRS:
+                self.info.blocking.append((
+                    node.lineno, "ITS-L003", f"{lock}.{fn.attr}",
+                    f"{lock}.{fn.attr}() blocks on a threading primitive",
+                ))
+                return
+            if recv.id in self.mod.import_aliases:
+                self.info.calls.append(
+                    ("mod", self.mod.import_aliases[recv.id], fn.attr)
+                )
+                return
+        else:
+            lock = self._lock_name(recv)
+            if lock and fn.attr in _WAIT_ATTRS:
+                self.info.blocking.append((
+                    node.lineno, "ITS-L003", f"{lock}.{fn.attr}",
+                    f"{lock}.{fn.attr}() blocks on a threading primitive",
+                ))
+                return
+        if fn.attr in BLOCKING_METHOD_NAMES:
+            self.info.blocking.append((
+                node.lineno, "ITS-L001", fn.attr,
+                f".{fn.attr}() is a blocking store operation",
+            ))
+
+
+def build_index(ctx: Context, package_rel: str = PACKAGE_REL) -> Dict[str, ModuleIndex]:
+    """Modules keyed by repo-relative path — basenames collide (four
+    __init__.py files in this package alone) and a basename key would
+    silently drop all but one colliding module from the scan."""
+    modules: Dict[str, ModuleIndex] = {}
+    for rel in ctx.walk_py(package_rel):
+        try:
+            tree = ast.parse(ctx.read(rel))
+        except SyntaxError:
+            continue
+        modules[rel] = ModuleIndex(rel, tree)
+    return modules
+
+
+def _by_basename(modules: Dict[str, ModuleIndex]) -> Dict[str, ModuleIndex]:
+    """Import-alias resolution map. On a basename collision the shallower
+    path wins deterministically (aliases like ``from . import lib`` mean
+    the package-level module; __init__ collisions are never aliased)."""
+    out: Dict[str, ModuleIndex] = {}
+    for rel in sorted(modules, key=lambda r: (r.count("/"), r)):
+        out.setdefault(rel.rsplit("/", 1)[-1][:-3], modules[rel])
+    return out
+
+
+def _resolve(mod: ModuleIndex, by_base: Dict[str, ModuleIndex], info: FnInfo,
+             call: Tuple[str, ...]) -> Optional[FnInfo]:
+    if call[0] == "name":
+        if info.parent:
+            sib = mod.fns.get(f"{info.parent}.<locals>.{call[1]}")
+            if sib:
+                return sib
+        nested = mod.fns.get(f"{info.qualname}.<locals>.{call[1]}")
+        if nested:
+            return nested
+        return mod.fns.get(call[1])
+    if call[0] == "self" and info.cls:
+        return mod.fns.get(f"{info.cls}.{call[1]}")
+    if call[0] == "mod":
+        target = by_base.get(call[1])
+        if target:
+            return target.fns.get(call[2])
+    return None
+
+
+def scan(ctx: Context, package_rel: str = PACKAGE_REL,
+         audited: Optional[dict] = None) -> List[Finding]:
+    audited = AUDITED if audited is None else audited
+    modules = build_index(ctx, package_rel)
+    by_base = _by_basename(modules)
+    mod_of: Dict[int, ModuleIndex] = {}
+    for m in modules.values():
+        for fninfo in m.fns.values():
+            mod_of[id(fninfo)] = m
+
+    findings: List[Finding] = []
+    seen_sites: Set[Tuple[str, str, int, str]] = set()
+    entries = [
+        fninfo for m in modules.values() for fninfo in m.fns.values()
+        if fninfo.is_async
+    ]
+    for entry in sorted(entries, key=lambda e: (e.file, e.lineno)):
+        # DFS over sync callees; async callees are entry points themselves.
+        stack: List[Tuple[FnInfo, List[str]]] = [(entry, [entry.qualname])]
+        visited: Set[str] = set()
+        while stack:
+            fninfo, path = stack.pop()
+            vkey = f"{fninfo.file}:{fninfo.qualname}"
+            if vkey in visited:
+                continue
+            visited.add(vkey)
+            for line, rule, slug, desc in fninfo.blocking:
+                if (fninfo.file, fninfo.qualname) in audited:
+                    continue
+                site = (fninfo.file, fninfo.qualname, line, slug)
+                if site in seen_sites:
+                    continue
+                seen_sites.add(site)
+                via = "" if len(path) == 1 else f" (reached via {' -> '.join(path)})"
+                findings.append(Finding(
+                    rule=rule, file=fninfo.file, line=line,
+                    message=f"{desc}; on the event loop in async "
+                            f"{entry.qualname}{via} — hop through an executor "
+                            "(asyncio.to_thread / run_in_executor)",
+                    key=f"{rule}:{fninfo.file}:{fninfo.qualname}:{slug}",
+                ))
+            m = mod_of[id(fninfo)]
+            for call in fninfo.calls:
+                callee = _resolve(m, by_base, fninfo, call)
+                if callee is not None and not callee.is_async:
+                    stack.append((callee, path + [callee.qualname]))
+    return findings
+
+
+@register("loop_block",
+          "no blocking op reachable from async def without an executor hop (ITS-L*)",
+          rule_prefix="ITS-L")
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)

@@ -1,0 +1,202 @@
+"""ITS-P*: degrade-policy and QoS-tagging discipline.
+
+Two conventions hold the self-healing (PR 3) and QoS (PR 4) planes
+together, and both are enforceable only by reading every call site —
+exactly what this pass does:
+
+- ITS-P001 **transport errors route through the degrade policy.** An
+  ``except`` clause that names ``InfiniStoreException`` (the TRANSPORT
+  error type; the semantic subclasses KeyNotFound / ResourcePressure /
+  NoMatch are legitimate control flow) must re-raise, feed a breaker /
+  quarantine / degrade routine, or park the error on a future. A handler
+  that just logs-and-continues turns a dead store into silent data loss
+  (docs/robustness.md's failure-policy matrix). ``faults.py`` is exempt —
+  it manufactures transport errors by design.
+
+- ITS-P002 **batched-op producers tag a QoS class at the source.** Calls
+  to the batched data-plane ops (``*_cache_async`` / ``write_cache`` /
+  ``read_cache``) outside the transport layer itself must pass
+  ``priority`` explicitly (kwarg, 4th positional, or a ``**kw`` splat
+  that forwards it, e.g. ``wire.qos_kwargs``). An untagged producer
+  defaults to FOREGROUND silently and erodes the isolation the two-class
+  scheduler measures (docs/qos.md); the decision must be visible at the
+  call site. ``benchmark.py`` is exempt: its synthetic legs measure the
+  untagged default path on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Context, Finding, register
+
+PACKAGE_REL = "infinistore_tpu"
+
+# Transport exception names (the base type). Semantic subclasses are NOT
+# transport failures and may be caught freely.
+TRANSPORT_EXC = {"InfiniStoreException"}
+SEMANTIC_EXC = {
+    "InfiniStoreKeyNotFound", "InfiniStoreResourcePressure", "InfiniStoreNoMatch",
+}
+
+# A handler body containing any of these routes the error into the degrade
+# machinery: breaker records, member attribution, stripe quarantine,
+# future-parking, or the cluster degrade accounting.
+ROUTING_CALLS = {
+    "_degrade", "_done", "_quarantine", "record_failure", "set_exception",
+    "_absorb", "_record", "fail",
+}
+
+# ITS-P001 exemptions (whole files): fault injection exists to fabricate
+# and absorb transport errors.
+P001_EXEMPT_FILES = {"infinistore_tpu/faults.py"}
+
+# Batched data-plane ops whose producers must tag a class.
+BATCHED_OPS = {
+    "rdma_write_cache_async", "rdma_read_cache_async",
+    "write_cache_async", "read_cache_async",
+    "write_cache", "read_cache",
+}
+
+# ITS-P002 scope exclusions: the transport layer itself (lib.py owns the
+# default), the fault shim (pass-through), and the benchmark harness
+# (deliberately measures the untagged default path).
+P002_EXEMPT_FILES = {
+    "infinistore_tpu/lib.py",
+    "infinistore_tpu/faults.py",
+    "infinistore_tpu/benchmark.py",
+}
+
+
+def _scope_map(tree: ast.Module) -> dict:
+    """node -> dotted name of the nearest enclosing function/class scope.
+    Finding keys anchor on the scope (plus a within-scope index only when
+    a scope holds several hits), so adding a handler elsewhere in the file
+    cannot re-key someone else's baseline entry."""
+    scopes: dict = {}
+
+    def visit(node, qual: str):
+        for child in ast.iter_child_nodes(node):
+            q = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                q = f"{qual}.{child.name}" if qual else child.name
+            scopes[child] = q
+            visit(child, q)
+
+    visit(tree, "")
+    return scopes
+
+
+def _scoped_key(rule: str, rel: str, scope: str, slug: str, nth: dict) -> str:
+    base = f"{rule}:{rel}:{scope or '<module>'}" + (f":{slug}" if slug else "")
+    nth[base] = nth.get(base, 0) + 1
+    return base if nth[base] == 1 else f"{base}:{nth[base]}"
+
+
+def _exc_names(handler: ast.ExceptHandler) -> Set[str]:
+    node = handler.type
+    if node is None:
+        return set()
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    names = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.add(e.attr)
+    return names
+
+
+def _routes_error(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name in ROUTING_CALLS:
+                return True
+    return False
+
+
+def _passes_priority(call: ast.Call) -> bool:
+    if any(kw.arg == "priority" for kw in call.keywords):
+        return True
+    if any(kw.arg is None for kw in call.keywords):  # **splat (qos_kwargs)
+        return True
+    return len(call.args) >= 4  # (blocks, block_size, ptr, priority)
+
+
+def scan(ctx: Context, package_rel: str = PACKAGE_REL,
+         p001_exempt: Optional[Set[str]] = None,
+         p002_exempt: Optional[Set[str]] = None) -> List[Finding]:
+    p001_exempt = P001_EXEMPT_FILES if p001_exempt is None else p001_exempt
+    p002_exempt = P002_EXEMPT_FILES if p002_exempt is None else p002_exempt
+    findings: List[Finding] = []
+    for rel in ctx.walk_py(package_rel):
+        try:
+            tree = ast.parse(ctx.read(rel))
+        except SyntaxError:
+            continue
+        if rel not in p001_exempt:
+            findings += _scan_p001(rel, tree)
+        if rel not in p002_exempt:
+            findings += _scan_p002(rel, tree)
+    return findings
+
+
+def _scan_p001(rel: str, tree: ast.Module) -> List[Finding]:
+    out: List[Finding] = []
+    scopes = _scope_map(tree)
+    nth: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _exc_names(node)
+        if not (names & TRANSPORT_EXC):
+            continue
+        if _routes_error(node):
+            continue
+        out.append(Finding(
+            rule="ITS-P001", file=rel, line=node.lineno,
+            message="except clause catches the TRANSPORT error type "
+                    "(InfiniStoreException) without re-raising or routing "
+                    "it through the degrade policy (breaker / quarantine / "
+                    "_degrade / set_exception) — a dead store degrades to "
+                    "silent data loss here (docs/robustness.md)",
+            key=_scoped_key("ITS-P001", rel, scopes.get(node, ""), "", nth),
+        ))
+    return out
+
+
+def _scan_p002(rel: str, tree: ast.Module) -> List[Finding]:
+    out: List[Finding] = []
+    scopes = _scope_map(tree)
+    nth: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in BATCHED_OPS):
+            continue
+        if _passes_priority(node):
+            continue
+        out.append(Finding(
+            rule="ITS-P002", file=rel, line=node.lineno,
+            message=f".{fn.attr}() without an explicit QoS class — pass "
+                    "priority= (or **wire.qos_kwargs(conn, priority)) so "
+                    "the FOREGROUND/BACKGROUND decision is visible at the "
+                    "producing call site (docs/qos.md)",
+            key=_scoped_key("ITS-P002", rel, scopes.get(node, ""), fn.attr, nth),
+        ))
+    return out
+
+
+@register("policy",
+          "transport errors route through the degrade policy; producers tag a QoS class (ITS-P*)",
+          rule_prefix="ITS-P")
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)

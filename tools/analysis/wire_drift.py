@@ -1,0 +1,499 @@
+"""ITS-W*: wire-format drift between protocol.h and wire.py.
+
+The wire protocol is defined twice by design — the native header owns the
+data plane, the Python mirror owns the ctypes boundary and the protocol
+unit tests — and every PR that touches the format must edit both by hand
+(PR 4's optional trailing priority byte did exactly that). A one-sided
+edit corrupts bytes silently: the tests sample a handful of encodings,
+this checker diffs the two definitions exhaustively.
+
+Both sides are parsed into one IR:
+
+- constants: canonical names (OP_*, STATUS_*, PRIORITY_*, MAGIC,
+  MAX_BODY_SIZE) -> int values,
+- fixed headers: packed field-width sequences + the sizes the C++
+  static_asserts and the Python struct formats imply,
+- struct bodies: each shared struct's encode() as a primitive field
+  sequence ("u32", "str_list", "u64*" for a repeated field, "{u16,str,u64}*"
+  for a repeated group, trailing "?" for an optional field).
+
+Rules:
+- ITS-W001 constant missing on one side or value mismatch
+- ITS-W002 struct field sequence drift (reorder / width change / optionality)
+- ITS-W003 struct present in the header but absent from the mirror
+- ITS-W004 fixed header layout/size drift
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import struct as pystruct
+from typing import Dict, List, Optional, Tuple
+
+from .core import Context, Finding, register
+
+HEADER_REL = "native/include/its/protocol.h"
+WIRE_REL = "infinistore_tpu/wire.py"
+
+# Deliberately client-side framings: encoded in wire.py but never spoken
+# to the native server (ChunkDesc is the striped scheduler's descriptor
+# record). Any OTHER Python-only struct/header is flagged — a message the
+# mirror encodes that the native side cannot parse is exactly the
+# one-sided-edit corruption this checker exists for.
+PY_ONLY_STRUCTS = {"ChunkDesc"}
+
+_WIDTHS = {"uint8_t": 1, "uint16_t": 2, "uint32_t": 4, "uint64_t": 8, "int32_t": 4}
+_FMT_PRIMS = {"B": "u8", "H": "u16", "I": "u32", "Q": "u64", "i": "i32"}
+
+
+def _camel_to_snake(name: str) -> str:
+    return re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_", name).upper()
+
+
+def _canon_cpp_const(name: str) -> Optional[str]:
+    """kOpPutBatch -> OP_PUT_BATCH, kStatusOk -> STATUS_OK, kMagic -> MAGIC."""
+    for prefix, out in (("kOp", "OP_"), ("kStatus", "STATUS_"), ("kPriority", "PRIORITY_")):
+        if name.startswith(prefix):
+            return out + _camel_to_snake(name[len(prefix):])
+    if name.startswith("k"):
+        return _camel_to_snake(name[1:])
+    return None
+
+
+def _cpp_int(text: str) -> Optional[int]:
+    """Evaluate the integer initializers protocol.h actually uses:
+    literals (hex/dec, u suffix), char literals, and `N << M` shifts."""
+    text = text.strip().rstrip(";").strip()
+    m = re.fullmatch(r"'(.)'", text)
+    if m:
+        return ord(m.group(1))
+    m = re.fullmatch(r"(0[xX][0-9a-fA-F]+|\d+)[uUlL]*", text)
+    if m:
+        return int(m.group(1), 0)
+    m = re.fullmatch(r"(0[xX][0-9a-fA-F]+|\d+)[uUlL]*\s*<<\s*(\d+)", text)
+    if m:
+        return int(m.group(1), 0) << int(m.group(2))
+    return None
+
+
+def _strip_comments(src: str) -> str:
+    # Keep line structure both ways (finding anchors and inline
+    # suppressions index into the ORIGINAL file): a block comment is
+    # replaced by its own newlines, not deleted.
+    src = re.sub(
+        r"/\*.*?\*/", lambda m: "\n" * m.group(0).count("\n"), src, flags=re.S
+    )
+    return re.sub(r"//[^\n]*", "", src)
+
+
+def _line_of(src: str, pos: int) -> int:
+    return src.count("\n", 0, pos) + 1
+
+
+class HeaderIR:
+    def __init__(self):
+        self.constants: Dict[str, int] = {}
+        self.const_lines: Dict[str, int] = {}
+        self.headers: Dict[str, List[Tuple[str, int]]] = {}
+        self.header_asserts: Dict[str, int] = {}
+        self.structs: Dict[str, List[str]] = {}
+        self.struct_lines: Dict[str, int] = {}
+
+
+def parse_header(ctx: Context, rel: str = HEADER_REL) -> HeaderIR:
+    raw = ctx.read(rel)
+    src = _strip_comments(raw)
+    ir = HeaderIR()
+
+    for m in re.finditer(r"constexpr\s+\w+\s+(k\w+)\s*=\s*([^;]+);", src):
+        canon = _canon_cpp_const(m.group(1))
+        val = _cpp_int(m.group(2))
+        if canon is not None and val is not None:
+            ir.constants[canon] = val
+            ir.const_lines[canon] = _line_of(src, m.start())
+
+    for m in re.finditer(r"enum\s+(\w+)\s*(?::\s*\w+)?\s*\{", src):
+        body = src[m.end(): src.index("}", m.end())]
+        for em in re.finditer(r"(k\w+)\s*=\s*([^,\n]+)", body):
+            canon = _canon_cpp_const(em.group(1))
+            val = _cpp_int(em.group(2))
+            if canon is not None and val is not None:
+                ir.constants[canon] = val
+                ir.const_lines[canon] = _line_of(src, m.end() + em.start())
+
+    # Packed fixed headers: every struct between pack(push, 1) and pack(pop)
+    # whose fields are plain integer declarations.
+    for pm in re.finditer(r"#pragma\s+pack\(push,\s*1\)(.*?)#pragma\s+pack\(pop\)", src, re.S):
+        for sm in re.finditer(r"struct\s+(\w+)\s*\{(.*?)\};", pm.group(1), re.S):
+            fields = [
+                (fm.group(2), _WIDTHS[fm.group(1)])
+                for fm in re.finditer(r"(uint8_t|uint16_t|uint32_t|uint64_t|int32_t)\s+(\w+)\s*;", sm.group(2))
+            ]
+            ir.headers[sm.group(1)] = fields
+            ir.struct_lines[sm.group(1)] = _line_of(src, pm.start(1) + sm.start())
+    for am in re.finditer(r"static_assert\(\s*sizeof\((\w+)\)\s*==\s*(\d+)", src):
+        ir.header_asserts[am.group(1)] = int(am.group(2))
+
+    # Body structs: encode() methods scanned into field sequences.
+    for sm in re.finditer(r"struct\s+(\w+)\s*\{", src):
+        name = sm.group(1)
+        if name in ir.headers:
+            continue
+        body = _balanced(src, sm.end() - 1)
+        if body is None:
+            continue
+        em = re.search(r"void\s+encode\s*\([^)]*\)\s*const\s*\{", body)
+        if not em:
+            continue
+        enc = _balanced(body, em.end() - 1)
+        if enc is None:
+            continue
+        ir.structs[name] = _scan_cpp_encode(enc)
+        ir.struct_lines[name] = _line_of(src, sm.start())
+    return ir
+
+
+def _balanced(src: str, open_pos: int) -> Optional[str]:
+    """Text inside the brace block whose '{' is at open_pos."""
+    depth = 0
+    for i in range(open_pos, len(src)):
+        if src[i] == "{":
+            depth += 1
+        elif src[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return src[open_pos + 1: i]
+    return None
+
+
+_CPP_EVENT = re.compile(
+    r"(?P<forkw>\bfor\s*\()|(?P<ifkw>\bif\s*\()|(?P<open>\{)|(?P<close>\})|"
+    r"w\.(?P<prim>u8|u16|u32|u64|i32|str_list|str)\s*\("
+)
+
+
+def _scan_cpp_encode(body: str) -> List[str]:
+    """Order-preserving scan of a C++ encode() body into the field-sequence
+    IR. Handles braced and single-statement `for`/`if` bodies; an `if`
+    whose condition mentions `priority` marks its writes optional."""
+    fields: List[str] = []
+    stack: List[dict] = [{"kind": "top", "out": fields}]
+    pending: Optional[dict] = None  # a for/if awaiting its body
+
+    def emit(tok: str):
+        top = stack[-1]
+        if top["kind"] == "if-opt":
+            tok += "?"
+        top["out"].append(tok)
+
+    for m in _CPP_EVENT.finditer(body):
+        if m.group("forkw") is not None:
+            pending = {"kind": "for", "out": []}
+        elif m.group("ifkw") is not None:
+            cond_end = body.index(")", m.end())
+            optional = "priority" in body[m.end(): cond_end]
+            pending = {"kind": "if-opt" if optional else "if", "out": None}
+        elif m.group("open") is not None:
+            if pending is not None:
+                blk = pending
+                pending = None
+                if blk["kind"] == "for":
+                    stack.append({"kind": "for", "out": blk["out"], "braced": True})
+                else:
+                    stack.append({"kind": blk["kind"], "out": stack[-1]["out"], "braced": True})
+            else:
+                stack.append({"kind": "plain", "out": stack[-1]["out"], "braced": True})
+        elif m.group("close") is not None:
+            if len(stack) > 1:
+                top = stack.pop()
+                if top["kind"] == "for":
+                    _flush_loop(stack[-1], top["out"])
+        else:
+            prim = m.group("prim")
+            if pending is not None:
+                # Single-statement body: this write IS the for/if body.
+                blk = pending
+                pending = None
+                if blk["kind"] == "for":
+                    _flush_loop(stack[-1], [prim])
+                elif blk["kind"] == "if-opt":
+                    stack[-1]["out"].append(prim + "?")
+                else:
+                    stack[-1]["out"].append(prim)
+            elif stack[-1]["kind"] == "for":
+                stack[-1]["out"].append(prim)
+            else:
+                emit(prim)
+    return fields
+
+
+def _flush_loop(parent: dict, loop_fields: List[str]):
+    if not loop_fields:
+        return
+    tok = (
+        f"{loop_fields[0]}*"
+        if len(loop_fields) == 1
+        else "{" + ",".join(loop_fields) + "}*"
+    )
+    if parent["kind"] == "if-opt":
+        tok += "?"
+    parent["out"].append(tok)
+
+
+# ---------------------------------------------------------------------------
+# Python side (ast).
+# ---------------------------------------------------------------------------
+
+class WireIR:
+    def __init__(self):
+        self.constants: Dict[str, int] = {}
+        self.const_lines: Dict[str, int] = {}
+        self.headers: Dict[str, List[str]] = {}  # name -> primitive list
+        self.header_lines: Dict[str, int] = {}
+        self.structs: Dict[str, List[str]] = {}
+        self.struct_lines: Dict[str, int] = {}
+
+
+_PY_HEADER_NAMES = {"_REQ_HEADER": "ReqHeader", "_RESP_HEADER": "RespHeader"}
+
+
+def _eval_const(node: ast.expr, env: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.LShift, ast.BitOr, ast.Add)):
+        left, right = _eval_const(node.left, env), _eval_const(node.right, env)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.BitOr):
+            return left | right
+        return left + right
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id == "ord"
+        and len(node.args) == 1
+        and isinstance(node.args[0], ast.Constant)
+    ):
+        return ord(node.args[0].value)
+    return None
+
+
+def _fmt_to_prims(fmt: str) -> List[str]:
+    return [_FMT_PRIMS[c] for c in fmt if c in _FMT_PRIMS]
+
+
+def parse_wire(ctx: Context, rel: str = WIRE_REL) -> WireIR:
+    tree = ast.parse(ctx.read(rel))
+    ir = WireIR()
+    env: Dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            # struct.Struct("<...>") fixed headers
+            if (
+                isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Attribute)
+                and node.value.func.attr == "Struct"
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Constant)
+            ):
+                canonical = _PY_HEADER_NAMES.get(name, name)
+                ir.headers[canonical] = _fmt_to_prims(node.value.args[0].value)
+                ir.header_lines[canonical] = node.lineno
+                continue
+            val = _eval_const(node.value, env)
+            if val is not None:
+                env[name] = val
+                if re.fullmatch(r"[A-Z][A-Z0-9_]*", name):
+                    ir.constants[name] = val
+                    ir.const_lines[name] = node.lineno
+        elif isinstance(node, ast.ClassDef):
+            enc = next(
+                (b for b in node.body if isinstance(b, ast.FunctionDef) and b.name == "encode"),
+                None,
+            )
+            if enc is not None:
+                ir.structs[node.name] = _scan_py_encode(enc)
+                ir.struct_lines[node.name] = node.lineno
+    return ir
+
+
+def _pack_fmt(call: ast.Call) -> Optional[str]:
+    """Format string of a struct.pack(...) / self._STRUCT.pack(...) call."""
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == "pack"):
+        return None
+    if call.args and isinstance(call.args[0], ast.Constant) and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _expr_prims(node: ast.expr, optional: bool = False) -> List[str]:
+    """Left-to-right primitive extraction from one expression tree."""
+    out: List[str] = []
+    suffix = "?" if optional else ""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _expr_prims(node.left, optional) + _expr_prims(node.right, optional)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        for elt in node.elts:
+            out += _expr_prims(elt, optional)
+        return out
+    if isinstance(node, ast.Call):
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        fmt = _pack_fmt(node)
+        if fmt is not None:
+            return [p + suffix for p in _fmt_to_prims(fmt)]
+        if fname == "encode_str":
+            return ["str" + suffix]
+        if fname in ("encode_str_list", "encode_keys_blob"):
+            return ["str_list" + suffix]
+        if fname == "join":
+            return []
+        for a in node.args:
+            out += _expr_prims(a, optional)
+        return out
+    if isinstance(node, (ast.GeneratorExp, ast.ListComp)):
+        inner = _expr_prims(node.elt)
+        if inner:
+            tok = f"{inner[0]}*" if len(inner) == 1 else "{" + ",".join(inner) + "}*"
+            return [tok + suffix]
+        return []
+    return out
+
+
+def _scan_py_encode(fn: ast.FunctionDef) -> List[str]:
+    fields: List[str] = []
+
+    def scan_stmt(stmt: ast.stmt, optional: bool):
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            fields.extend(_expr_prims(stmt.value, optional))
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            # out.append(expr) / out.extend(gen)
+            if isinstance(call.func, ast.Attribute) and call.func.attr in ("append", "extend"):
+                for a in call.args:
+                    fields.extend(_expr_prims(a, optional))
+            else:
+                fields.extend(_expr_prims(call, optional))
+        elif isinstance(stmt, ast.For):
+            inner: List[str] = []
+            for s in stmt.body:
+                inner.extend(_collect_expr_prims(s))
+            if inner:
+                tok = f"{inner[0]}*" if len(inner) == 1 else "{" + ",".join(inner) + "}*"
+                fields.append(tok + ("?" if optional else ""))
+        elif isinstance(stmt, ast.If):
+            cond_src = ast.dump(stmt.test)
+            opt = "priority" in cond_src
+            for s in stmt.body:
+                scan_stmt(s, optional or opt)
+        elif isinstance(stmt, ast.Return) and stmt.value is not None:
+            fields.extend(_expr_prims(stmt.value, optional))
+
+    def _collect_expr_prims(stmt: ast.stmt) -> List[str]:
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            return _expr_prims(stmt.value)
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute) and call.func.attr in ("append", "extend"):
+                out: List[str] = []
+                for a in call.args:
+                    out += _expr_prims(a)
+                return out
+            return _expr_prims(call)
+        return []
+
+    for stmt in fn.body:
+        scan_stmt(stmt, False)
+    return fields
+
+
+# ---------------------------------------------------------------------------
+# Diff.
+# ---------------------------------------------------------------------------
+
+def compare(ctx: Context, header_rel: str = HEADER_REL, wire_rel: str = WIRE_REL) -> List[Finding]:
+    cpp = parse_header(ctx, header_rel)
+    py = parse_wire(ctx, wire_rel)
+    findings: List[Finding] = []
+
+    def f(rule: str, file: str, line: int, slug: str, msg: str):
+        findings.append(Finding(rule=rule, file=file, line=line, message=msg,
+                                key=f"{rule}:{file}:{slug}"))
+
+    # Constants: every canonical C++ constant must exist in Python, equal.
+    for name, val in sorted(cpp.constants.items()):
+        if name not in py.constants:
+            f("ITS-W001", wire_rel, 1, name,
+              f"constant {name} (= {val}) defined in {header_rel} is missing "
+              f"from the Python mirror")
+        elif py.constants[name] != val:
+            f("ITS-W001", wire_rel, py.const_lines.get(name, 1), name,
+              f"constant {name} drifted: C++ {val} vs Python {py.constants[name]}")
+    # Python extras in the wire namespaces must alias a same-prefix C++ value.
+    cpp_by_prefix: Dict[str, set] = {}
+    for name, val in cpp.constants.items():
+        for prefix in ("OP_", "STATUS_", "PRIORITY_"):
+            if name.startswith(prefix):
+                cpp_by_prefix.setdefault(prefix, set()).add(val)
+    for name, val in sorted(py.constants.items()):
+        for prefix in ("OP_", "STATUS_", "PRIORITY_"):
+            if name.startswith(prefix) and name not in cpp.constants:
+                if val not in cpp_by_prefix.get(prefix, set()):
+                    f("ITS-W001", wire_rel, py.const_lines.get(name, 1), name,
+                      f"Python constant {name} (= {val}) has no counterpart "
+                      f"value in {header_rel}")
+
+    # Fixed headers: field widths in order + size cross-check.
+    for name, fields in sorted(cpp.headers.items()):
+        widths = [w for _, w in fields]
+        cpp_seq = [{1: "u8", 2: "u16", 4: "u32", 8: "u64"}[w] for w in widths]
+        expect = cpp.header_asserts.get(name)
+        if expect is not None and sum(widths) != expect:
+            f("ITS-W004", header_rel, cpp.struct_lines.get(name, 1), name,
+              f"{name} fields sum to {sum(widths)} bytes but its "
+              f"static_assert pins {expect}")
+        if name not in py.headers:
+            f("ITS-W004", wire_rel, 1, name,
+              f"packed header {name} has no struct format in the Python mirror")
+            continue
+        if py.headers[name] != cpp_seq:
+            f("ITS-W004", wire_rel, py.header_lines.get(name, 1), name,
+              f"{name} layout drifted: C++ {cpp_seq} vs Python {py.headers[name]}")
+    for name in sorted(set(py.headers) - set(cpp.headers)):
+        f("ITS-W004", wire_rel, py.header_lines.get(name, 1), name,
+          f"Python struct format {name} has no packed header in "
+          f"{header_rel} — a fixed frame only one side understands")
+
+    # Struct bodies: sequences must match for every struct defined in C++.
+    for name, seq in sorted(cpp.structs.items()):
+        if name not in py.structs:
+            f("ITS-W003", wire_rel, 1, name,
+              f"struct {name} (encoded in {header_rel}) has no Python mirror")
+            continue
+        if py.structs[name] != seq:
+            f("ITS-W002", wire_rel, py.struct_lines.get(name, 1), name,
+              f"struct {name} field sequence drifted: C++ {seq} vs "
+              f"Python {py.structs[name]}")
+    for name in sorted(set(py.structs) - set(cpp.structs) - PY_ONLY_STRUCTS):
+        f("ITS-W003", wire_rel, py.struct_lines.get(name, 1), name,
+          f"Python struct {name} encodes wire bytes but has no native "
+          f"counterpart in {header_rel} — mirror it there, or register it "
+          "in wire_drift.PY_ONLY_STRUCTS if it is deliberately "
+          "client-side framing")
+    return findings
+
+
+@register("wire_drift",
+          "protocol.h and wire.py must describe the same wire format (ITS-W*)",
+          rule_prefix="ITS-W")
+def check(ctx: Context) -> List[Finding]:
+    return compare(ctx)

@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""Emit a compile_commands.json for the native tree.
+
+clang-tidy (and clangd) need a compilation database; meson/cmake generate
+one for free but our native build is a plain Makefile, and `bear` is not
+in the toolchain. The Makefile invokes this script with ITS OWN $(CXX) /
+$(CXXFLAGS), so the database can never drift from the real build line:
+
+    make -C native compile_commands.json
+
+Usage: gen_compile_commands.py --cxx g++ --flags "-O3 ..." --dir DIR \
+           --out compile_commands.json src/a.cpp src/b.cpp ...
+"""
+
+import argparse
+import json
+import os
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cxx", required=True)
+    parser.add_argument("--flags", required=True)
+    parser.add_argument("--dir", default=os.getcwd())
+    parser.add_argument("--out", required=True)
+    parser.add_argument("sources", nargs="+")
+    args = parser.parse_args()
+
+    directory = os.path.abspath(args.dir)
+    db = [
+        {
+            "directory": directory,
+            "command": f"{args.cxx} {args.flags} -c {src} -o {os.path.splitext(src)[0]}.o",
+            "file": src,
+        }
+        for src in args.sources
+    ]
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(db, f, indent=2)
+        f.write("\n")
+    print(f"wrote {args.out} ({len(db)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
