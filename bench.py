@@ -1280,6 +1280,212 @@ def _cluster_chaos_metrics(its, np) -> dict:
             s.stop()
 
 
+def _membership_churn_metrics(its, np) -> dict:
+    """Elastic membership under churn (the bench leg ISSUE 6 adds): a
+    3-member pool with R=2 replication takes a live JOIN and a member
+    DEATH mid-workload while reads keep flowing (docs/membership.md).
+
+    Sequence: save N roots -> baseline sweep -> add a 4th member (reads
+    run MID-reshard: epoch-aware failover serves unmigrated roots from
+    the old owner) -> drain -> kill one original member's server, take a
+    breaker-failover sweep, mark it dead -> reads run mid-re-replication
+    -> drain -> final sweep.
+
+    Figures of merit:
+    - ``churn_availability`` / ``churn_wrong_reads``: every read across
+      every sweep must return CORRECT bytes or a typed miss — gated at
+      1.0 / 0 (tools/bench_check.py). ``churn_misses`` reported as color
+      (with R=2 + failover it should be 0 too).
+    - ``churn_join_moved_fraction``: roots the join's reshard actually
+      moved / total roots — the rendezvous-delta property. Gated against
+      ``churn_join_delta_fraction`` (the exact delta: roots whose top-R
+      rendezvous set gained the joiner, computed independently here) —
+      a full reshuffle (~1.0) or naive-mod remap fails; the analytic
+      expectation is R/(N+1) (= 0.5 at N=3, R=2), reported as
+      ``churn_join_expected_fraction``.
+    - ``churn_migration_debt``: the resharder's remaining debt after the
+      workload (bounded migration debt — gated at 0).
+    - ``churn_epoch`` / ``churn_reshard_replans`` / ``churn_moved_keys``
+      / ``churn_bg_moved_bytes``: mechanism counters (migration traffic
+      is BACKGROUND-tagged end to end, so the QoS leg's foreground p99
+      gate holds with a reshard in flight).
+    """
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.cluster import (
+        CircuitBreaker, ClusterKVConnector, rendezvous_ranked,
+    )
+    from infinistore_tpu.tpu import PagedKVCacheSpec, gather_blocks
+
+    spec = PagedKVCacheSpec(
+        num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+        head_dim=32, dtype=jnp.bfloat16,
+    )
+
+    def connect(port):
+        conn = its.InfinityConnection(
+            its.ClientConfig(
+                host_addr="127.0.0.1", service_port=port,
+                log_level="error", auto_reconnect=True,
+                connect_timeout_ms=500, op_timeout_ms=2000,
+            )
+        )
+        conn.connect()
+        return conn
+
+    servers, conns = [], []
+    cluster = None
+    try:
+        for _ in range(3):
+            srv = its.start_local_server(
+                prealloc_bytes=64 << 20, block_bytes=16 << 10
+            )
+            servers.append(srv)
+            conns.append(connect(srv.port))
+        cluster = ClusterKVConnector(
+            conns, spec, "churn-bench", max_blocks=8, degrade=True,
+            replicas=2,
+            breaker_factory=lambda i: CircuitBreaker(
+                fail_threshold=2, probe_backoff_s=0.05, max_backoff_s=0.4,
+                seed=i,
+            ),
+        )
+        rng = np.random.default_rng(23)
+        n_roots = 36
+        prompts = [
+            rng.integers(0, 1000, size=2 * spec.block_tokens).tolist()
+            for _ in range(n_roots)
+        ]
+
+        def mk_caches(seed):
+            out = []
+            for layer in range(spec.num_layers):
+                k = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + layer), spec.cache_shape,
+                    jnp.float32,
+                ).astype(spec.dtype)
+                v = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + 50 + layer),
+                    spec.cache_shape, jnp.float32,
+                ).astype(spec.dtype)
+                out.append((k, v))
+            return out
+
+        contents = {i: mk_caches(i) for i in range(n_roots)}
+        src = np.array([3, 9], np.int32)
+        for i, p in enumerate(prompts):
+            asyncio.run(cluster.save(p, contents[i], src))
+
+        reads = wrong = misses = 0
+
+        def sweep():
+            nonlocal reads, wrong, misses
+            for i, p in enumerate(prompts):
+                reads += 1
+                dst = np.array([6, 2], np.int32)
+                loaded, n = asyncio.run(
+                    cluster.load(p, spec.make_caches(), dst)
+                )
+                if n == 0:
+                    misses += 1  # typed miss: legal, but counted as color
+                    continue
+                wrong += any(
+                    not np.array_equal(
+                        np.asarray(
+                            gather_blocks(loaded[layer][kind], jnp.asarray(dst)),
+                            np.float32,
+                        ),
+                        np.asarray(
+                            gather_blocks(
+                                contents[i][layer][kind], jnp.asarray(src)
+                            ),
+                            np.float32,
+                        ),
+                    )
+                    for layer in range(spec.num_layers)
+                    for kind in (0, 1)
+                )
+
+        sweep()  # baseline: settled 3-member pool
+
+        # --- live JOIN mid-workload ----------------------------------------
+        old_place = list(cluster.membership.view().placement_ids())
+        moved_before = cluster.resharder.progress()["reshard_moved_roots"]
+        srv4 = its.start_local_server(
+            prealloc_bytes=64 << 20, block_bytes=16 << 10
+        )
+        servers.append(srv4)
+        conn4 = connect(srv4.port)
+        conns.append(conn4)
+        joiner_id = f"127.0.0.1:{srv4.port}"
+        cluster.add_member(conn4, member_id=joiner_id)
+        sweep()  # mid-reshard: epoch-aware failover must hold availability
+        cluster.resharder.wait_idle(timeout=30.0)
+        sweep()  # settled 4-member pool: joiner serves its share
+        moved_join = (
+            cluster.resharder.progress()["reshard_moved_roots"] - moved_before
+        )
+        # The exact rendezvous delta, computed independently of the
+        # resharder: roots whose top-R set over the NEW placement contains
+        # the joiner.
+        new_place = old_place + [joiner_id]
+        delta_roots = 0
+        for p in prompts:
+            root_candidates = [
+                new_place[k]
+                for k in rendezvous_ranked(
+                    new_place, cluster._root_of(p)
+                )[: cluster.replicas]
+            ]
+            delta_roots += joiner_id in root_candidates
+
+        # --- member DEATH mid-workload -------------------------------------
+        victim_id = next(
+            mid for mid in cluster.member_ids[:3]
+            if cluster.membership.view().state_of(mid) == "active"
+        )
+        victim = cluster.member_index(victim_id)
+        servers[victim].stop()  # the kill
+        sweep()  # breaker + replica failover carry the outage
+        cluster.mark_dead(victim_id)
+        sweep()  # mid-re-replication
+        cluster.resharder.wait_idle(timeout=30.0)
+        sweep()  # settled 3-member pool again, R=2 restored
+
+        status = cluster.membership_status()
+        return {
+            "churn_reads": reads,
+            "churn_wrong_reads": wrong,
+            "churn_misses": misses,
+            "churn_availability": (reads - wrong) / reads if reads else 0.0,
+            "churn_roots": n_roots,
+            "churn_join_moved_roots": moved_join,
+            "churn_join_moved_fraction": moved_join / n_roots,
+            "churn_join_delta_fraction": delta_roots / n_roots,
+            "churn_join_expected_fraction": cluster.replicas / len(new_place),
+            "churn_migration_debt": status["reshard_debt_roots"],
+            "churn_epoch": status["membership_epoch"],
+            "churn_reshard_replans": status["reshard_replans"],
+            "churn_moved_keys": status["reshard_moved_keys"],
+            "churn_bg_moved_bytes": status["reshard_moved_bytes"],
+            "churn_pruned_keys": status["reshard_pruned_keys"],
+            "churn_lost_roots": status["reshard_lost_roots"],
+        }
+    finally:
+        if cluster is not None:
+            cluster.close()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for s in servers:
+            s.stop()
+
+
 def _run_check(files) -> int:
     """`bench.py --check RECEIPT.json [...]`: run the data-plane regression
     gate (tools/bench_check.py) over existing receipts instead of measuring.
@@ -1339,6 +1545,7 @@ def main(argv=None) -> int:
     qos = _qos_isolation_us(its, np)
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
+    churn = _membership_churn_metrics(its, np)
     try:
         tpu = _tpu_connector_gbps(its, np, conn)
         import jax
@@ -1483,6 +1690,33 @@ def main(argv=None) -> int:
         "chaos_fast_fails": chaos["chaos_fast_fails"],
         "chaos_degraded_ops": chaos["chaos_degraded_ops"],
         "chaos_breaker_recovery_ms": round(chaos["chaos_breaker_recovery_ms"], 1),
+        # Elastic membership under churn (docs/membership.md): a live JOIN
+        # and a member DEATH mid-workload. Gated in tools/bench_check.py:
+        # availability 1.0 / 0 wrong reads across every sweep (epoch-aware
+        # read failover carries the mid-reshard window), the join's
+        # migration moves only the rendezvous-delta root set (measured vs
+        # the independently computed delta fraction; analytic expectation
+        # R/(N+1)), and the resharder ends with zero migration debt. The
+        # migration traffic itself is BACKGROUND-tagged, so the QoS leg's
+        # foreground-p99 gate holds with a reshard in flight.
+        "churn_reads": churn["churn_reads"],
+        "churn_wrong_reads": churn["churn_wrong_reads"],
+        "churn_misses": churn["churn_misses"],
+        "churn_availability": round(churn["churn_availability"], 4),
+        "churn_roots": churn["churn_roots"],
+        "churn_join_moved_roots": churn["churn_join_moved_roots"],
+        "churn_join_moved_fraction": round(churn["churn_join_moved_fraction"], 4),
+        "churn_join_delta_fraction": round(churn["churn_join_delta_fraction"], 4),
+        "churn_join_expected_fraction": round(
+            churn["churn_join_expected_fraction"], 4
+        ),
+        "churn_migration_debt": churn["churn_migration_debt"],
+        "churn_epoch": churn["churn_epoch"],
+        "churn_reshard_replans": churn["churn_reshard_replans"],
+        "churn_moved_keys": churn["churn_moved_keys"],
+        "churn_bg_moved_bytes": churn["churn_bg_moved_bytes"],
+        "churn_pruned_keys": churn["churn_pruned_keys"],
+        "churn_lost_roots": churn["churn_lost_roots"],
         "tpu_backend": backend,
     }
     if tpu is not None:

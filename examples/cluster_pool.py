@@ -5,8 +5,15 @@ leaves multi-node routing to LMCache; here the framework provides it).
 Three local servers become one ClusterKVConnector. Prompts route by the hash
 of their FIRST token block (rendezvous hashing), so every prompt sharing a
 system prefix lands on the same server and per-server longest-prefix match
-keeps working. Stopping one server shows the degrade policy: its prompts
-become cache misses (recompute), everyone else's keep hitting.
+keeps working.
+
+The pool is ELASTIC (docs/membership.md): a fourth server JOINs live — the
+membership epoch bumps and the background resharder migrates only the
+rendezvous-delta roots to it (~R/(N+1), BACKGROUND-tagged) — then one
+member LEAVEs gracefully: its roots re-mirror to their promoted successors
+before it is REMOVED, so stopping the node afterwards costs nothing.
+Finally a member is killed WITHOUT ceremony to show the degrade policy and
+per-member health attribution (docs/robustness.md).
 """
 
 import asyncio
@@ -65,8 +72,48 @@ def main():
         hits = sum(cluster.lookup(p) for p in prompts)
         print(f"blocks cached across the pool: {hits}")
 
-        # Drain one member: only its prompts degrade to misses.
-        victim = owners[0]
+        # --- live JOIN: the pool grows without a restart ------------------
+        srv4 = its.start_local_server(prealloc_bytes=64 << 20,
+                                      block_bytes=16 << 10)
+        conn4 = its.InfinityConnection(
+            its.ClientConfig(host_addr="127.0.0.1", service_port=srv4.port,
+                             log_level="error")
+        )
+        conn4.connect()
+        servers.append(srv4)
+        conns.append(conn4)
+        view = cluster.add_member(conn4, wait=True)
+        ms = cluster.membership_status()
+        print(
+            f"joined member 3: epoch={view.epoch} -> "
+            f"{ms['membership_epoch']} (finalized), moved "
+            f"{ms['reshard_moved_roots']} roots / "
+            f"{ms['reshard_moved_keys']} keys "
+            f"({ms['reshard_moved_bytes']} bytes, BACKGROUND), "
+            f"pruned {ms['reshard_pruned_keys']} old copies, "
+            f"debt={ms['reshard_debt_roots']}"
+        )
+        owners = [cluster.owner_index(p) for p in prompts]
+        print("owner per prompt after join:", owners)
+
+        # --- graceful LEAVE: re-mirror first, then stop the node ----------
+        leaver = cluster.member_ids[1]
+        cluster.remove_member(leaver, wait=True)
+        ms = cluster.membership_status()
+        print(
+            f"drained {leaver}: epoch={ms['membership_epoch']}, "
+            f"re-mirrored (lifetime moved={ms['reshard_moved_roots']} "
+            f"roots), debt={ms['reshard_debt_roots']} -> node may stop"
+        )
+        servers[1].stop()  # free: every root already has R copies elsewhere
+        hits = sum(cluster.lookup(p) for p in prompts)
+        print(f"blocks cached after leave: {hits} (no loss)")
+
+        # --- crash: kill one member WITHOUT ceremony ----------------------
+        # Only its prompts degrade to misses (replicas=1 here; with
+        # replicas=2 reads would fail over — tests/test_selfheal.py).
+        owners = [cluster.owner_index(p) for p in prompts]
+        victim = owners[0]  # owners come from the live placement
         servers[victim].stop()
         after = [cluster.lookup(p) for p in prompts]
         lost = sum(1 for o, h in zip(owners, after) if o == victim and h == 0)
@@ -79,15 +126,26 @@ def main():
         # The self-healing layer's attribution (docs/robustness.md): the
         # dead member's breaker opens after a few errors (later ops
         # fast-fail locally instead of burning timeouts), and health()
-        # names the sick node. With replicas=2 the same drain would cost
-        # NOTHING: saves mirror to the rendezvous runner-up and reads fail
-        # over to it (see tests/test_selfheal.py).
-        for m in cluster.health()["members"]:
+        # names the sick node — now alongside its membership state. With
+        # replicas=2 the same death would cost NOTHING: reads fail over to
+        # the mirror, and mark_dead() re-replicates in the background.
+        health = cluster.health()
+        for m in health["members"]:
             print(
-                f"  {m['member_id']}: breaker={m['breaker_state']} "
-                f"errors={m['errors']} fast_fails={m['fast_fails']} "
+                f"  {m['member_id']}: state={m['state']} "
+                f"breaker={m['breaker_state']} errors={m['errors']} "
+                f"fast_fails={m['fast_fails']} "
                 f"degraded_ops={m['degraded_ops']}"
             )
+        # Write the crashed member off: with replicas=1 its roots are
+        # unrecoverable, and the resharder says so honestly.
+        cluster.mark_dead(cluster.member_ids[victim], wait=True)
+        ms = cluster.membership_status()
+        print(
+            f"marked dead: epoch={ms['membership_epoch']}, written-off "
+            f"roots={ms['reshard_lost_roots']} (replicas=1; with "
+            f"replicas=2 they would re-replicate instead)"
+        )
     finally:
         for c in conns:
             try:

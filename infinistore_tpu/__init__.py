@@ -68,6 +68,10 @@ def __getattr__(name):
         from . import cluster
 
         return getattr(cluster, name)
+    if name in ("MemberState", "MembershipView", "Membership", "Resharder"):
+        from . import membership
+
+        return getattr(membership, name)
     if name in ("FaultRule", "FaultyConnection", "kill_transport"):
         from . import faults
 
@@ -90,6 +94,10 @@ __all__ = [
     "rendezvous_owner",
     "rendezvous_ranked",
     "CircuitBreaker",
+    "MemberState",
+    "MembershipView",
+    "Membership",
+    "Resharder",
     "FaultRule",
     "FaultyConnection",
     "kill_transport",

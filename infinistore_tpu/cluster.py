@@ -40,14 +40,27 @@ converts an unserved op into a cache miss (lookup 0 / load 0 / save
 skipped), counted in the aggregate ``degraded_ops`` AND per-member in
 ``stats()``/``health()`` so an operator can tell WHICH node is sick: on an
 engine, a dead cache node should cost recompute, not availability.
+
+Membership is **elastic** (docs/membership.md): the member list is a
+versioned :class:`~.membership.Membership` view, and
+:meth:`ClusterKVConnector.add_member` / :meth:`remove_member` /
+:meth:`mark_dead` change it at runtime. Every op routes through the
+CURRENT view; while a transition's background reshard
+(:class:`~.membership.Resharder`) is still moving the rendezvous-delta
+keys, reads are **epoch-aware**: they try the new owner first and fall
+back to the old owner / surviving replica on a miss, so availability
+stays 1.0 mid-reshard. The cluster keeps a root **catalog** (which
+members hold which root's keys) that the resharder reconciles against the
+view's rendezvous placement.
 """
 
 import asyncio
 import hashlib
 import random
+import threading
 import time
-from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -58,6 +71,7 @@ from .lib import (
     InfiniStoreNoMatch,
     InfiniStoreResourcePressure,
 )
+from .membership import MemberState, Membership, Resharder, _RootTask
 from .tpu.layerwise import PartialReadError
 from .tpu.paged import PagedKVCacheSpec
 
@@ -122,8 +136,9 @@ class CircuitBreaker:
     burns a full transport timeout; with one, a dead member costs one
     fast-failed op per probe window. ``clock`` is injectable (tests drive
     the state machine with a fake clock; defaults to ``time.monotonic``).
-    Not thread-safe by itself — callers serialize (the cluster drives it
-    from its own call sites, which share the caller's loop/thread).
+    Not thread-safe by itself — callers serialize (the cluster guards every
+    breaker touch with its ``_breaker_lock``, since the resharder's worker
+    thread feeds the same breakers as the caller's loop).
     """
 
     CLOSED = "closed"
@@ -248,9 +263,29 @@ class _MemberHealth:
         }
 
 
+@dataclass
+class _RootRecord:
+    """Catalog entry for one prefix tree (chain root): what was saved and
+    which members are believed to hold it — the client-side metadata the
+    resharder reconciles against the view's rendezvous placement.
+
+    ``holders`` maps member id -> contiguous complete blocks held FROM
+    BLOCK 0 (the level). Levels matter: a ``first_block>0`` extension save
+    only raises the level of members that already held the base (a member
+    receiving just the tail has a hole and keeps its old level), so the
+    resharder can never mistake a partial copy for a complete one and
+    prune the only member holding the base blocks."""
+
+    tokens: np.ndarray  # full-block token ids (int64; longest prefix seen)
+    blocks: int  # highest holder level (complete blocks saved under root)
+    holders: Dict[str, int] = field(default_factory=dict)
+
+
 class ClusterKVConnector:
     """``KVConnector`` surface over N servers with prefix-affine routing,
-    per-member circuit breakers, and optional R-way rendezvous replication.
+    per-member circuit breakers, optional R-way rendezvous replication,
+    and ELASTIC membership (live add/remove with online resharding —
+    docs/membership.md).
 
     Duck-type compatible with what ``EngineKVAdapter`` needs (``spec``,
     ``lookup``/``load``/``save``/``drop``), so the continuous-batching
@@ -258,11 +293,26 @@ class ClusterKVConnector:
     ``KVConnector`` (staging pool registered on that member's connection);
     ``handoff`` stays a per-member concern — it is mesh topology, not key
     routing.
+
+    Membership surface: :meth:`add_member` / :meth:`remove_member` /
+    :meth:`mark_dead` mutate the versioned view (``self.membership``);
+    ``self.resharder`` migrates the rendezvous-delta keys in the
+    background; :meth:`membership_status` is the flat counter snapshot the
+    manage plane serves. Member entry indices are stable forever
+    (tombstones), so ``members`` / ``member_ids`` / per-member health stay
+    index-aligned across churn.
     """
 
     # Accepts the two-class priority kwarg on start_fetch (adapters gate
     # forwarding on this attribute — docs/qos.md).
     QOS_AWARE = True
+
+    # Root-catalog bound: the oldest record is dropped past this (a record
+    # is failover/migration *knowledge*, not data — an evicted record's
+    # root still reads fine via placement ranking; the resharder just
+    # cannot re-mirror it, same as a root another client wrote). Keeps a
+    # long-lived engine's client memory and reconcile-pass cost bounded.
+    CATALOG_MAX_ROOTS = 65536
 
     def __init__(
         self,
@@ -328,23 +378,376 @@ class ClusterKVConnector:
         # FOREGROUND, saves (and their replica mirrors) and drops are
         # BACKGROUND by construction. Surfaced in health().
         self._qos = {"fg_ops": 0, "bg_ops": 0, "mirror_writes": 0}
+        # Elastic membership (docs/membership.md): the versioned view every
+        # op routes through, the background delta-resharder, and the root
+        # catalog it reconciles (root -> tokens/blocks/holders).
+        self._member_factory = member_factory
+        self._breaker_factory = breaker_factory
+        self.membership = Membership(self.member_ids)
+        self.resharder = Resharder(self)
+        self._catalog: Dict[str, _RootRecord] = {}
+        self._cat_lock = threading.Lock()
+        # Serializes membership transitions (add/remove/mark_dead): the
+        # member-array append + view publish must be atomic against OTHER
+        # transitions (a rejected add's rollback must never delete a
+        # concurrently admitted member's entries). Ops never take this.
+        self._admin_lock = threading.Lock()
+        # Serializes breaker admission/outcome across threads: CircuitBreaker
+        # itself is not thread-safe, and with the resharder worker feeding
+        # the same breakers as the caller's loop, an unserialized allow()
+        # race could admit TWO half-open probes (two concurrent reconnects
+        # on one native connection). Held only for the O(1) state update —
+        # never across a heal/reconnect.
+        self._breaker_lock = threading.Lock()
 
     # -- routing -------------------------------------------------------------
 
+    def _root_of(self, token_ids) -> Optional[str]:
+        """This prompt's chain root (None when it has no complete block)."""
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        return chains[0] if chains else None
+
+    def _ranked_ids(self, ids: Sequence[str], root: str) -> List[str]:
+        """``ids`` in HRW rank order for ``root`` (empty for empty ids)."""
+        if not ids:
+            return []
+        return [ids[i] for i in rendezvous_ranked(ids, root)]
+
+    def member_index(self, member_id: str) -> int:
+        """Stable entry index of ``member_id`` (KeyError when unknown)."""
+        return self.membership.index_of(member_id)
+
     def owner_index(self, token_ids: Sequence[int]) -> Optional[int]:
-        """Which member owns this prompt's prefix tree (None when the prompt
-        has no complete block — nothing to route)."""
-        chain = self.replica_indices(token_ids)
-        return chain[0] if chain else None
+        """Which member owns this prompt's prefix tree under the CURRENT
+        view's placement (None when the prompt has no complete block)."""
+        root = self._root_of(token_ids)
+        if root is None:
+            return None
+        place = self.membership.view().placement_ids()
+        ranked = self._ranked_ids(place, root)
+        return self.member_index(ranked[0]) if ranked else None
+
+    def write_indices(self, token_ids) -> List[int]:
+        """The ``replicas`` member indices NEW writes target, HRW rank
+        order over the current view's placement (JOINING + ACTIVE) —
+        ``[owner, successor, ...]``; empty when the prompt has no complete
+        block."""
+        root = self._root_of(token_ids)
+        if root is None:
+            return []
+        place = self.membership.view().placement_ids()
+        return [
+            self.member_index(m)
+            for m in self._ranked_ids(place, root)[: self.replicas]
+        ]
 
     def replica_indices(self, token_ids) -> List[int]:
-        """The ``replicas`` member indices responsible for this prompt, HRW
-        rank order: ``[owner, successor, ...]`` (empty when the prompt has
-        no complete block)."""
-        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
-        if not chains:
+        """The member indices a READ may be served from, in try order:
+        the current placement's ``[owner, successor, ...]`` first, then —
+        while a reshard is in flight — the epoch-aware fallbacks (the
+        root's known holders, or the previous placement's owners), so a
+        read mid-migration finds the copy wherever it still lives
+        (docs/membership.md). With settled membership this is exactly the
+        placement ranking (the pre-elastic behavior)."""
+        root = self._root_of(token_ids)
+        if root is None:
             return []
-        return rendezvous_ranked(self.member_ids, chains[0])[: self.replicas]
+        return self._read_candidates(root)[0]
+
+    def _read_candidates(self, root: str):
+        """(candidate indices, failover_active) for one root. Failover is
+        active while the membership view has a pending transition or the
+        resharder still carries debt; then reads fall THROUGH misses to
+        the old owner / surviving holders instead of stopping at the new
+        owner's (not-yet-migrated) miss."""
+        view = self.membership.view()
+        place = view.placement_ids()
+        ids = self._ranked_ids(place, root)[: self.replicas]
+        failover = (not self.membership.settled) or self.resharder.active
+        if failover:
+            # Audited: O(1) dict read under a lock whose other holders
+            # (catalog record / resharder callbacks) are O(1) too — the
+            # only O(n_roots) holder is reshard_plan, on the worker thread.
+            with self._cat_lock:  # its: allow[ITS-L003]
+                rec = self._catalog.get(root)
+                holders = set(rec.holders) if rec is not None else None
+            if holders is not None:
+                # Exact knowledge: the catalog says who holds a copy.
+                readable = view.readable_ids()
+                extras = [
+                    m for m in self._ranked_ids(readable, root)
+                    if m in holders and m not in ids
+                ]
+            else:
+                # Root unknown to the catalog (another client's write):
+                # fall back to the previous placement's owners.
+                prev = self.membership.prev_placement or ()
+                readable = set(view.readable_ids())
+                extras = [
+                    m for m in self._ranked_ids(list(prev), root)[: self.replicas]
+                    if m in readable and m not in ids
+                ]
+            ids = ids + extras
+        return [self.member_index(m) for m in ids], failover
+
+    # -- elastic membership ----------------------------------------------------
+
+    def add_member(
+        self, conn, member_id: Optional[str] = None, wait: bool = False,
+        timeout: float = 30.0,
+    ):
+        """Admit a new member at runtime: it JOINs the placement (new
+        writes rendezvous over it immediately) and the resharder copies
+        its ~1/(N+1) rendezvous share of existing roots in the background,
+        after which it finalizes to ACTIVE. ``conn`` is a connected
+        ``InfinityConnection``-shaped object; the member's connector comes
+        from the cluster's ``member_factory``. Returns the new
+        epoch-stamped view. ``wait=True`` blocks until the reshard drains
+        (tests/operators; production callers watch ``/membership``)."""
+        if member_id is None:
+            member_id = f"{conn.config.host_addr}:{conn.config.service_port}"
+        connector = self._member_factory(conn)
+        with self._admin_lock:
+            # A tombstoned id being REUSED must first be scrubbed from
+            # every holder set: the catalog's lazy scrub keys on state,
+            # and the fresh entry's JOINING state would otherwise make the
+            # dead incarnation's stale holder knowledge look live again,
+            # suppressing the re-replication its roots need. Runs off any
+            # event loop (operator thread / manage-plane to_thread).
+            reused = (
+                self.membership.view().state_of(member_id)
+                in MemberState.TERMINAL
+            )
+            if reused:
+                with self._cat_lock:
+                    for rec in self._catalog.values():
+                        rec.holders.pop(member_id, None)
+            # Entry arrays first, then the view transition: a concurrent
+            # reader resolves indices through the view, which appears
+            # last. A rejected transition (duplicate live id) rolls the
+            # arrays back — safe under the admin lock, which keeps any
+            # other transition from appending between the two steps.
+            idx = len(self.members)
+            self.members.append(connector)
+            self.member_ids.append(member_id)
+            self._health.append(
+                _MemberHealth(breaker=self._breaker_factory(idx))
+            )
+            try:
+                view = self.membership.add_member(member_id)
+            except BaseException:
+                del self.members[idx:]
+                del self.member_ids[idx:]
+                del self._health[idx:]
+                raise
+        self.resharder.kick()
+        if wait:
+            self.resharder.wait_idle(timeout)
+        return view
+
+    def remove_member(
+        self, member_id: str, wait: bool = False, timeout: float = 30.0
+    ):
+        """Gracefully drain a member: it leaves placement (no new writes),
+        stays readable while the resharder re-mirrors its roots from the
+        surviving copies to their promoted successors, then finalizes to
+        REMOVED. The caller still owns (and eventually closes) the
+        member's connection. Returns the new view."""
+        with self._admin_lock:
+            view = self.membership.remove_member(member_id)
+        self.resharder.kick()
+        if wait:
+            self.resharder.wait_idle(timeout)
+        return view
+
+    def mark_dead(
+        self, member_id: str, wait: bool = False, timeout: float = 30.0
+    ):
+        """Write a crashed member off: out of placement AND unreadable —
+        its copies are lost, and the resharder re-replicates every root it
+        held from the surviving replica to the promoted successor (the
+        dead id is scrubbed from catalog holders lazily, on the
+        resharder's worker thread — this call stays O(1) so the manage
+        plane may run it on its event loop). Returns the new view."""
+        with self._admin_lock:
+            view = self.membership.mark_dead(member_id)
+        self.resharder.kick()
+        if wait:
+            self.resharder.wait_idle(timeout)
+        return view
+
+    def close(self):
+        """Stop the background resharder (member connections stay the
+        caller's to close)."""
+        self.resharder.stop()
+
+    # -- catalog (the resharder's metadata plane) ------------------------------
+
+    def _catalog_record(
+        self, token_ids, blocks: int, served_ids: List[str],
+        root: Optional[str] = None, first_block: int = 0,
+    ):
+        """Record a successful save: ``served_ids`` took blocks
+        ``[first_block, blocks)`` of this prompt's root (``root`` may be
+        passed by callers that already hashed the chain). A member's
+        holder LEVEL only rises when the write is contiguous with what it
+        already held — a tail landing on a member without the base leaves
+        its level (and a root unknown to the catalog is not recorded from
+        a tail-only save at all). Bounded: past ``CATALOG_MAX_ROOTS`` the
+        oldest record is dropped (insertion order) — losing
+        failover/migration KNOWLEDGE for a cold root, not data (its keys
+        still read via placement ranking, like any root another client
+        wrote)."""
+        if blocks <= first_block or not served_ids:
+            return
+        if root is None:
+            root = self._root_of(token_ids)
+        if root is None:
+            return
+        chains_tokens = np.asarray(
+            token_ids[: blocks * self.spec.block_tokens], dtype=np.int64
+        )
+        # Audited: O(1) dict upsert (the eviction loop pops at most a few
+        # oldest entries); see _read_candidates on this lock's holder
+        # discipline (no O(n) section ever runs on the event loop).
+        with self._cat_lock:  # its: allow[ITS-L003]
+            rec = self._catalog.get(root)
+            if rec is None:
+                if first_block > 0:
+                    return  # tail with no recorded base: nothing provable
+                while len(self._catalog) >= self.CATALOG_MAX_ROOTS:
+                    self._catalog.pop(next(iter(self._catalog)))
+                rec = self._catalog[root] = _RootRecord(
+                    tokens=chains_tokens, blocks=blocks
+                )
+            for mid in served_ids:
+                level = rec.holders.get(mid, 0)
+                if level >= first_block:
+                    rec.holders[mid] = max(level, blocks)
+            top = max(rec.holders.values(), default=0)
+            if top > rec.blocks:
+                rec.tokens = chains_tokens
+                rec.blocks = top
+
+    def catalog_add_holder(
+        self, root: str, member_id: str, blocks: int = 0
+    ) -> bool:
+        """Resharder callback: ``member_id`` now holds ``blocks`` complete
+        blocks of ``root``. Returns False when the record is GONE — the
+        root was dropped (or catalog-evicted) while the copy was in
+        flight; the resharder then undoes the copy, so a concurrent
+        ``drop`` can never resurrect a prompt on the new owner."""
+        with self._cat_lock:
+            rec = self._catalog.get(root)
+            if rec is None:
+                return False
+            rec.holders[member_id] = max(rec.holders.get(member_id, 0), blocks)
+            return True
+
+    def catalog_remove_holder(self, root: str, member_id: str):
+        """Resharder callback: ``member_id``'s copy of ``root`` was pruned."""
+        with self._cat_lock:
+            rec = self._catalog.get(root)
+            if rec is not None:
+                rec.holders.pop(member_id, None)
+
+    def catalog_demote_holder(self, root: str, member_id: str):
+        """Resharder callback: ``member_id``'s copy of ``root`` proved
+        incomplete (keys evicted under a migration read) — drop its level
+        to 0. It stays a read-failover candidate (shorter prefixes still
+        serve) but can no longer act as a migration source or justify a
+        prune; if no complete holder remains the root simply stops being
+        planned, which is the truth."""
+        with self._cat_lock:
+            rec = self._catalog.get(root)
+            if rec is not None and member_id in rec.holders:
+                rec.holders[member_id] = 0
+
+    def reshard_plan(self) -> List[_RootTask]:
+        """The rendezvous delta at the CURRENT epoch: one task per catalog
+        root whose placement copies are incomplete (a joiner missing its
+        share, or a leaver/dead member's roots awaiting their promoted
+        successor) OR whose prune debt is outstanding (a copy rendezvous
+        no longer places, e.g. left over from a pass that aborted between
+        copy and prune — retried until it drains, so a moved root never
+        silently accretes copies). Roots with no readable holder left are
+        written off — reads degrade to a miss (recompute), never wrong
+        bytes. Runs on the resharder's worker thread; terminal members'
+        ids are scrubbed from holder sets here, lazily, so no O(n_roots)
+        sweep ever runs on an event loop."""
+        view = self.membership.view()
+        place = view.placement_ids()
+        if not place:
+            return []
+        readable = view.readable_ids()
+        readable_set = set(readable)
+        tasks: List[_RootTask] = []
+        with self._cat_lock:
+            items = list(self._catalog.items())
+        lost = []
+        for root, rec in items:
+            levels = dict(rec.holders)
+            stale = {
+                m for m in levels
+                if view.state_of(m) in (MemberState.DEAD, MemberState.REMOVED)
+                or view.state_of(m) is None
+            }
+            if stale:
+                # Lazy scrub (mark_dead stays O(1)): a terminal member's
+                # copies are gone with it.
+                with self._cat_lock:
+                    for m in stale:
+                        rec.holders.pop(m, None)
+                for m in stale:
+                    levels.pop(m, None)
+            live = {m: lv for m, lv in levels.items() if m in readable_set}
+            if not live:
+                lost.append(root)
+                continue
+            lvl = max(live.values())
+            if lvl <= 0:
+                continue  # only holey/unknown copies left: nothing provable
+            want = self._ranked_ids(place, root)[: self.replicas]
+            missing = [m for m in want if levels.get(m, 0) < lvl]
+            # Prune is safe only when every wanted member provably holds at
+            # least as much as the copy being deleted; with copy targets in
+            # this task, the resharder enforces that at runtime (prunes run
+            # only after skip-free copies to level ``lvl``).
+            want_floor = min((levels.get(w, 0) for w in want), default=0)
+            prune = [
+                m for m in sorted(set(levels) - set(want))
+                if view.state_of(m) == MemberState.ACTIVE
+                and (missing or want_floor >= levels[m])
+            ]
+            if not missing and not prune:
+                continue
+            sources = [
+                m for m in self._ranked_ids(readable, root)
+                if live.get(m, 0) >= lvl
+            ]
+            tasks.append(_RootTask(
+                root=root, tokens=rec.tokens, blocks=lvl,
+                sources=sources, targets=missing, prune=prune,
+            ))
+        if lost:
+            discarded = 0
+            with self._cat_lock:
+                for root in lost:
+                    rec = self._catalog.pop(root, None)
+                    if rec is not None and set(rec.holders) & readable_set:
+                        # Raced a concurrent holder update: keep it.
+                        self._catalog[root] = rec
+                    elif rec is not None:
+                        discarded += 1
+            self.resharder._c["reshard_lost_roots"] += discarded
+        return tasks
+
+    def membership_status(self) -> dict:
+        """Flat membership + reshard counter snapshot (the ``/membership``
+        manage endpoint and ``/metrics`` membership gauges serve this —
+        keys enumerated in ``Membership.status`` and
+        ``Resharder.progress``)."""
+        return {**self.membership.status(), **self.resharder.progress()}
 
     # -- failure-domain plumbing ---------------------------------------------
 
@@ -359,14 +762,17 @@ class ClusterKVConnector:
         event loop would stall every other request exactly the way the
         breaker exists to prevent."""
         h = self._health[i]
-        if not h.breaker.allow():
-            h.fast_fails += 1
-            return None
-        probe = h.breaker.state == CircuitBreaker.HALF_OPEN
-        if probe:
-            h.probes += 1
-            if heal:
-                self._probe_heal(i)
+        # Audited: O(1) breaker state update; the blocking heal runs
+        # OUTSIDE the lock (see _breaker_lock).
+        with self._breaker_lock:  # its: allow[ITS-L003]
+            if not h.breaker.allow():
+                h.fast_fails += 1
+                return None
+            probe = h.breaker.state == CircuitBreaker.HALF_OPEN
+            if probe:
+                h.probes += 1
+        if probe and heal:
+            self._probe_heal(i)
         return probe
 
     async def _begin_async(self, i: int) -> Optional[bool]:
@@ -401,13 +807,15 @@ class ClusterKVConnector:
         Semantic errors (miss / pressure) count as SUCCESS for liveness —
         the member answered."""
         h = self._health[i]
-        if exc is not None and _is_transport(exc):
-            h.errors += 1
-            h.last_error = repr(exc)
-            h.breaker.record_failure()
-        else:
-            if h.breaker.record_success():
-                h.recoveries += 1
+        # Audited: O(1) breaker state update (see _breaker_lock).
+        with self._breaker_lock:  # its: allow[ITS-L003]
+            if exc is not None and _is_transport(exc):
+                h.errors += 1
+                h.last_error = repr(exc)
+                h.breaker.record_failure()
+            else:
+                if h.breaker.record_success():
+                    h.recoveries += 1
 
     def _degrade(self, candidates: Sequence[int], exc: Optional[BaseException]):
         """The failure policy, in one place, applied when NO replica served
@@ -430,11 +838,22 @@ class ClusterKVConnector:
         if candidates:
             self._health[candidates[0]].degraded_ops += 1
 
-    def _read_failover(self, candidates: Sequence[int], call, miss_value):
+    def _read_failover(
+        self, candidates: Sequence[int], call, miss_value, is_miss=None
+    ):
         """Sync read path: try each replica in HRW order under its breaker;
         first success wins. Only when EVERY candidate is open or errors does
-        the failure policy apply."""
+        the failure policy apply.
+
+        ``is_miss`` (epoch-aware failover, docs/membership.md): when given,
+        a result it classifies as a MISS counts as liveness for the member
+        but the read CONTINUES to the next candidate — mid-reshard the new
+        owner legitimately misses keys that have not migrated yet, and the
+        old owner / surviving holder behind it still serves them. A miss on
+        every candidate returns ``miss_value`` (no degrade: every member
+        answered)."""
         last: Optional[InfiniStoreException] = None
+        answered = False
         for rank, i in enumerate(candidates):
             if self._begin(i) is None:
                 continue
@@ -454,21 +873,34 @@ class ClusterKVConnector:
                 self._done(i, None)
                 raise
             self._done(i, None)
+            if is_miss is not None and is_miss(res):
+                answered = True
+                continue
             if rank:
                 self._health[i].replica_serves += 1
             return res
+        if answered:
+            # Every reachable candidate answered "miss": a legal cache
+            # miss under the contract, not an availability failure.
+            return miss_value
         self._degrade(candidates, last)
         return miss_value
 
     # -- engine surface (KVConnector-shaped) ---------------------------------
 
     def lookup(self, token_ids: Sequence[int]) -> int:
-        candidates = self.replica_indices(token_ids)
+        root = self._root_of(token_ids)
+        if root is None:
+            return 0
+        candidates, failover = self._read_candidates(root)
         if not candidates:
             return 0
         self._qos["fg_ops"] += 1
         return self._read_failover(
-            candidates, lambda m: m.lookup(token_ids), 0
+            candidates, lambda m: m.lookup(token_ids), 0,
+            # Mid-reshard, a 0-hit answer from the new owner falls through
+            # to the old owner / surviving holder.
+            is_miss=(lambda r: r == 0) if failover else None,
         )
 
     def start_fetch(
@@ -476,15 +908,40 @@ class ClusterKVConnector:
     ):
         """Two-phase admission over the pool: route the gate-free fetch to
         the prefix owner (same rendezvous as load), failing over to the
-        replica when the owner is open/erroring. Returns the serving
-        member's prefetch handle, or None when nothing is fetchable / no
-        replica is up under the degrade policy — callers then use the
-        one-phase ``load``. StagingPoolExhausted propagates (backpressure,
-        not failure)."""
-        candidates = self.replica_indices(token_ids)
+        replica when the owner is open/erroring — and, mid-reshard, falling
+        through a 0-hit handle to the old owner / surviving holder (the
+        skipped handle is discarded, staging accounting intact). Returns
+        the serving member's prefetch handle, or None when nothing is
+        fetchable / no replica is up under the degrade policy — callers
+        then use the one-phase ``load``. StagingPoolExhausted propagates
+        (backpressure, not failure)."""
+        root = self._root_of(token_ids)
+        if root is None:
+            return None
+        candidates, failover = self._read_candidates(root)
         if not candidates:
             return None
         self._qos["bg_ops" if priority else "fg_ops"] += 1
+
+        def is_miss(handle) -> bool:
+            if handle is None:
+                return True
+            if getattr(handle, "hit_blocks", 1) > 0:
+                return False
+            discard = getattr(handle, "discard", None)
+            if discard is not None:
+                d = discard()
+                if asyncio.iscoroutine(d):
+                    # LayerwisePrefetch.discard is async; start_fetch runs
+                    # on a live event loop (its documented contract), so
+                    # schedule the cancellation rather than dropping an
+                    # un-awaited coroutine on the floor.
+                    try:
+                        asyncio.get_running_loop().create_task(d)
+                    except RuntimeError:
+                        d.close()  # no loop: nothing was reserved to free
+            return True
+
         return self._read_failover(
             candidates,
             # Forward the tag only to members that advertise the kwarg
@@ -499,17 +956,22 @@ class ClusterKVConnector:
                 ),
             ),
             None,
+            is_miss=is_miss if failover else None,
         )
 
     async def load(
         self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
         on_layer=None,
     ):
-        candidates = self.replica_indices(token_ids)
+        root = self._root_of(token_ids)
+        if root is None:
+            return list(caches), 0
+        candidates, failover = self._read_candidates(root)
         if not candidates:
             return list(caches), 0
         self._qos["fg_ops"] += 1
         last: Optional[InfiniStoreException] = None
+        answered = False
         for rank, i in enumerate(candidates):
             if await self._begin_async(i) is None:
                 continue
@@ -537,9 +999,18 @@ class ClusterKVConnector:
                 self._done(i, None)  # see _read_failover: never wedge a probe
                 raise
             self._done(i, None)
+            if failover and res[1] == 0:
+                # Epoch-aware failover: a 0-block load before any scatter
+                # leaves the caches intact (KVConnector.load returns early
+                # on a 0 hit) — the old owner behind this candidate may
+                # still hold the unmigrated copy.
+                answered = True
+                continue
             if rank:
                 self._health[i].replica_serves += 1
             return res
+        if answered:
+            return list(caches), 0
         self._degrade(candidates, last)
         return list(caches), 0
 
@@ -551,13 +1022,27 @@ class ClusterKVConnector:
         Returns the blocks written to the fullest successful copy. Strict
         mode treats under-replication (any replica skipped or failed) as an
         error AFTER attempting the rest — a mirror outage is visible, not
-        silent; degrade mode counts it and keeps the surviving copy."""
-        candidates = self.replica_indices(token_ids)
+        silent; degrade mode counts it and keeps the surviving copy.
+
+        Writes target the CURRENT view's placement (a JOINING member takes
+        its rendezvous share immediately — no migration debt accrues for
+        new data), and each successful copy is recorded in the root
+        catalog the resharder reconciles (docs/membership.md)."""
+        chains = token_chain_hashes(token_ids, self.spec.block_tokens)
+        if not chains:
+            return 0
+        root = chains[0]
+        place = self.membership.view().placement_ids()
+        candidates = [
+            self.member_index(m)
+            for m in self._ranked_ids(place, root)[: self.replicas]
+        ]
         if not candidates:
             return 0
         self._qos["bg_ops"] += 1
         written = 0
         served = 0
+        served_ids: List[str] = []
         last: Optional[InfiniStoreException] = None
         for i in candidates:
             if await self._begin_async(i) is None:
@@ -575,12 +1060,20 @@ class ClusterKVConnector:
                 raise
             self._done(i, None)
             served += 1
+            served_ids.append(self.member_ids[i])
             if served > 1:
                 # A non-first successful copy is the replication mirror —
                 # BACKGROUND traffic by construction (each member's
                 # KVConnector.save already tags its puts).
                 self._qos["mirror_writes"] += 1
             written = max(written, n)
+        self._catalog_record(
+            token_ids,
+            min(len(chains), first_block + len(block_ids)),
+            served_ids,
+            root=root,
+            first_block=first_block,
+        )
         if served < len(candidates):
             if last is None and served:
                 # Every failure was a local fast-fail, yet a copy WAS
@@ -610,8 +1103,12 @@ class ClusterKVConnector:
         failure policy covers BOTH phases: a stage-time member error obeys
         degrade (returning the noop ship) instead of bypassing ``_absorb``
         and crashing the engine, and the returned ``ship`` applies the same
-        policy to the network puts."""
-        candidates = self.replica_indices(token_ids)
+        policy to the network puts. The final layer's successful ship
+        records the serving member in the root catalog, so a later reshard
+        knows where the layer-streamed copy lives (and, with replicas=2,
+        the resharder mirrors it to the successor in the background once a
+        membership transition kicks a reconcile pass)."""
+        candidates = self.write_indices(token_ids)
         if not candidates:
             return self._noop_ship()
         last: Optional[InfiniStoreException] = None
@@ -644,6 +1141,16 @@ class ClusterKVConnector:
                     self._degrade(candidates, e)
                     return 0
                 self._done(member_idx, None)
+                if n and layer == self.spec.num_layers - 1:
+                    n_chains = len(
+                        token_chain_hashes(token_ids, self.spec.block_tokens)
+                    )
+                    self._catalog_record(
+                        token_ids,
+                        min(n_chains, first_block + len(block_ids)),
+                        [self.member_ids[member_idx]],
+                        first_block=first_block,
+                    )
                 return n
 
             return routed
@@ -658,10 +1165,38 @@ class ClusterKVConnector:
         return noop
 
     def drop(self, token_ids) -> int:
-        """Remove this prompt's blocks from every responsible replica;
-        returns the largest per-member deletion count (replicas hold the
-        same keys)."""
-        candidates = self.replica_indices(token_ids)
+        """Remove this prompt's blocks from every responsible replica —
+        including, mid-reshard, every catalog holder (the old owner's
+        not-yet-pruned copy must not resurrect a dropped prompt via read
+        failover); returns the largest per-member deletion count (replicas
+        hold the same keys). The catalog record is removed up front so the
+        resharder can never re-mirror a dropped root; a copy behind an
+        unreachable member (OPEN breaker) survives there until that node
+        purges — the existing partial-drop policy surfaces it (strict mode
+        raises, degrade counts), same as a down member pre-elasticity."""
+        root = self._root_of(token_ids)
+        if root is None:
+            return 0
+        place = self.membership.view().placement_ids()
+        candidates = [
+            self.member_index(m)
+            for m in self._ranked_ids(place, root)[: self.replicas]
+        ]
+        read_cands, _ = self._read_candidates(root)
+        candidates += [i for i in read_cands if i not in candidates]
+        with self._cat_lock:
+            rec = self._catalog.pop(root, None)
+        if rec is not None:
+            view = self.membership.view()
+            for mid in sorted(rec.holders):
+                if view.state_of(mid) not in MemberState.READABLE:
+                    continue
+                try:
+                    i = self.member_index(mid)
+                except KeyError:
+                    continue
+                if i not in candidates:
+                    candidates.append(i)
         if not candidates:
             return 0
         best = 0
@@ -695,16 +1230,24 @@ class ClusterKVConnector:
         ``breaker_state`` / ``breaker_consecutive_failures`` /
         ``breaker_open_for_s`` / ``breaker_next_probe_in_s``, and the
         counters errors / fast_fails / probes / recoveries / degraded_ops
-        / replica_serves / last_error. The engine harness surfaces this as
-        ``store_health`` in its metrics."""
+        / replica_serves / last_error — plus each member's membership
+        ``state``, the epoch-stamped ``membership`` view, and the
+        resharder's ``reshard`` progress counters (docs/membership.md).
+        The engine harness surfaces this as ``store_health`` in its
+        metrics."""
+        view = self.membership.view()
         return {
             "degraded_ops": self.degraded_ops,
             "replicas": self.replicas,
             "degrade": self.degrade,
             "qos": dict(self._qos),
+            "membership": view.as_dict(),
+            "reshard": self.resharder.progress(),
             "members": [
-                {"member_id": mid, **h.as_dict()}
-                for mid, h in zip(self.member_ids, self._health)
+                {"member_id": mid, "state": state, **h.as_dict()}
+                for mid, state, h in zip(
+                    self.member_ids, view.states, self._health
+                )
             ],
         }
 
@@ -714,11 +1257,20 @@ class ClusterKVConnector:
         ``{"unreachable": True}`` WITHOUT touching it (the breaker exists so
         a dead node costs no timeouts — including here); a closed member
         that fails the stat query is likewise reported unreachable (and the
-        failure feeds its breaker)."""
+        failure feeds its breaker). DEAD/REMOVED members are reported by
+        ``state`` alone, never touched."""
         out = []
-        for i, (mid, m) in enumerate(zip(self.member_ids, self.members)):
+        view = self.membership.view()
+        # zip truncates to the view: a member being added concurrently
+        # (arrays grow before the view publishes) is skipped this call and
+        # appears on the next — never an index off the end of the view.
+        for i, (mid, m, state) in enumerate(
+            zip(self.member_ids, self.members, view.states)
+        ):
             h = self._health[i]
-            if h.breaker.state == CircuitBreaker.OPEN:
+            if state not in MemberState.READABLE:
+                s = {"unreachable": True}
+            elif h.breaker.state == CircuitBreaker.OPEN:
                 s = {"unreachable": True}
             else:
                 # Members expose get_stats() themselves (KVConnector and the
@@ -732,6 +1284,7 @@ class ClusterKVConnector:
                     self._done(i, e)
                     s = {"unreachable": True}
             s["member_id"] = mid
+            s["state"] = state
             s.update(h.as_dict())
             out.append(s)
         return out

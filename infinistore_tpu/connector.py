@@ -366,6 +366,27 @@ class KVConnector:
         self._keys0_cache = (list(chains), keys)
         return keys
 
+    def manifest(self, token_ids, n_blocks: Optional[int] = None):
+        """Every store key this connector would hold for the prompt's first
+        ``n_blocks`` complete blocks (default: all), as size-grouped
+        ``[(block_nbytes, [key, ...])]`` — the raw-byte inventory the
+        membership resharder migrates between members without knowing the
+        key scheme (docs/membership.md). Sentinel ordering: the layer-0 K
+        key of each block (what ``lookup`` probes) is LAST in its group, so
+        a batched copy that dies mid-stream never publishes a sentinel for
+        an incompletely copied block."""
+        chains = self._chains(token_ids)
+        if n_blocks is not None:
+            chains = chains[:n_blocks]
+        keys = [
+            self.block_key(layer, kind, c)
+            for layer in range(self.spec.num_layers)
+            for kind in ("k", "v")
+            for c in chains
+            if (layer, kind) != (0, "k")
+        ] + [self.block_key(0, "k", c) for c in chains]
+        return [(self.spec.block_nbytes, keys)] if keys else []
+
     # -- engine surface ------------------------------------------------------
 
     def lookup(self, token_ids: Sequence[int]) -> int:

@@ -79,9 +79,8 @@ def prevent_oom() -> None:
 
 def _http_response(status: int, payload: dict) -> bytes:
     body = json.dumps(payload).encode()
-    reason = {200: "OK", 404: "Not Found", 405: "Method Not Allowed", 500: "Error"}.get(
-        status, "OK"
-    )
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed", 500: "Error"}.get(status, "OK")
     return (
         f"HTTP/1.1 {status} {reason}\r\n"
         f"Content-Type: application/json\r\n"
@@ -90,9 +89,11 @@ def _http_response(status: int, payload: dict) -> bytes:
     ).encode() + body
 
 
-def _prometheus_text(stats: dict) -> bytes:
+def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
-    reference exposes no metrics at all — SURVEY.md §5.1/§5.5)."""
+    reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
+    cluster attached to the manage plane, ``membership_status`` appends
+    the membership/reshard gauge families (docs/membership.md)."""
     lines = [
         "# TYPE infinistore_kvmap_entries gauge",
         f"infinistore_kvmap_entries {stats['kvmap_len']}",
@@ -174,6 +175,8 @@ def _prometheus_text(stats: dict) -> bytes:
     lines.append("# TYPE infinistore_op_p99_latency_us gauge")
     for op, s in ops:
         lines.append(f'infinistore_op_p99_latency_us{{op="{op}"}} {s["p99_us"]}')
+    if membership_status is not None:
+        lines += _membership_prometheus_lines(membership_status)
     body = ("\n".join(lines) + "\n").encode()
     return (
         f"HTTP/1.1 200 OK\r\n"
@@ -183,14 +186,84 @@ def _prometheus_text(stats: dict) -> bytes:
     ).encode() + body
 
 
+def _membership_prometheus_lines(ms: dict) -> list:
+    """Membership + reshard gauges for /metrics, from the flat
+    ``ClusterKVConnector.membership_status()`` snapshot (the same dict the
+    ``/membership`` endpoint serves; key vocabulary in
+    ``Membership.status`` / ``Resharder.progress``). The counters checker
+    (ITS-C005, tools/analysis/counters.py) cross-checks that every status
+    key is consumed here — a membership counter that never reaches a
+    dashboard is observability drift."""
+    return [
+        "# TYPE infinistore_membership_epoch gauge",
+        f"infinistore_membership_epoch {ms['membership_epoch']}",
+        "# TYPE infinistore_membership_epoch_changes counter",
+        f"infinistore_membership_epoch_changes {ms['membership_epoch_changes']}",
+        "# TYPE infinistore_membership_members gauge",
+        f"infinistore_membership_members {ms['membership_members']}",
+        "# TYPE infinistore_membership_state gauge",
+        f'infinistore_membership_state{{state="joining"}} {ms["membership_joining"]}',
+        f'infinistore_membership_state{{state="active"}} {ms["membership_active"]}',
+        f'infinistore_membership_state{{state="leaving"}} {ms["membership_leaving"]}',
+        f'infinistore_membership_state{{state="dead"}} {ms["membership_dead"]}',
+        f'infinistore_membership_state{{state="removed"}} {ms["membership_removed"]}',
+        "# TYPE infinistore_membership_settled gauge",
+        f"infinistore_membership_settled {ms['membership_settled']}",
+        "# TYPE infinistore_reshard_active gauge",
+        f"infinistore_reshard_active {ms['reshard_active']}",
+        "# TYPE infinistore_reshard_passes counter",
+        f"infinistore_reshard_passes {ms['reshard_passes']}",
+        "# TYPE infinistore_reshard_replans counter",
+        f"infinistore_reshard_replans {ms['reshard_replans']}",
+        "# TYPE infinistore_reshard_planned_roots counter",
+        f"infinistore_reshard_planned_roots {ms['reshard_planned_roots']}",
+        "# TYPE infinistore_reshard_moved_roots counter",
+        f"infinistore_reshard_moved_roots {ms['reshard_moved_roots']}",
+        "# TYPE infinistore_reshard_moved_keys counter",
+        f"infinistore_reshard_moved_keys {ms['reshard_moved_keys']}",
+        "# TYPE infinistore_reshard_moved_bytes counter",
+        f"infinistore_reshard_moved_bytes {ms['reshard_moved_bytes']}",
+        "# TYPE infinistore_reshard_pruned_keys counter",
+        f"infinistore_reshard_pruned_keys {ms['reshard_pruned_keys']}",
+        "# TYPE infinistore_reshard_skipped_keys counter",
+        f"infinistore_reshard_skipped_keys {ms['reshard_skipped_keys']}",
+        "# TYPE infinistore_reshard_failed_roots counter",
+        f"infinistore_reshard_failed_roots {ms['reshard_failed_roots']}",
+        "# TYPE infinistore_reshard_lost_roots counter",
+        f"infinistore_reshard_lost_roots {ms['reshard_lost_roots']}",
+        "# TYPE infinistore_reshard_debt_roots gauge",
+        f"infinistore_reshard_debt_roots {ms['reshard_debt_roots']}",
+        "# TYPE infinistore_reshard_prune_debt gauge",
+        f"infinistore_reshard_prune_debt {ms['reshard_prune_debt']}",
+        "# TYPE infinistore_reshard_last_pass_ms gauge",
+        f"infinistore_reshard_last_pass_ms {ms['reshard_last_pass_ms']}",
+    ]
+
+
 class ManageServer:
     """The management plane: /purge, /kvmap_len (reference server.py:25-39),
     /selftest (advertised in reference README.md:56-57 but missing), /stats,
-    /usage, /metrics (Prometheus), /health."""
+    /usage, /metrics (Prometheus), /health — plus, with a cluster attached,
+    /membership GET/POST (the elastic-membership control surface,
+    docs/membership.md).
 
-    def __init__(self, config: ServerConfig):
+    ``cluster``: an optional ``ClusterKVConnector``-shaped object (needs
+    ``membership`` / ``resharder`` / ``membership_status()`` / ``health()``
+    and the add/remove/mark_dead transitions). A plain store server runs
+    without one; a pool operator embeds the manage plane next to the
+    cluster client to drive membership over HTTP. Connections the manage
+    plane itself creates (POST add) are OWNED here: once their member
+    reaches a terminal state (REMOVED after a drain, DEAD after a crash)
+    they are closed on the next control-plane request — HTTP-driven
+    join/leave churn never accumulates native connections."""
+
+    def __init__(self, config: ServerConfig, cluster=None):
         self.config = config
+        self.cluster = cluster
         self._server = None
+        # member_id -> InfinityConnection this manage plane connected
+        # (POST add); swept once the member goes terminal.
+        self._owned_conns = {}
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
@@ -200,15 +273,29 @@ class ManageServer:
                 writer.close()
                 return
             method, path = parts[0], parts[1]
-            # Drain headers.
+            # Drain headers, keeping Content-Length (POST bodies).
+            content_len = 0
             while True:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            resp = await self._route(method, path)
+                name, _, value = line.decode("latin-1").partition(":")
+                if name.strip().lower() == "content-length":
+                    try:
+                        # Clamp both ways: a negative length must not reach
+                        # readexactly().
+                        content_len = max(0, min(int(value.strip()), 1 << 20))
+                    except ValueError:
+                        content_len = 0
+            body = b""
+            if content_len:
+                body = await asyncio.wait_for(
+                    reader.readexactly(content_len), timeout=10
+                )
+            resp = await self._route(method, path, body)
             writer.write(resp)
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError):
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             try:
@@ -217,7 +304,7 @@ class ManageServer:
             except Exception:
                 pass
 
-    async def _route(self, method: str, path: str) -> bytes:
+    async def _route(self, method: str, path: str, body: bytes = b"") -> bytes:
         path = path.split("?", 1)[0]
         try:
             if path == "/purge" and method == "POST":
@@ -233,19 +320,143 @@ class ManageServer:
                 stats = await asyncio.to_thread(_lib.get_server_stats)
                 return _http_response(200, {"usage": stats["usage"]})
             if path == "/metrics" and method == "GET":
-                stats = await asyncio.to_thread(_lib.get_server_stats)
-                return _prometheus_text(stats)
+                ms = (
+                    self.cluster.membership_status()
+                    if self.cluster is not None else None
+                )
+                try:
+                    stats = await asyncio.to_thread(_lib.get_server_stats)
+                except Exception:
+                    # A cluster-side manage plane may run with no local
+                    # store server in-process: membership gauges must
+                    # still scrape. A plain store server's failure stays
+                    # a 500.
+                    if ms is None:
+                        raise
+                    body = ("\n".join(_membership_prometheus_lines(ms)) + "\n").encode()
+                    return (
+                        f"HTTP/1.1 200 OK\r\n"
+                        f"Content-Type: text/plain; version=0.0.4\r\n"
+                        f"Content-Length: {len(body)}\r\n"
+                        f"Connection: close\r\n\r\n"
+                    ).encode() + body
+                return _prometheus_text(stats, membership_status=ms)
             if path == "/health" and method == "GET":
                 return _http_response(200, {"status": "ok"})
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
+            if path == "/membership" and method == "GET":
+                return self._membership_get()
+            if path == "/membership" and method == "POST":
+                return await self._membership_post(body)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
-                        "/selftest", "/health"):
+                        "/selftest", "/health", "/membership"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request
             Logger.error(f"manage request {method} {path} failed: {e}")
             return _http_response(500, {"error": str(e)})
+
+    # -- elastic membership control surface (docs/membership.md) -------------
+
+    def _sweep_owned_conns(self):
+        """Close manage-plane-owned connections whose member went terminal
+        (REMOVED after a drain completes, DEAD after a crash). Lazy: runs
+        on each /membership request, so a leave's connection lives exactly
+        until its drain finalizes."""
+        if self.cluster is None or not self._owned_conns:
+            return
+        from .membership import MemberState
+
+        view = self.cluster.membership.view()
+        for mid in list(self._owned_conns):
+            if view.state_of(mid) in MemberState.TERMINAL:
+                conn = self._owned_conns.pop(mid)
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def _membership_get(self) -> bytes:
+        """GET /membership: the epoch-stamped view (per-member states) plus
+        the flat membership_*/reshard_* counter snapshot, verbatim from
+        ``membership_status()`` — the counters checker (ITS-C005) holds
+        this route to the status vocabulary."""
+        if self.cluster is None:
+            return _http_response(
+                200, {"enabled": False, "error": "no cluster attached"}
+            )
+        self._sweep_owned_conns()
+        view = self.cluster.membership.view()
+        return _http_response(200, {
+            "enabled": True,
+            **view.as_dict(),
+            **self.cluster.membership_status(),
+        })
+
+    async def _membership_post(self, body: bytes) -> bytes:
+        """POST /membership: apply one membership transition.
+
+        Body (JSON): ``{"action": "add", "host": ..., "service_port": ...,
+        "member_id"?: ...}`` connects a new member and admits it JOINING
+        (connect runs in a worker thread — the control plane must not block
+        on a TCP connect, ITS-L001); ``{"action": "remove"|"mark_dead",
+        "member_id": ...}`` drains / writes off an existing member. Returns
+        the new epoch + status; transition errors are 400s."""
+        if self.cluster is None:
+            return _http_response(400, {"error": "no cluster attached"})
+        try:
+            req = json.loads(body.decode() or "{}")
+            action = req.get("action")
+            if action == "add":
+                view = await asyncio.to_thread(
+                    self._add_member_blocking, req
+                )
+            elif action in ("remove", "mark_dead"):
+                member_id = req["member_id"]
+                fn = (
+                    self.cluster.remove_member if action == "remove"
+                    else self.cluster.mark_dead
+                )
+                view = fn(member_id)
+            else:
+                return _http_response(
+                    400, {"error": f"unknown action {action!r}"}
+                )
+        except (KeyError, ValueError, TypeError) as e:
+            return _http_response(400, {"error": repr(e)})
+        self._sweep_owned_conns()
+        return _http_response(200, {
+            "status": "ok",
+            "epoch": view.epoch,
+            **self.cluster.membership_status(),
+        })
+
+    def _add_member_blocking(self, req: dict):
+        """Connect + admit a new member (worker-thread half of POST add)."""
+        from .config import ClientConfig
+        from .lib import InfinityConnection
+
+        host, port = req["host"], int(req["service_port"])
+        member_id = req.get("member_id") or f"{host}:{port}"
+        conn = InfinityConnection(ClientConfig(
+            host_addr=host, service_port=port, log_level="error",
+        ))
+        try:
+            conn.connect()
+            view = self.cluster.add_member(conn, member_id=member_id)
+        except BaseException:
+            # Whatever failed — unreachable host, rejected transition — the
+            # native connection must not leak across operator retries.
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
+        # Admitted: the manage plane owns this connection until the member
+        # goes terminal (_sweep_owned_conns).
+        self._owned_conns[member_id] = conn
+        return view
 
     def _selftest(self) -> dict:
         """Loopback write/read/delete through the real data plane."""

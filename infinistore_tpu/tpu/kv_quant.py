@@ -306,6 +306,23 @@ class QuantizedKVConnector:
         """Remove this prompt's data AND scale blocks."""
         return self.data.drop(token_ids) + self.scales.drop(token_ids)
 
+    @property
+    def conn(self):
+        """The shared store connection (both planes ride one connection) —
+        the surface the cluster's probe-heal and the membership resharder
+        move raw bytes through."""
+        return self.data.conn
+
+    def manifest(self, token_ids, n_blocks=None):
+        """Size-grouped key inventory for the resharder (see
+        ``KVConnector.manifest``): the scale group precedes the data group,
+        mirroring ``save``'s commit order — the data plane's layer-0 K
+        sentinel lands last, so a half-migrated copy never looks complete
+        to ``lookup``."""
+        return self.scales.manifest(token_ids, n_blocks) + self.data.manifest(
+            token_ids, n_blocks
+        )
+
     def get_stats(self) -> dict:
         """Connection stats (both planes ride one connection)."""
         return self.data.get_stats()

@@ -599,3 +599,142 @@ class TestFramework:
             assert json.loads(out.read_text())["counts"]["new"] == 0
         finally:
             baseline_file.write_text(snapshot)  # the test must not mutate the repo
+
+
+# ---------------------------------------------------------------------------
+# policy ITS-P003: migration traffic is BACKGROUND (membership subsystem)
+# ---------------------------------------------------------------------------
+
+P003_FIXTURE = '''\
+from .wire import PRIORITY_BACKGROUND, PRIORITY_FOREGROUND
+import wire
+
+
+def copy_ok(src, dst, blocks, size, ptr):
+    src.read_cache(blocks, size, ptr, priority=PRIORITY_BACKGROUND)
+    dst.write_cache(blocks, size, ptr, priority=wire.PRIORITY_BACKGROUND)
+    dst.write_cache(blocks, size, ptr, **wire.qos_kwargs(dst, PRIORITY_BACKGROUND))
+    src.tcp_read_cache("k", priority=PRIORITY_BACKGROUND)
+
+
+def copy_untagged(src, blocks, size, ptr):
+    src.read_cache(blocks, size, ptr)
+
+
+def copy_foreground(dst, blocks, size, ptr):
+    dst.write_cache(blocks, size, ptr, priority=PRIORITY_FOREGROUND)
+
+
+def copy_tcp_untagged(src, dst):
+    data = src.tcp_read_cache("k")
+    dst.tcp_write_cache("k", 0, 16)
+'''
+
+
+class TestPolicyP003:
+    def scan(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/membership.py": P003_FIXTURE})
+        return policy.scan(
+            ctx, package_rel="pkg", p001_exempt=set(), p002_exempt=set(),
+            p003_files={"pkg/membership.py"},
+        )
+
+    def test_untagged_and_foreground_migration_ops_fire(self, tmp_path):
+        p3 = [f for f in self.scan(tmp_path) if f.rule == "ITS-P003"]
+        ops = sorted(f.message.split("(")[0].split(".")[1].split("()")[0] for f in p3)
+        # The three violations: an untagged batched read, a FOREGROUND-tagged
+        # batched write, and BOTH untagged single-key tcp ops.
+        assert ops == [
+            "read_cache", "tcp_read_cache", "tcp_write_cache", "write_cache",
+        ]
+
+    def test_background_tagged_calls_pass(self, tmp_path):
+        p3 = [f for f in self.scan(tmp_path) if f.rule == "ITS-P003"]
+        # Nothing from copy_ok: kwarg, attribute form, and qos_kwargs splat
+        # all count as a BACKGROUND tag.
+        assert not [f for f in p3 if "copy_ok" in f.key]
+
+    def test_scope_is_membership_only(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/other.py": P003_FIXTURE})
+        found = policy.scan(
+            ctx, package_rel="pkg", p001_exempt=set(), p002_exempt=set(),
+            p003_files={"pkg/membership.py"},
+        )
+        assert not [f for f in found if f.rule == "ITS-P003"]
+
+    def test_real_membership_is_background_tagged(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in policy.scan(ctx) if f.rule == "ITS-P003"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# counters ITS-C005: membership status keys reach the /metrics exporter
+# ---------------------------------------------------------------------------
+
+C005_MEMBERSHIP = '''\
+class Membership:
+    def status(self):
+        return {"membership_epoch": 1, "membership_settled": 1}
+
+
+class Resharder:
+    def __init__(self):
+        self._c = {"reshard_moved_roots": 0, "reshard_debt_roots": 0}
+
+    def progress(self):
+        out = dict(self._c)
+        out["reshard_active"] = 0
+        return out
+'''
+
+C005_MANAGE_OK = '''\
+def _membership_prometheus_lines(ms):
+    return [
+        f"a {ms['membership_epoch']}",
+        f"b {ms['membership_settled']}",
+        f"c {ms['reshard_moved_roots']}",
+        f"d {ms['reshard_debt_roots']}",
+        f"e {ms['reshard_active']}",
+    ]
+
+route = "/membership"  # served from membership_status
+'''
+
+
+class TestCountersMembership:
+    def scan(self, tmp_path, manage_src, membership_src=C005_MEMBERSHIP):
+        ctx = make_tree(tmp_path, {
+            "manage.py": manage_src, "membership.py": membership_src,
+        })
+        return counters._scan_membership(ctx, "manage.py", "membership.py")
+
+    def test_complete_exporter_is_clean(self, tmp_path):
+        assert self.scan(tmp_path, C005_MANAGE_OK) == []
+
+    def test_unexported_status_key_fires(self, tmp_path):
+        manage = C005_MANAGE_OK.replace(
+            "        f\"d {ms['reshard_debt_roots']}\",\n", "")
+        found = self.scan(tmp_path, manage)
+        assert any(
+            f.rule == "ITS-C005" and f.key.endswith("reshard_debt_roots")
+            for f in found
+        )
+
+    def test_stale_exporter_key_fires(self, tmp_path):
+        manage = C005_MANAGE_OK.replace(
+            "reshard_debt_roots", "reshard_gone_key")
+        found = self.scan(tmp_path, manage)
+        keys = {f.key for f in found}
+        assert any(k.endswith("stale:reshard_gone_key") for k in keys)
+        assert any(k.endswith(":reshard_debt_roots") for k in keys)
+
+    def test_missing_membership_route_fires(self, tmp_path):
+        manage = C005_MANAGE_OK.replace('"/membership"', '"/nope"')
+        found = self.scan(tmp_path, manage)
+        assert any(f.key.endswith("membership-route") for f in found)
+
+    def test_real_membership_counters_are_clean(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in counters.scan(ctx) if f.rule == "ITS-C005"]
+        assert found == []

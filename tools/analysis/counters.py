@@ -22,6 +22,14 @@ and cross-checks them:
 - ITS-C003 counter key absent from docs/api_reference.md
 - ITS-C004 manage plane no longer serves /stats verbatim from
   get_server_stats
+- ITS-C005 membership/reshard counter drift: every ``membership_*`` /
+  ``reshard_*`` key of the elastic-membership status snapshot
+  (``Membership.status`` + ``Resharder.progress``/``__init__`` ledgers)
+  must be consumed by the /metrics membership exporter
+  (``server.py _membership_prometheus_lines``) — and the exporter must
+  not consume keys the snapshot no longer emits (KeyError at scrape
+  time); the manage plane must keep serving GET/POST ``/membership``
+  from ``membership_status``.
 
 Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
 both sides.
@@ -48,7 +56,20 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/lib.py", "StripedConnection.completion_stats"),
     ("infinistore_tpu/cluster.py", "_MemberHealth.as_dict"),
     ("infinistore_tpu/cluster.py", "ClusterKVConnector.health"),
+    ("infinistore_tpu/membership.py", "Membership.status"),
+    ("infinistore_tpu/membership.py", "Resharder.progress"),
 ]
+
+# The elastic-membership status snapshot (ITS-C005): the dict-literal
+# ledgers whose union is the membership_*/reshard_* key vocabulary, and
+# the /metrics exporter function that must consume all of it.
+MEMBERSHIP_REL = "infinistore_tpu/membership.py"
+MEMBERSHIP_LEDGERS: List[str] = [
+    "Membership.status",
+    "Resharder.__init__",  # the reshard_* counter dict literal
+    "Resharder.progress",
+]
+MEMBERSHIP_EXPORT_FN = "_membership_prometheus_lines"
 
 
 # ---------------------------------------------------------------------------
@@ -329,6 +350,59 @@ def scan(
                     "get_server_stats (the raw counter surface /metrics "
                     "summarizes)",
             key=f"ITS-C004:{manage_rel}:stats-route",
+        ))
+    findings += _scan_membership(ctx, manage_rel, MEMBERSHIP_REL)
+    return findings
+
+
+def _scan_membership(
+    ctx: Context, manage_rel: str, membership_rel: str = MEMBERSHIP_REL
+) -> List[Finding]:
+    """ITS-C005: the elastic-membership status vocabulary vs the /metrics
+    membership exporter and the /membership manage route."""
+    findings: List[Finding] = []
+    if not ctx.exists(membership_rel):
+        return findings
+    status_keys: Set[str] = set()
+    for dotted in MEMBERSHIP_LEDGERS:
+        keys, _ = ledger_keys(ctx, membership_rel, dotted)
+        status_keys |= keys
+    status_keys = {
+        k for k in status_keys
+        if k.startswith("membership_") or k.startswith("reshard_")
+    }
+    consumed = metrics_consumed_keys(
+        ctx, manage_rel, fn_name=MEMBERSHIP_EXPORT_FN
+    )
+    for key in sorted(status_keys - consumed):
+        findings.append(Finding(
+            rule="ITS-C005", file=manage_rel, line=1,
+            message=f"membership status key {key!r} is not exported by the "
+                    f"/metrics membership exporter ({MEMBERSHIP_EXPORT_FN}) "
+                    "— a reshard counter dashboards cannot see is "
+                    "observability drift (docs/membership.md)",
+            key=f"ITS-C005:{manage_rel}:{key}",
+        ))
+    for key in sorted(consumed - status_keys):
+        findings.append(Finding(
+            rule="ITS-C005", file=manage_rel, line=1,
+            message=f"/metrics membership exporter consumes key {key!r} "
+                    "which the membership status snapshot no longer emits "
+                    "(KeyError at scrape time)",
+            key=f"ITS-C005:{manage_rel}:stale:{key}",
+        ))
+    manage_src = ctx.read(manage_rel)
+    if (
+        not re.search(r'[\'"]/membership[\'"]', manage_src)
+        or "membership_status" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C005", file=manage_rel, line=1,
+            message="manage plane must serve /membership (GET view+status, "
+                    "POST transitions) from membership_status — the "
+                    "elastic-membership control surface "
+                    "(docs/membership.md)",
+            key=f"ITS-C005:{manage_rel}:membership-route",
         ))
     return findings
 

@@ -22,6 +22,19 @@ exactly what this pass does:
   scheduler measures (docs/qos.md); the decision must be visible at the
   call site. ``benchmark.py`` is exempt: its synthetic legs measure the
   untagged default path on purpose.
+
+- ITS-P003 **migration traffic is BACKGROUND, always.** Inside the
+  membership subsystem (``membership.py`` — the resharder's copy/prune
+  machinery), every data-plane call (the batched ops AND the single-key
+  ``tcp_*_cache`` ops) must pass a ``priority`` whose expression names
+  BACKGROUND (``PRIORITY_BACKGROUND`` / ``wire.PRIORITY_BACKGROUND``).
+  ITS-P002's "any explicit class" is not enough here: a reshard moving
+  ~1/N of the pool at FOREGROUND priority would push the decode-blocking
+  p99 exactly when the fleet is already churning (docs/membership.md,
+  docs/qos.md). Membership-transition handlers also fall under ITS-P001
+  like everyone else — their ``except InfiniStoreException`` clauses
+  must feed the degrade machinery (the cluster's ``_begin``/``_done``
+  breaker plumbing), not swallow a dying member mid-migration.
 """
 
 from __future__ import annotations
@@ -67,6 +80,11 @@ P002_EXEMPT_FILES = {
     "infinistore_tpu/faults.py",
     "infinistore_tpu/benchmark.py",
 }
+
+# ITS-P003 scope: the membership subsystem's migration machinery, where
+# every data-plane op — batched AND single-key — must be BACKGROUND.
+P003_FILES = {"infinistore_tpu/membership.py"}
+P003_OPS = BATCHED_OPS | {"tcp_read_cache", "tcp_write_cache"}
 
 
 def _scope_map(tree: ast.Module) -> dict:
@@ -132,9 +150,11 @@ def _passes_priority(call: ast.Call) -> bool:
 
 def scan(ctx: Context, package_rel: str = PACKAGE_REL,
          p001_exempt: Optional[Set[str]] = None,
-         p002_exempt: Optional[Set[str]] = None) -> List[Finding]:
+         p002_exempt: Optional[Set[str]] = None,
+         p003_files: Optional[Set[str]] = None) -> List[Finding]:
     p001_exempt = P001_EXEMPT_FILES if p001_exempt is None else p001_exempt
     p002_exempt = P002_EXEMPT_FILES if p002_exempt is None else p002_exempt
+    p003_files = P003_FILES if p003_files is None else p003_files
     findings: List[Finding] = []
     for rel in ctx.walk_py(package_rel):
         try:
@@ -145,6 +165,8 @@ def scan(ctx: Context, package_rel: str = PACKAGE_REL,
             findings += _scan_p001(rel, tree)
         if rel not in p002_exempt:
             findings += _scan_p002(rel, tree)
+        if rel in p003_files:
+            findings += _scan_p003(rel, tree)
     return findings
 
 
@@ -191,6 +213,53 @@ def _scan_p002(rel: str, tree: ast.Module) -> List[Finding]:
                     "the FOREGROUND/BACKGROUND decision is visible at the "
                     "producing call site (docs/qos.md)",
             key=_scoped_key("ITS-P002", rel, scopes.get(node, ""), fn.attr, nth),
+        ))
+    return out
+
+
+def _names_background(node) -> bool:
+    """Does this expression reference the BACKGROUND class (a Name or
+    Attribute whose identifier names BACKGROUND, e.g. PRIORITY_BACKGROUND /
+    wire.PRIORITY_BACKGROUND), anywhere inside it?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "BACKGROUND" in sub.id:
+            return True
+        if isinstance(sub, ast.Attribute) and "BACKGROUND" in sub.attr:
+            return True
+    return False
+
+
+def _scan_p003(rel: str, tree: ast.Module) -> List[Finding]:
+    out: List[Finding] = []
+    scopes = _scope_map(tree)
+    nth: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in P003_OPS):
+            continue
+        tagged = False
+        for kw in node.keywords:
+            # An explicit priority kwarg naming BACKGROUND, or a **splat
+            # whose expression does (wire.qos_kwargs(conn,
+            # PRIORITY_BACKGROUND)).
+            if kw.arg == "priority" and _names_background(kw.value):
+                tagged = True
+            if kw.arg is None and _names_background(kw.value):
+                tagged = True
+        if len(node.args) >= 4 and _names_background(node.args[3]):
+            tagged = True
+        if tagged:
+            continue
+        out.append(Finding(
+            rule="ITS-P003", file=rel, line=node.lineno,
+            message=f".{fn.attr}() in the membership subsystem without an "
+                    "explicit BACKGROUND tag — migration traffic must pass "
+                    "priority=PRIORITY_BACKGROUND (or a qos_kwargs splat "
+                    "naming it) so a reshard can never move the foreground "
+                    "p99 (docs/membership.md, docs/qos.md)",
+            key=_scoped_key("ITS-P003", rel, scopes.get(node, ""), fn.attr, nth),
         ))
     return out
 

@@ -127,6 +127,63 @@ CHECKS = [
             "restart (must be within one probe window; gate at 5s)"
         ),
     ),
+    # Elastic membership churn (docs/membership.md): a live JOIN and a
+    # member DEATH mid-workload. Binary like the chaos gate: epoch-aware
+    # read failover must hold availability at 1.0 with ZERO wrong reads
+    # AND ZERO misses across every sweep (including the mid-reshard
+    # ones). Misses are gated separately from the availability ratio —
+    # (reads-wrong)/reads stays 1.0 even if every read degrades to a
+    # miss, and "failover quietly turned the cache off mid-reshard" is
+    # exactly the regression this leg exists to catch (with R=2 every
+    # root survives both churn events, so a miss is never legitimate
+    # here).
+    Check(
+        "churn_availability",
+        ["churn_availability", "churn_wrong_reads", "churn_misses"],
+        lambda m: (
+            m["churn_availability"] >= 1.0
+            and m["churn_wrong_reads"] == 0
+            and m["churn_misses"] == 0
+        ),
+        lambda m: (
+            f"availability={m['churn_availability']:.4f}, "
+            f"wrong_reads={m['churn_wrong_reads']:.0f}, "
+            f"misses={m['churn_misses']:.0f} under membership churn "
+            "(must be 1.0 / 0 / 0 with epoch-aware read failover)"
+        ),
+    ),
+    # The rendezvous-delta property: a join must move ONLY the roots whose
+    # top-R placement gained the joiner — measured against the delta
+    # fraction computed independently of the resharder (analytic
+    # expectation R/(N+1); a full reshuffle or naive-mod remap is ~1.0).
+    # 0.10 slack covers roots that legitimately resolve either way during
+    # the overlap window (a concurrent re-save landing on the joiner).
+    Check(
+        "churn_join_delta",
+        ["churn_join_moved_fraction", "churn_join_delta_fraction"],
+        lambda m: (
+            abs(m["churn_join_moved_fraction"] - m["churn_join_delta_fraction"])
+            <= 0.10
+            and m["churn_join_moved_fraction"] <= 0.80
+        ),
+        lambda m: (
+            f"join moved {100 * m['churn_join_moved_fraction']:.1f}% of roots "
+            f"vs rendezvous delta {100 * m['churn_join_delta_fraction']:.1f}% "
+            "(only the delta may move; a full reshuffle is ~100%)"
+        ),
+    ),
+    # Bounded migration debt: the reconciler must drain within the
+    # workload — leftover debt means the pool never converges to R copies
+    # on the new placement.
+    Check(
+        "churn_migration_debt",
+        ["churn_migration_debt"],
+        lambda m: m["churn_migration_debt"] == 0,
+        lambda m: (
+            f"reshard ended with {m['churn_migration_debt']:.0f} unmigrated "
+            "roots (debt must drain to 0)"
+        ),
+    ),
     # QoS two-class isolation (docs/qos.md): with the churn tagged
     # BACKGROUND, the innocent foreground 4KB read's contended p99 must
     # improve by >= 2x over the untagged (FIFO) run — measured history

@@ -45,6 +45,9 @@ SURFACE = [
         "ClusterKVConnector", "rendezvous_owner", "rendezvous_ranked",
         "CircuitBreaker",
     ]),
+    ("infinistore_tpu.membership", [
+        "MemberState", "MembershipView", "Membership", "Resharder",
+    ]),
     ("infinistore_tpu.faults", [
         "FaultRule", "FaultyConnection", "kill_transport",
     ]),
