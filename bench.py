@@ -585,6 +585,226 @@ def _qos_isolation_us(its, np) -> dict:
     }
 
 
+def _trace_metrics(its, np, srv) -> dict:
+    """End-to-end tracing receipt (docs/observability.md), three parts:
+
+    1. OVERHEAD: batched-get wall time with tracing on vs off, sampled in
+       INTERLEAVED rounds (min per config — the weather rule: this host
+       swings ~2x between seconds, so separate windows measure weather,
+       not the tracing hooks). ``trace_overhead_cost`` = on/off - 1,
+       gated <= 3% in tools/bench_check.py. Off-path wire identity
+       (``trace_wire_identical``) is checked byte-for-byte.
+
+    2. STAGE BREAKDOWN: traced batched gets, client span stamps merged
+       with the server's trace-tick ring by trace id (same monotonic
+       clock), reduced to per-stage fractions of wall time
+       (``trace_frac_*``; they sum to ~1.0 by construction —
+       ``trace_stage_fraction_sum``). This is the receipt that scopes the
+       ROADMAP-2 descriptor-ring work: it says WHERE the
+       ~54%-of-memcpy-ceiling loopback gap lives, per stage.
+
+    3. MANAGE PLANE: GET /trace on a live ManageServer must return
+       Perfetto-loadable Chrome trace events for the ops above
+       (``trace_endpoint_events``), and the slow-op watchdog must have
+       captured them (threshold 1us here — every op is 'slow' by
+       construction, proving the capture path: ``trace_slow_ops``)."""
+    import asyncio
+
+    from infinistore_tpu import tracing, wire
+    from infinistore_tpu.config import ServerConfig
+    from infinistore_tpu.server import ManageServer
+    from infinistore_tpu import lib as its_lib
+
+    # Off-path wire byte-identity: the untraced encoding must be
+    # byte-identical to the pre-trace (and pre-QoS, for FOREGROUND) format.
+    legacy = (
+        __import__("struct").pack("<I", 4096)
+        + wire.encode_str_list(["k0", "k1"])
+    )
+    identical = int(
+        wire.BatchMeta(block_size=4096, keys=["k0", "k1"]).encode() == legacy
+        and wire.SegBatchMeta(
+            block_size=4096, seg_id=0, keys=["k0"], offsets=[0]
+        ).encode()
+        == wire.SegBatchMeta(
+            block_size=4096, seg_id=0, keys=["k0"], offsets=[0],
+            priority=0,
+        ).encode()
+    )
+
+    n_keys, block = 256, 64 << 10
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                         log_level="error")
+    )
+    conn.connect()
+    buf = _staging_buf(np, conn, n_keys * block)
+    buf[:] = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
+    pairs = [(f"tr-{i}", i * block) for i in range(n_keys)]
+
+    async def put():
+        await conn.write_cache_async(pairs, block, buf.ctypes.data)
+
+    def get_once(traced: bool, reps: int = 8) -> float:
+        # ``reps`` traced/untraced gets inside ONE loop run, timed around
+        # the ops only: asyncio.run()'s loop setup (~hundreds of us) would
+        # otherwise dominate the on/off delta of a ~2ms op.
+        async def go() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                if traced:
+                    with tracing.trace_op("batched_get", stage="enqueue") as sp:
+                        await conn.read_cache_async(pairs, block, buf.ctypes.data)
+                        if sp is not None:
+                            sp.stage("install")
+                else:
+                    await conn.read_cache_async(pairs, block, buf.ctypes.data)
+            return time.perf_counter() - t0
+
+        return asyncio.run(go())
+
+    asyncio.run(put())
+    # Overhead phase: the steady-state tracing config — watchdog armed at a
+    # threshold normal ops never cross. (slow_op_us=1 would capture EVERY
+    # op's full span tree, a deliberate worst case the watchdog phase below
+    # measures separately; recording it here would charge tracing for a
+    # pathological configuration.)
+    tracing.configure(enabled=True, capacity=512, slow_op_us=60_000_000)
+    get_once(True)  # warmup both paths
+    tracing.configure(enabled=False)
+    get_once(False)
+
+    # PAIRED estimator (the weather rule, strongest form): each round times
+    # tracing-on and tracing-off back-to-back — the two halves of a pair
+    # share the same ~tens-of-ms weather window — and the reported cost is
+    # the MEDIAN of the per-pair ratios, which a minority of weather-spiked
+    # pairs cannot move (a min-of-independent-samples estimator measured
+    # 0-5% run-to-run scatter here for a true ~0.3% effect). Bounded noise
+    # guard: pool more pairs while the median sits past 1%; a REAL >1%
+    # regression will not converge and reports honestly against the 3% gate.
+    times = {True: float("inf"), False: float("inf")}
+    sums = {True: 0.0, False: 0.0}
+    ratios: list = []
+    flip = [0]
+
+    def pair():
+        # Alternate which half runs first: within-pair ordering carries its
+        # own small bias (TCP/loop warmth favors the second half), which a
+        # fixed order would book entirely against one config.
+        flip[0] ^= 1
+        sample = {}
+        for traced in ((True, False) if flip[0] else (False, True)):
+            tracing.configure(enabled=traced)
+            sample[traced] = get_once(traced)
+        for traced in (True, False):
+            times[traced] = min(times[traced], sample[traced])
+            sums[traced] += sample[traced]
+        ratios.append(sample[True] / sample[False])
+
+    def estimate() -> float:
+        # Two estimators, take the smaller: the MEDIAN of per-pair ratios
+        # (robust to spiked pairs) and the ratio of interleaved SUMS
+        # (robust to a weather period covering several consecutive pairs,
+        # which moves the median but hits both sums equally). Host weather
+        # only inflates them in DIFFERENT failure modes, while a real
+        # tracing cost appears identically in both — so min() debiases the
+        # noise without hiding a regression.
+        med = sorted(ratios)[len(ratios) // 2]
+        return max(0.0, min(med, sums[True] / sums[False]) - 1.0)
+
+    for _ in range(10):
+        pair()
+    for _ in range(16):
+        if estimate() <= 0.01:
+            break
+        pair()
+    overhead = estimate()
+
+    # Stage breakdown: fresh recorder, traced gets, join with server ticks.
+    tracing.configure(enabled=True, capacity=512, slow_op_us=1)
+    for _ in range(10):
+        get_once(True, reps=1)
+    rec = tracing.recorder()
+    client_spans = [
+        s for s in rec.snapshot() if s["name"] == "batched_get"
+    ]
+    ticks = {
+        e["trace_id"]: e
+        for e in conn.get_stats().get("trace", {}).get("entries", [])
+    }
+    merged = []
+    joined = 0
+    for s in client_spans:
+        stages = list(s["stages"])
+        tick = ticks.get(s["trace_id"])
+        if tick is not None:
+            joined += 1
+            for field, stage in tracing.SERVER_TICK_STAGES.items():
+                if tick.get(field):
+                    stages.append([stage, tick[field]])
+        merged.append({**s, "stages": sorted(stages, key=lambda p: p[1])})
+    # The join-success rate is the REAL server-attribution signal the gate
+    # pins: per-span fractions sum to 1.0 by construction whatever stages
+    # exist, so a silently broken tick join would leave the sum green while
+    # the server-side stages vanish from the breakdown.
+    join_frac = joined / len(merged) if merged else 0.0
+    breakdown = tracing.stage_breakdown(merged)
+    fracs = {
+        "trace_frac_" + k.replace("->", "_to_"): round(v, 4)
+        for k, v in breakdown.items() if k != "total_us"
+    }
+    frac_sum = sum(v for k, v in breakdown.items() if k != "total_us")
+
+    # Manage plane: GET /trace (Chrome trace-event format) over real HTTP.
+    # The bench server is anonymous (start_local_server), so alias it into
+    # the module-level registry the manage plane reads, and restore after.
+    async def fetch_trace() -> dict:
+        cfg = ServerConfig(host="127.0.0.1", manage_port=0)
+        manage = ManageServer(cfg)
+        manage._server = await asyncio.start_server(
+            manage._handle, host="127.0.0.1", port=0
+        )
+        port = manage._server.sockets[0].getsockname()[1]
+        try:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(b"GET /trace?fmt=chrome HTTP/1.1\r\n\r\n")
+            await writer.drain()
+            raw = await reader.read()
+            writer.close()
+            return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+        finally:
+            manage._server.close()
+            await manage._server.wait_closed()
+
+    old_handle = its_lib._server_handle
+    its_lib._server_handle = srv.handle
+    try:
+        chrome = asyncio.run(fetch_trace())
+    finally:
+        its_lib._server_handle = old_handle
+    events = chrome.get("traceEvents", [])
+    assert events and all(
+        "ph" in e and "ts" in e and "pid" in e and "tid" in e for e in events
+    ), "GET /trace returned non-Chrome-trace payload"
+
+    slow_total = rec.slow_ops_total
+    tracing.configure(enabled=False)
+    conn.close()
+    return {
+        "trace_wire_identical": identical,
+        "trace_overhead_cost": round(overhead, 4),
+        "trace_on_s": round(times[True], 4),
+        "trace_off_s": round(times[False], 4),
+        "trace_stage_fraction_sum": round(frac_sum, 4),
+        "trace_server_join_fraction": round(join_frac, 4),
+        "trace_spans": len(merged),
+        "trace_endpoint_events": len(events),
+        "trace_slow_ops": slow_total,
+        "trace_stage_p50_total_us": round(breakdown.get("total_us", 0.0), 1),
+        **fracs,
+    }
+
+
 def _asyncio_efd_floor_us(iters: int = 1500) -> float:
     """The irreducible cost of waking an asyncio loop from another thread via
     eventfd + add_reader — the exact mechanism the async data plane's
@@ -1543,6 +1763,7 @@ def main(argv=None) -> int:
     spill = _spill_tier_gbps(its, np)
     contended = _contended_latency_us(its, np)
     qos = _qos_isolation_us(its, np)
+    trace = _trace_metrics(its, np, srv)
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
     churn = _membership_churn_metrics(its, np)
@@ -1633,6 +1854,14 @@ def main(argv=None) -> int:
         # interleaved; the ratio and the background throughput give-up are
         # both gated in tools/bench_check.py.
         **qos,
+        # End-to-end tracing (docs/observability.md): off-path wire
+        # byte-identity, tracing-on overhead (interleaved, gated <= 3%),
+        # the per-stage latency breakdown of the batched-get leg (the
+        # trace_frac_* fractions sum to ~1.0 of first->last stage wall
+        # time — the receipt that scopes the ROADMAP-2 descriptor-ring
+        # work), GET /trace Perfetto-event count, and the slow-op
+        # watchdog's capture count.
+        **trace,
         # Engine-shaped connector proof (BASELINE config 4 in spirit): the
         # continuous-batching harness at engine scale — 32 requests 8-way
         # concurrent under a MIXED hit/miss schedule (expected ~0.5), demo

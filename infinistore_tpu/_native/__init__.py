@@ -116,10 +116,12 @@ lib.its_conn_unregister_mr.restype = c_int
 lib.its_conn_alloc_shm_mr.argtypes = [c_void_p, c_uint64]
 lib.its_conn_alloc_shm_mr.restype = c_void_p
 # Trailing c_int: QoS class tag (0 = foreground/default, 1 = background —
-# wire.PRIORITY_*; see docs/qos.md).
+# wire.PRIORITY_*; see docs/qos.md). The two trailing c_uint64s are the
+# per-op trace context (trace id + client span id, docs/observability.md);
+# 0/0 = untraced, zero extra wire bytes.
 _batch_args = [
     c_void_p, c_char_p, c_uint64, c_uint32, POINTER(c_uint64), c_uint32, c_void_p,
-    COMPLETION_CB, c_void_p, c_int,
+    COMPLETION_CB, c_void_p, c_int, c_uint64, c_uint64,
 ]
 lib.its_conn_put_batch.argtypes = _batch_args
 lib.its_conn_put_batch.restype = c_int
@@ -127,6 +129,7 @@ lib.its_conn_get_batch.argtypes = _batch_args
 lib.its_conn_get_batch.restype = c_int
 _batch_sync_args = [
     c_void_p, c_char_p, c_uint64, c_uint32, POINTER(c_uint64), c_uint32, c_void_p, c_int,
+    c_uint64, c_uint64,
 ]
 lib.its_conn_put_batch_sync.argtypes = _batch_sync_args
 lib.its_conn_put_batch_sync.restype = c_int

@@ -64,6 +64,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import tracing
 from .connector import KVConnector, token_chain_hashes
 from .lib import (
     InfiniStoreException,
@@ -854,6 +855,10 @@ class ClusterKVConnector:
         answered)."""
         last: Optional[InfiniStoreException] = None
         answered = False
+        # Trace: record the routing outcome (which replica rank actually
+        # served) on the active span, so a cross-member failover is visible
+        # in the op's trace instead of only in aggregate health counters.
+        tspan = tracing.active_span()
         for rank, i in enumerate(candidates):
             if self._begin(i) is None:
                 continue
@@ -878,6 +883,8 @@ class ClusterKVConnector:
                 continue
             if rank:
                 self._health[i].replica_serves += 1
+            if tspan is not None:
+                tspan.annotate(cluster_member=i, cluster_rank=rank)
             return res
         if answered:
             # Every reachable candidate answered "miss": a legal cache
@@ -999,6 +1006,9 @@ class ClusterKVConnector:
                 self._done(i, None)  # see _read_failover: never wedge a probe
                 raise
             self._done(i, None)
+            tspan = tracing.active_span()
+            if tspan is not None:
+                tspan.annotate(cluster_member=i, cluster_rank=rank)
             if failover and res[1] == 0:
                 # Epoch-aware failover: a 0-block load before any scatter
                 # leaves the caches intact (KVConnector.load returns early
@@ -1040,6 +1050,9 @@ class ClusterKVConnector:
         if not candidates:
             return 0
         self._qos["bg_ops"] += 1
+        tspan = tracing.active_span()
+        if tspan is not None:
+            tspan.annotate(cluster_replicas=list(candidates))
         written = 0
         served = 0
         served_ids: List[str] = []

@@ -22,7 +22,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import wire
+from . import tracing, wire
 from .lib import (
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
@@ -173,9 +173,14 @@ class FetchCoalescer:
         returns a future resolving when those bytes are staged.
         ``priority``: QoS class (wire.PRIORITY_*) — submissions merge only
         with same-class peers, so a BACKGROUND speculative prefetch never
-        drags a FOREGROUND admission fetch into its service class."""
+        drags a FOREGROUND admission fetch into its service class.
+
+        Tracing: the submitter's active span is captured HERE — the flush
+        task inherits the contextvars of whichever submitter SCHEDULED it,
+        not of each merged peer — and stamped ``coalesce`` when its merged
+        batched call issues (docs/observability.md)."""
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((blocks, fut, priority))
+        self._pending.append((blocks, fut, priority, tracing.active_span()))
         self.submissions += 1
         if not self._flush_scheduled:
             self._flush_scheduled = True
@@ -190,19 +195,19 @@ class FetchCoalescer:
         rides alone — the data plane chunks it internally), partitioned by
         QoS class first so each merged call carries one honest tag."""
         by_class: dict = {}
-        for blocks, fut, priority in batch:
-            by_class.setdefault(priority, []).append((blocks, fut))
+        for blocks, fut, priority, span in batch:
+            by_class.setdefault(priority, []).append((blocks, fut, span))
         groups = []
         for priority, items in by_class.items():
             if not self.max_merge_blocks:
                 groups.append((priority, items))
                 continue
             cur, cur_blocks = [], 0
-            for blocks, fut in items:
+            for blocks, fut, span in items:
                 if cur and cur_blocks + len(blocks) > self.max_merge_blocks:
                     groups.append((priority, cur))
                     cur, cur_blocks = [], 0
-                cur.append((blocks, fut))
+                cur.append((blocks, fut, span))
                 cur_blocks += len(blocks)
             if cur:
                 groups.append((priority, cur))
@@ -220,12 +225,27 @@ class FetchCoalescer:
     async def _issue(self, batch, priority: int = 0):
         self.calls += 1
         self.max_batch = max(self.max_batch, len(batch))
-        merged = [b for blocks, _ in batch for b in blocks]
+        merged = [b for blocks, _, _ in batch for b in blocks]
         pri_kw = wire.qos_kwargs(self.conn, priority)
+        # Tracing: every merged submission stamps `coalesce` now; the
+        # merged wire op rides the FIRST traced submitter's context (one
+        # batched call carries one trace id — siblings still see their
+        # merge moment and group size). override_span, not use_span: this
+        # flush task INHERITS the scheduling submitter's contextvars, so a
+        # fully-untraced group must clear that inherited span or its wire
+        # op (and stamps) would be misattributed to an unrelated request.
+        lead_span = None
+        for _, _, span in batch:
+            if span is not None:
+                span.stage("coalesce")
+                span.annotate(coalesced_group=len(batch))
+                if lead_span is None:
+                    lead_span = span
         try:
-            await self.conn.read_cache_async(
-                merged, self.block_size, self.base_ptr, **pri_kw
-            )
+            with tracing.override_span(lead_span):
+                await self.conn.read_cache_async(
+                    merged, self.block_size, self.base_ptr, **pri_kw
+                )
         except Exception as e:
             # Per-submission retry exists to isolate ONE evicted/pressured
             # key from its group-mates. A transport error is different: the
@@ -237,24 +257,25 @@ class FetchCoalescer:
                 e, (InfiniStoreKeyNotFound, InfiniStoreResourcePressure)
             )
             if len(batch) == 1 or not retryable:
-                for blocks, fut in batch:
+                for blocks, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 return
-            for blocks, fut in batch:
+            for blocks, fut, span in batch:
                 if fut.done():
                     continue
                 self.calls += 1
                 try:
-                    await self.conn.read_cache_async(
-                        blocks, self.block_size, self.base_ptr, **pri_kw
-                    )
+                    with tracing.override_span(span):
+                        await self.conn.read_cache_async(
+                            blocks, self.block_size, self.base_ptr, **pri_kw
+                        )
                 except Exception as e2:
                     fut.set_exception(e2)
                 else:
                     fut.set_result(None)
             return
-        for _, fut in batch:
+        for _, fut, _ in batch:
             if not fut.done():
                 fut.set_result(None)
 
@@ -491,6 +512,12 @@ class KVConnector:
         n = min(hit - first_block, len(block_ids))
         if n <= 0:
             return list(caches), 0
+        # Trace: the cached prefix's store streaming begins here (the probe
+        # above is control-plane; fetch_start marks the first data-plane leg).
+        tspan = tracing.active_span()
+        if tspan is not None:
+            tspan.stage("fetch_start")
+            tspan.annotate(hit_blocks=hit, fetch_blocks=n)
         span = chains[first_block : first_block + n]
         try:
             out = await self._reader.read(
@@ -564,6 +591,11 @@ class KVConnector:
         if limit_blocks is not None:
             n = min(n, limit_blocks)
         pool = prefetch_pool or self._ensure_prefetch_pool()
+        # Trace: the gate-free layer streaming starts with the handle below.
+        tspan = tracing.active_span()
+        if tspan is not None and n > 0:
+            tspan.stage("fetch_start")
+            tspan.annotate(hit_blocks=hit, fetch_blocks=n)
         span = chains[first_block : first_block + n]
         # Mutable class cell so promote() upgrades LATER submissions even
         # on the coalescer path (the closure reads it per call).

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import tracing
 from .models.llama import prefill, prefill_continue, verify_step_batched
 from .tpu.paged import gather_blocks
 from .tpu.staging import StagingPoolExhausted
@@ -707,47 +708,63 @@ class ContinuousBatchingHarness:
         token_ids = list(token_ids)[: n_blocks * bt]
         self.live += 1
         self.max_live = max(self.max_live, self.live)
+        # Trace root for this request (docs/observability.md): `enqueue` is
+        # stamped at admission t0, `install` when fetched bytes land in the
+        # paged cache; every store op issued below (prefetch -> coalescer ->
+        # striped scheduler -> wire) becomes a child of this span via the
+        # bound context. With tracing off this is three no-op calls.
+        rspan = tracing.start_span("engine_request")
+        rtoken = tracing.bind_span(rspan)
+        if rspan is not None:
+            rspan.stage("enqueue")
+            rspan.annotate(tokens=len(token_ids), blocks=n_blocks)
         # Speculative prefetch AT ENQUEUE: probe + start streaming the hit
         # prefix into host staging before BlockPool.alloc even completes —
         # the store fetch overlaps this request's own admission wait and
         # every other request's compute, and NEVER holds the device gate.
         t0 = time.perf_counter()
         prefetch = None
+        prefetch_settled = True  # nothing to discard until a fetch starts
         fallback_hit: Optional[int] = None  # probe answer from a failed start_fetch
-        # getattr: adapters without a two-phase path (QuantizingKVAdapter)
-        # simply keep the one-phase gated load below. Prefer the async
-        # variant — it hops the probe RTT through an executor instead of
-        # blocking this loop mid-wave (ITS-L001).
-        starter = getattr(
-            self.adapter, "start_fetch_async",
-            getattr(self.adapter, "start_fetch", None),
-        )
-        starter_is_async = asyncio.iscoroutinefunction(starter)
-        if starter is not None:
-            # QoS: a request the block pool cannot admit right now is beyond
-            # the next wave — its speculative fetch is opportunistic, so it
-            # rides BACKGROUND class and never delays the current wave's
-            # decode-blocking reads. Requests that can start immediately
-            # keep the FOREGROUND (untagged) fetch. Only adapters that
-            # advertise the kwarg (QOS_AWARE) are tagged.
-            fetch_kw = {}
-            if getattr(self.adapter, "QOS_AWARE", False) and (
-                self.pool.available < total_blocks
-            ):
-                fetch_kw["priority"] = PRIORITY_BACKGROUND
-            try:
-                result = starter(token_ids, limit_blocks=n_blocks, **fetch_kw)
-                prefetch = await result if starter_is_async else result
-            except StagingPoolExhausted as e:
-                # Admission backpressure: the staging arena is carrying a
-                # full wave already — this request takes the gated load,
-                # reusing the probe the failed start_fetch already paid.
-                self.prefetch_fallbacks += 1
-                fallback_hit = getattr(e, "hit_blocks", None)
-        lookup_s = time.perf_counter() - t0  # start_fetch includes the probe
-        prefetch_settled = prefetch is None or prefetch.n_blocks == 0
         table = None
+        # One try for the whole admission (the speculative starter INCLUDED):
+        # a probe that dies on a dead store must still release the live
+        # count, unbind the trace context, and finish the request span —
+        # otherwise the task's later ops parent under a zombie span.
         try:
+            # getattr: adapters without a two-phase path (QuantizingKVAdapter)
+            # simply keep the one-phase gated load below. Prefer the async
+            # variant — it hops the probe RTT through an executor instead of
+            # blocking this loop mid-wave (ITS-L001).
+            starter = getattr(
+                self.adapter, "start_fetch_async",
+                getattr(self.adapter, "start_fetch", None),
+            )
+            starter_is_async = asyncio.iscoroutinefunction(starter)
+            if starter is not None:
+                # QoS: a request the block pool cannot admit right now is
+                # beyond the next wave — its speculative fetch is
+                # opportunistic, so it rides BACKGROUND class and never
+                # delays the current wave's decode-blocking reads. Requests
+                # that can start immediately keep the FOREGROUND (untagged)
+                # fetch. Only adapters that advertise the kwarg (QOS_AWARE)
+                # are tagged.
+                fetch_kw = {}
+                if getattr(self.adapter, "QOS_AWARE", False) and (
+                    self.pool.available < total_blocks
+                ):
+                    fetch_kw["priority"] = PRIORITY_BACKGROUND
+                try:
+                    result = starter(token_ids, limit_blocks=n_blocks, **fetch_kw)
+                    prefetch = await result if starter_is_async else result
+                except StagingPoolExhausted as e:
+                    # Admission backpressure: the staging arena is carrying a
+                    # full wave already — this request takes the gated load,
+                    # reusing the probe the failed start_fetch already paid.
+                    self.prefetch_fallbacks += 1
+                    fallback_hit = getattr(e, "hit_blocks", None)
+            lookup_s = time.perf_counter() - t0  # start_fetch includes the probe
+            prefetch_settled = prefetch is None or prefetch.n_blocks == 0
             table = await self.pool.alloc(total_blocks)
             if prefetch is not None:
                 # Admitted: a background-tagged speculative fetch is
@@ -777,6 +794,8 @@ class ContinuousBatchingHarness:
                             self.caches,
                             prompt_table[: prefetch.n_blocks],
                         )
+                        if rspan is not None:
+                            rspan.stage("install")
                         gate_hold_us = (time.perf_counter() - t_hold) * 1e6
                     prefetch_settled = True
                     t_end = prefetch.fetch_finished_s or time.perf_counter()
@@ -807,6 +826,8 @@ class ContinuousBatchingHarness:
                     self.caches, loaded_tokens = await self.adapter.load_kv(
                         token_ids, self.caches, prompt_table
                     )
+                    if rspan is not None and loaded_tokens:
+                        rspan.stage("install")
                     gate_hold_us = (time.perf_counter() - t_io) * 1e6
                     store_io_us = lookup_s * 1e6 + gate_hold_us
             admission_us = (time.perf_counter() - t0) * 1e6
@@ -890,7 +911,18 @@ class ContinuousBatchingHarness:
             )
             self.stats.append(stats)
             return stats
+        except BaseException as e:
+            # Explicit arm, not sys.exc_info()-in-finally: exc_info also
+            # reports a CALLER's already-being-handled exception during a
+            # normal return (a retry inside an except block would record a
+            # successful request as failed).
+            if rspan is not None:
+                rspan.finish(status=f"error:{type(e).__name__}")
+            raise
         finally:
+            tracing.unbind_span(rtoken)
+            if rspan is not None:
+                rspan.finish()  # idempotent: an error finish above wins
             if not prefetch_settled:
                 # Admission died between enqueue and install (cancellation,
                 # alloc backpressure unwound, model error): the speculative

@@ -30,7 +30,7 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from . import wire
+from . import tracing, wire
 from .wire import PRIORITY_BACKGROUND, PRIORITY_FOREGROUND  # noqa: F401 (re-export)
 from ._native import COMPLETION_CB, LOG_SINK_CB, lib
 from .config import (  # noqa: F401  (re-exported reference names)
@@ -836,8 +836,21 @@ class InfinityConnection:
         future = loop.create_future()
         token = next(_completion_token)
 
+        # Trace context (docs/observability.md): the active span — bound by
+        # the engine/connector/bench layer above — stamps `submit` here and
+        # `completion_ring` when its completion drains; its (trace id, span
+        # id) ride the wire so the server's tick ring records the same op.
+        # Tracing off: one module-bool check, wire bytes untouched.
+        span = tracing.active_span()
+        trace_id, span_id = tracing.wire_ids(span)
+        if span is not None:
+            span.stage("submit")
+            span.annotate(op=op_name, blocks=n, block_size=block_size)
+
         def on_done(fut, code):
             sem.release()
+            if span is not None:
+                span.stage("completion_ring")
             if fut.cancelled():
                 return
             if code == wire.STATUS_OK:
@@ -866,6 +879,8 @@ class InfinityConnection:
             _NULL_CB if use_ring else _on_complete,
             ctypes.c_void_p(token),
             priority,
+            trace_id,
+            span_id,
         )
         if rc != 0:
             _completions.pop(token, None)
@@ -957,10 +972,20 @@ class InfinityConnection:
         keys_blob = wire.encode_keys_blob(list(keys))
         n = len(keys)
         offs = (ctypes.c_uint64 * n)(*offsets)
+        # Sync path trace stamps: submit before the blocking native call,
+        # completion_ring right after it returns (the calling thread IS the
+        # completion wait — there is no ring drain to stamp separately).
+        span = tracing.active_span()
+        trace_id, span_id = tracing.wire_ids(span)
+        if span is not None:
+            span.stage("submit")
+            span.annotate(op=op_name, blocks=n, block_size=block_size)
         rc = native_fn(
             self._handle, keys_blob, len(keys_blob), n, offs, block_size,
-            ctypes.c_void_p(ptr), priority,
+            ctypes.c_void_p(ptr), priority, trace_id, span_id,
         )
+        if span is not None:
+            span.stage("completion_ring")
         if rc == 0:
             return wire.STATUS_OK
         if rc == -wire.STATUS_KEY_NOT_FOUND:
@@ -1180,10 +1205,19 @@ class InfinityConnection:
           ``bg_queued``, plus the ``bg_cooldown_us``/``bg_aging_us``
           tunables — the two-class slice scheduler (docs/qos.md);
         - ``suspended_ops`` — sliced ops parked in the reactor;
+        - ``trace``: the server-side trace tick ring
+          (docs/observability.md) — ``recorded``/``dropped`` ring
+          counters and ``entries``, each ``{trace_id, parent_id, op,
+          prio, ok, recv_us, first_slice_us, last_slice_us, done_us,
+          bytes}`` — the ticks ``GET /trace`` joins to client spans;
         - ``ops``: per-opcode ``count``, ``errors``, ``bytes_in``,
-          ``bytes_out``, ``total_us``, ``p50_us``, ``p99_us``."""
+          ``bytes_out``, ``total_us``, ``p50_us``, ``p99_us``, and
+          ``hist_us`` — sparse ``[le_us, count]`` latency buckets
+          (base-2 octaves, 32 sub-buckets, ~2% resolution; the
+          ``infinistore_op_duration_us`` histogram /metrics renders,
+          and what the p50/p99 gauges are derived from)."""
         self._require()
-        buf = ctypes.create_string_buffer(64 << 10)
+        buf = ctypes.create_string_buffer(256 << 10)
         n = lib.its_conn_stat_json(self._handle, buf, len(buf))
         if n < 0:
             raise InfiniStoreException("stat query failed")
@@ -1627,10 +1661,21 @@ class StripedConnection:
                     count += descs.popleft().count
                 remaining[0] -= count
                 chunk = blocks[start : start + count]
+                # Trace: each claimed span is a child span of the batched
+                # op's — `stripe_claim` marks the moment this stripe took
+                # the work; the chunk's own wire op stamps submit/
+                # completion_ring under it (docs/observability.md).
+                chunk_span = tracing.start_span(f"{meth_name}:chunk")
+                if chunk_span is not None:
+                    chunk_span.stage("stripe_claim")
+                    chunk_span.annotate(stripe=idx, start=start, count=count)
                 t0 = time.perf_counter()
                 try:
-                    await bound(chunk, block_size, ptr, **pri_kw)
+                    with tracing.use_span(chunk_span):
+                        await bound(chunk, block_size, ptr, **pri_kw)
                 except BaseException as e:
+                    if chunk_span is not None:
+                        chunk_span.finish(status=f"error:{type(e).__name__}")
                     if self._is_stripe_transport_error(e):
                         # Give the claimed span back (quantum granularity,
                         # so the survivors' tail splitting stays fine) and
@@ -1649,6 +1694,8 @@ class StripedConnection:
                     else:
                         fatal.append((idx, e))
                     return
+                if chunk_span is not None:
+                    chunk_span.finish()
                 dt = time.perf_counter() - t0
                 if dt > 0:
                     bps = count * block_size / dt
@@ -2081,7 +2128,7 @@ def evict_cache(min_threshold: float, max_threshold: float) -> int:
 
 
 def get_server_stats() -> dict:
-    buf = ctypes.create_string_buffer(64 << 10)
+    buf = ctypes.create_string_buffer(256 << 10)
     n = lib.its_server_stats_json(_require_server(), buf, len(buf))
     if n < 0:
         raise InfiniStoreException("stats query failed")

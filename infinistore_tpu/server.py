@@ -23,8 +23,10 @@ import dataclasses
 import json
 import signal
 import sys
+import urllib.parse
 
 from . import lib as _lib
+from . import tracing
 from .config import ServerConfig
 from .lib import Logger, register_server, unregister_server
 
@@ -152,6 +154,24 @@ def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
             "# TYPE infinistore_qos_bg_aging_us gauge",
             f"infinistore_qos_bg_aging_us {qos['bg_aging_us']}",
         ]
+    # Tracing surfaces (docs/observability.md): the client flight
+    # recorder's counters (span volume + the slow-op watchdog) and the
+    # server-side trace tick ring's coverage counters. The spans/ticks
+    # themselves are served by GET /trace, not scraped.
+    rec = tracing.recorder()
+    tr = stats.get("trace", {})
+    lines += [
+        "# TYPE infinistore_trace_slow_ops_total counter",
+        f"infinistore_trace_slow_ops_total {rec.slow_ops_total if rec else 0}",
+        "# TYPE infinistore_trace_spans_recorded counter",
+        f"infinistore_trace_spans_recorded {rec.recorded if rec else 0}",
+        "# TYPE infinistore_trace_spans_dropped counter",
+        f"infinistore_trace_spans_dropped {rec.dropped if rec else 0}",
+        "# TYPE infinistore_trace_server_ticks_recorded counter",
+        f"infinistore_trace_server_ticks_recorded {tr.get('recorded', 0)}",
+        "# TYPE infinistore_trace_server_ticks_dropped counter",
+        f"infinistore_trace_server_ticks_dropped {tr.get('dropped', 0)}",
+    ]
     # Exposition format requires all samples of a family in one uninterrupted
     # group after its TYPE line — one pass per family, not per op.
     ops = sorted(stats.get("ops", {}).items())
@@ -167,6 +187,27 @@ def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
     lines.append("# TYPE infinistore_op_time_us counter")
     for op, s in ops:
         lines.append(f'infinistore_op_time_us{{op="{op}"}} {s["total_us"]}')
+    # Proper log-bucketed latency HISTOGRAM per op (base-2 octaves, 32
+    # sub-buckets = ~2% resolution — native OpStats::lat_buckets, exported
+    # sparse as [le_us, count]): dashboards can aggregate/re-quantile it,
+    # which the old p99 point-gauges could not. The cumulative `le` walk +
+    # +Inf/_sum/_count triplet is the Prometheus histogram contract.
+    lines.append("# TYPE infinistore_op_duration_us histogram")
+    for op, s in ops:
+        cum = 0
+        for le, cnt in s.get("hist_us", []):
+            cum += cnt
+            lines.append(
+                f'infinistore_op_duration_us_bucket{{op="{op}",le="{le}"}} {cum}'
+            )
+        lines.append(
+            f'infinistore_op_duration_us_bucket{{op="{op}",le="+Inf"}} {s["count"]}'
+        )
+        lines.append(f'infinistore_op_duration_us_sum{{op="{op}"}} {s["total_us"]}')
+        lines.append(f'infinistore_op_duration_us_count{{op="{op}"}} {s["count"]}')
+    # p50/p99 stay as DERIVED gauges (computed natively from the same
+    # buckets) so existing dashboards and the bench_check gates keep their
+    # names; the histogram above is the primary surface.
     lines.append("# TYPE infinistore_op_p50_latency_us gauge")
     for op, s in ops:
         lines.append(f'infinistore_op_p50_latency_us{{op="{op}"}} {s["p50_us"]}')
@@ -240,12 +281,48 @@ def _membership_prometheus_lines(ms: dict) -> list:
     ]
 
 
+def _trace_payload(stats: dict, fmt: str = "json") -> bytes:
+    """GET /trace body: recent spans from the process flight recorder
+    joined with the local server's trace tick ring (``stats["trace"]``).
+
+    ``fmt="json"`` (default) returns the span/tick dump plus the stage
+    schema (``tracing.STAGES`` — the vocabulary the ITS-T checker holds
+    producers and docs to); ``fmt="chrome"`` returns Chrome trace-event
+    format — save the body to a file and load it in Perfetto
+    (https://ui.perfetto.dev) or chrome://tracing
+    (docs/observability.md)."""
+    trace = stats.get("trace", {})
+    server_spans = tracing.server_tick_spans(trace)
+    rec = tracing.recorder()
+    client_spans = rec.snapshot() if rec is not None else []
+    if fmt == "chrome":
+        payload = {
+            "traceEvents": tracing.chrome_trace_events(
+                client_spans + server_spans
+            ),
+            "displayTimeUnit": "ms",
+        }
+        return _http_response(200, payload)
+    return _http_response(200, {
+        "enabled": tracing.enabled(),
+        "stages": list(tracing.STAGES),
+        "spans": client_spans,
+        "server_spans": server_spans,
+        "slow_ops": rec.slow_snapshot() if rec is not None else [],
+        "slow_ops_total": rec.slow_ops_total if rec is not None else 0,
+        "recorded": rec.recorded if rec is not None else 0,
+        "dropped": rec.dropped if rec is not None else 0,
+        "server_recorded": trace.get("recorded", 0),
+        "server_dropped": trace.get("dropped", 0),
+    })
+
+
 class ManageServer:
     """The management plane: /purge, /kvmap_len (reference server.py:25-39),
     /selftest (advertised in reference README.md:56-57 but missing), /stats,
-    /usage, /metrics (Prometheus), /health — plus, with a cluster attached,
-    /membership GET/POST (the elastic-membership control surface,
-    docs/membership.md).
+    /usage, /metrics (Prometheus), /health, /trace (the op-tracing dump,
+    docs/observability.md) — plus, with a cluster attached, /membership
+    GET/POST (the elastic-membership control surface, docs/membership.md).
 
     ``cluster``: an optional ``ClusterKVConnector``-shaped object (needs
     ``membership`` / ``resharder`` / ``membership_status()`` / ``health()``
@@ -305,7 +382,7 @@ class ManageServer:
                 pass
 
     async def _route(self, method: str, path: str, body: bytes = b"") -> bytes:
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         try:
             if path == "/purge" and method == "POST":
                 count = await asyncio.to_thread(_lib.purge_kv_map)
@@ -343,6 +420,17 @@ class ManageServer:
                 return _prometheus_text(stats, membership_status=ms)
             if path == "/health" and method == "GET":
                 return _http_response(200, {"status": "ok"})
+            if path == "/trace" and method == "GET":
+                # Recent op spans (flight recorder + native tick ring):
+                # default JSON dump, ?fmt=chrome for Perfetto. A manage
+                # plane with no local store still serves the client spans.
+                try:
+                    stats = await asyncio.to_thread(_lib.get_server_stats)
+                except Exception:
+                    stats = {}
+                params = urllib.parse.parse_qs(query)
+                fmt = "chrome" if params.get("fmt") == ["chrome"] else "json"
+                return _trace_payload(stats, fmt)
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
             if path == "/membership" and method == "GET":
@@ -350,7 +438,7 @@ class ManageServer:
             if path == "/membership" and method == "POST":
                 return await self._membership_post(body)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
-                        "/selftest", "/health", "/membership"):
+                        "/selftest", "/health", "/trace", "/membership"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request

@@ -57,6 +57,17 @@ _RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 byte
 PRIORITY_FOREGROUND = 0
 PRIORITY_BACKGROUND = 1
 
+# End-to-end op tracing (docs/observability.md): a per-op trace context —
+# u64 trace id + u64 parent span id — rides BatchMeta/SegBatchMeta as a
+# SECOND trailing optional extension AFTER the QoS priority byte. An
+# untraced op (trace_id == 0, the default) appends nothing and stays
+# byte-identical to the pre-trace format; a traced op must therefore also
+# emit the priority byte (even FOREGROUND's 0) so the decoder's
+# read-while-bytes-remain walk stays unambiguous. TRACE_ID_NONE is the
+# wire's "untraced" sentinel — real trace ids are never zero
+# (tracing._new_id).
+TRACE_ID_NONE = 0
+
 
 def qos_kwargs(conn, priority: int) -> dict:
     """Kwargs for tagging a batched op on ``conn`` with ``priority``.
@@ -155,11 +166,17 @@ class BatchMeta:
     block_size: int = 0
     keys: List[str] = field(default_factory=list)
     priority: int = PRIORITY_FOREGROUND
+    # Trace context extension (second trailing optional group — see
+    # TRACE_ID_NONE above): 0/0 encodes nothing.
+    trace_id: int = TRACE_ID_NONE
+    trace_parent: int = 0
 
     def encode(self) -> bytes:
         out = struct.pack("<I", self.block_size) + encode_str_list(self.keys)
-        if self.priority:
+        if self.priority or self.trace_id:
             out += struct.pack("<B", self.priority)
+        if self.trace_id:
+            out += struct.pack("<QQ", self.trace_id, self.trace_parent)
         return out
 
     @classmethod
@@ -168,6 +185,9 @@ class BatchMeta:
         m = cls(block_size=r.u32(), keys=r.str_list())
         if not r.done:
             m.priority = r.u8()
+        if not r.done:
+            m.trace_id = r.u64()
+            m.trace_parent = r.u64()
         return m
 
 
@@ -258,14 +278,19 @@ class SegBatchMeta:
     keys: List[str] = field(default_factory=list)
     offsets: List[int] = field(default_factory=list)
     priority: int = PRIORITY_FOREGROUND
+    # Trace context extension (after the priority byte; see BatchMeta).
+    trace_id: int = TRACE_ID_NONE
+    trace_parent: int = 0
 
     def encode(self) -> bytes:
         out = [struct.pack("<IH", self.block_size, self.seg_id)]
         out.append(encode_str_list(self.keys))
         out.append(struct.pack("<I", len(self.offsets)))
         out.extend(struct.pack("<Q", off) for off in self.offsets)
-        if self.priority:
+        if self.priority or self.trace_id:
             out.append(struct.pack("<B", self.priority))
+        if self.trace_id:
+            out.append(struct.pack("<QQ", self.trace_id, self.trace_parent))
         return b"".join(out)
 
     @classmethod
@@ -275,6 +300,9 @@ class SegBatchMeta:
         m.offsets = [r.u64() for _ in range(r.u32())]
         if not r.done:
             m.priority = r.u8()
+        if not r.done:
+            m.trace_id = r.u64()
+            m.trace_parent = r.u64()
         return m
 
 

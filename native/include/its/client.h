@@ -90,15 +90,23 @@ class Connection {
     // Priority): kPriorityForeground (default) leaves the wire bytes
     // untouched; kPriorityBackground marks the op for the server's
     // two-level slice scheduler (docs/qos.md).
+    // ``trace_id``/``trace_span``: per-op trace context (protocol.h
+    // kTraceIdNone) — 0/0 (the default) leaves the wire bytes untouched; a
+    // non-zero trace id rides the trailing trace extension and the server
+    // reactor stamps recv/slice/done ticks for it into its trace ring
+    // (docs/observability.md). ``trace_span`` is the CLIENT span the
+    // server ticks hang under (wire field trace_parent).
     int put_batch_async(const std::vector<std::string>& keys,
                         const std::vector<uint64_t>& offsets, uint32_t block_size,
                         void* base_ptr, CompletionCb cb, void* ctx,
-                        uint8_t priority = kPriorityForeground);
+                        uint8_t priority = kPriorityForeground,
+                        uint64_t trace_id = kTraceIdNone, uint64_t trace_span = 0);
     // Async batched block read into base_ptr+offsets[i].
     int get_batch_async(const std::vector<std::string>& keys,
                         const std::vector<uint64_t>& offsets, uint32_t block_size,
                         void* base_ptr, CompletionCb cb, void* ctx,
-                        uint8_t priority = kPriorityForeground);
+                        uint8_t priority = kPriorityForeground,
+                        uint64_t trace_id = kTraceIdNone, uint64_t trace_span = 0);
 
     // Sync batched ops: same pipeline, but the calling thread blocks on the
     // completion (promise wait — no event-loop hop). This is the low-latency
@@ -111,10 +119,12 @@ class Connection {
     // and alive until close() (true for staging pools by construction).
     int put_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
                   uint32_t block_size, void* base_ptr,
-                  uint8_t priority = kPriorityForeground);
+                  uint8_t priority = kPriorityForeground,
+                  uint64_t trace_id = kTraceIdNone, uint64_t trace_span = 0);
     int get_batch(const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
                   uint32_t block_size, void* base_ptr,
-                  uint8_t priority = kPriorityForeground);
+                  uint8_t priority = kPriorityForeground,
+                  uint64_t trace_id = kTraceIdNone, uint64_t trace_span = 0);
 
     // Sync ops (safe to call from any thread; they ride the same pipeline).
     int tcp_put(const std::string& key, const void* data, size_t size);
@@ -179,11 +189,13 @@ class Connection {
     std::unique_ptr<Request> build_put(const std::vector<std::string>& keys,
                                        const std::vector<uint64_t>& offsets,
                                        uint32_t block_size, void* base_ptr,
-                                       uint8_t priority);
+                                       uint8_t priority, uint64_t trace_id,
+                                       uint64_t trace_span);
     std::unique_ptr<Request> build_get(const std::vector<std::string>& keys,
                                        const std::vector<uint64_t>& offsets,
                                        uint32_t block_size, void* base_ptr,
-                                       uint8_t priority);
+                                       uint8_t priority, uint64_t trace_id,
+                                       uint64_t trace_span);
     void shm_handshake();
     char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);
     // Reactor-side: handle a PutAlloc/GetLoc response. Returns the request

@@ -68,6 +68,15 @@ enum Priority : uint8_t {
     kPriorityBackground = 1,
 };
 
+// End-to-end op tracing (docs/observability.md): a per-op trace context —
+// u64 trace id + u64 parent span id — rides BatchMeta/SegBatchMeta as a
+// SECOND trailing optional extension AFTER the QoS priority byte. An
+// untraced op (trace_id == 0, the default) appends nothing — byte-identical
+// to the pre-trace format — and a traced op also emits the priority byte
+// (even 0) so the trailing-optional walk stays unambiguous. Real trace ids
+// are never zero (tracing.py _new_id).
+constexpr uint64_t kTraceIdNone = 0;
+
 // HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
 enum Status : uint32_t {
     kStatusOk = 200,
@@ -181,12 +190,18 @@ struct BatchMeta {
     uint32_t block_size = 0;
     std::vector<std::string> keys;
     uint8_t priority = kPriorityForeground;  // optional trailing byte; 0 = untagged
+    uint64_t trace_id = kTraceIdNone;  // optional trailing trace context; 0 = untraced
+    uint64_t trace_parent = 0;
 
     void encode(std::vector<uint8_t>& out) const {
         WireWriter w(out);
         w.u32(block_size);
         w.str_list(keys);
-        if (priority != kPriorityForeground) w.u8(priority);
+        if (priority != kPriorityForeground || trace_id != kTraceIdNone) w.u8(priority);
+        if (trace_id != kTraceIdNone) {
+            w.u64(trace_id);
+            w.u64(trace_parent);
+        }
     }
     static BatchMeta decode(const uint8_t* data, size_t size) {
         WireReader r(data, size);
@@ -194,6 +209,10 @@ struct BatchMeta {
         m.block_size = r.u32();
         m.keys = r.str_list();
         if (!r.done()) m.priority = r.u8();
+        if (!r.done()) {
+            m.trace_id = r.u64();
+            m.trace_parent = r.u64();
+        }
         return m;
     }
 };
@@ -342,6 +361,8 @@ struct SegBatchMeta {
     std::vector<std::string> keys;
     std::vector<uint64_t> offsets;
     uint8_t priority = kPriorityForeground;  // optional trailing byte; 0 = untagged
+    uint64_t trace_id = kTraceIdNone;  // optional trailing trace context (see BatchMeta)
+    uint64_t trace_parent = 0;
 
     void encode(std::vector<uint8_t>& out) const {
         WireWriter w(out);
@@ -350,7 +371,11 @@ struct SegBatchMeta {
         w.str_list(keys);
         w.u32(static_cast<uint32_t>(offsets.size()));
         for (uint64_t off : offsets) w.u64(off);
-        if (priority != kPriorityForeground) w.u8(priority);
+        if (priority != kPriorityForeground || trace_id != kTraceIdNone) w.u8(priority);
+        if (trace_id != kTraceIdNone) {
+            w.u64(trace_id);
+            w.u64(trace_parent);
+        }
     }
     static SegBatchMeta decode(const uint8_t* data, size_t size) {
         WireReader r(data, size);
@@ -362,6 +387,10 @@ struct SegBatchMeta {
         m.offsets.reserve(n);
         for (uint32_t i = 0; i < n; i++) m.offsets.push_back(r.u64());
         if (!r.done()) m.priority = r.u8();
+        if (!r.done()) {
+            m.trace_id = r.u64();
+            m.trace_parent = r.u64();
+        }
         return m;
     }
 };

@@ -88,10 +88,13 @@ struct ServerConfig {
 // all; we make latency/throughput first-class). Histogram buckets are log2 of
 // microseconds: bucket i covers [2^i, 2^(i+1)) us.
 struct OpStats {
-    // HDR-style histogram: 8 sub-buckets per octave caps quantization error
-    // at ~9% (vs 2x for plain power-of-two buckets) at 512*8 bytes per op.
-    static constexpr int kSubBits = 3;
-    static constexpr int kBuckets = 512;
+    // HDR-style histogram: 32 sub-buckets per octave caps quantization
+    // error at ~2% (base-2 octaves, 2^(1/32) ~= 1.022 steps) at 2048*8
+    // bytes per op — the resolution the derived p50/p99 gauges and the
+    // /metrics infinistore_op_duration_us histogram export inherit
+    // (docs/observability.md).
+    static constexpr int kSubBits = 5;
+    static constexpr int kBuckets = 2048;
 
     uint64_t count = 0;
     uint64_t errors = 0;
@@ -104,6 +107,26 @@ struct OpStats {
     double percentile_us(double q) const;
     double p50_us() const { return percentile_us(0.50); }
     double p99_us() const { return percentile_us(0.99); }
+    // Inclusive upper bound (Prometheus `le`) of bucket ``idx`` in us.
+    static uint64_t bucket_le_us(int idx);
+};
+
+// One traced op's server-side tick record (docs/observability.md): the
+// reactor stamps these for any op whose metadata carried a non-zero trace
+// id, into a bounded ring exported through stats_json()["trace"]. Stage
+// names on the shared vocabulary: recv_us = server_recv, first/last_us =
+// first_slice/last_slice (tracing.SERVER_TICK_STAGES).
+struct TraceTick {
+    uint64_t trace_id = 0;
+    uint64_t parent_id = 0;  // the client span the op rode (wire trace_parent)
+    uint8_t op = 0;
+    uint8_t prio = 0;
+    bool ok = true;
+    uint64_t recv_us = 0;   // request fully read, op dispatched
+    uint64_t first_us = 0;  // first payload/slice unit of work
+    uint64_t last_us = 0;   // last payload/slice unit of work
+    uint64_t done_us = 0;   // response enqueued (or error recorded)
+    uint64_t bytes = 0;     // payload bytes moved (either direction)
 };
 
 class Server {
@@ -246,6 +269,20 @@ class Server {
     std::vector<std::unique_ptr<Conn>> graveyard_;
     std::unordered_map<uint8_t, OpStats> stats_;
     uint64_t conns_accepted_ = 0;
+
+    // Trace tick ring (docs/observability.md): server_recv/first_slice/
+    // last_slice/done stamps for ops that carried a wire trace context.
+    // Reactor-thread-only (stats_json reads it via call()); untraced ops
+    // never touch it beyond one per-op branch.
+    static constexpr int kTraceRing = 128;
+    TraceTick trace_ring_[kTraceRing];
+    uint64_t trace_next_ = 0;     // total ticks ever recorded
+    uint64_t trace_dropped_ = 0;  // ticks the full ring overwrote
+    // Per-op stamps live on the Conn (one op in flight per connection);
+    // these helpers are no-ops for untraced ops (trace_id == 0).
+    void trace_begin(Conn* c, uint64_t trace_id, uint64_t parent, uint8_t prio);
+    void trace_slice(Conn* c);
+    void trace_finish(Conn* c, uint64_t bytes, bool ok);
 };
 
 }  // namespace its

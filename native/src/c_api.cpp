@@ -158,48 +158,60 @@ void its_conn_completion_counters(void* c, uint64_t* pushed, uint64_t* signalled
 // ``priority``: QoS class tag (its::Priority) — 0 foreground (default
 // scheduling, wire bytes unchanged), 1 background (yields to foreground in
 // the server's two-level slice scheduler; see docs/qos.md).
+// ``trace_id``/``trace_span``: per-op trace context (docs/observability.md)
+// — 0/0 (the default/untraced case) adds ZERO wire bytes; non-zero rides
+// the trailing trace extension and the server stamps recv/slice/done ticks
+// for the op into its trace ring (stats_json "trace").
 int its_conn_put_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,
-                       its::CompletionCb cb, void* ctx, int priority) {
+                       its::CompletionCb cb, void* ctx, int priority,
+                       uint64_t trace_id, uint64_t trace_span) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->put_batch_async(keys, offs, block_size, base_ptr,
                                                             cb, ctx,
-                                                            static_cast<uint8_t>(priority));
+                                                            static_cast<uint8_t>(priority),
+                                                            trace_id, trace_span);
     }, -1);
 }
 int its_conn_get_batch(void* c, const uint8_t* keys_blob, uint64_t blob_len, uint32_t nkeys,
                        const uint64_t* offsets, uint32_t block_size, void* base_ptr,
-                       its::CompletionCb cb, void* ctx, int priority) {
+                       its::CompletionCb cb, void* ctx, int priority,
+                       uint64_t trace_id, uint64_t trace_span) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->get_batch_async(keys, offs, block_size, base_ptr,
                                                             cb, ctx,
-                                                            static_cast<uint8_t>(priority));
+                                                            static_cast<uint8_t>(priority),
+                                                            trace_id, trace_span);
     }, -1);
 }
 // Sync batched ops: calling thread blocks on completion (no asyncio hop) —
 // the low-latency path for small fetches. Returns 0 or -status.
 int its_conn_put_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
                             uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
-                            void* base_ptr, int priority) {
+                            void* base_ptr, int priority,
+                            uint64_t trace_id, uint64_t trace_span) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->put_batch(keys, offs, block_size, base_ptr,
-                                                      static_cast<uint8_t>(priority));
+                                                      static_cast<uint8_t>(priority),
+                                                      trace_id, trace_span);
     }, -static_cast<int>(its::kStatusInvalidReq));
 }
 int its_conn_get_batch_sync(void* c, const uint8_t* keys_blob, uint64_t blob_len,
                             uint32_t nkeys, const uint64_t* offsets, uint32_t block_size,
-                            void* base_ptr, int priority) {
+                            void* base_ptr, int priority,
+                            uint64_t trace_id, uint64_t trace_span) {
     return guarded([&]() -> int {
         auto keys = parse_keys_blob(keys_blob, blob_len, nkeys);
         std::vector<uint64_t> offs(offsets, offsets + nkeys);
         return static_cast<Connection*>(c)->get_batch(keys, offs, block_size, base_ptr,
-                                                      static_cast<uint8_t>(priority));
+                                                      static_cast<uint8_t>(priority),
+                                                      trace_id, trace_span);
     }, -static_cast<int>(its::kStatusInvalidReq));
 }
 int its_conn_tcp_put(void* c, const char* key, const void* data, uint64_t size) {

@@ -399,7 +399,8 @@ int Connection::submit(std::unique_ptr<Request> req) {
 
 std::unique_ptr<Connection::Request> Connection::build_put(
     const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-    uint32_t block_size, void* base_ptr, uint8_t priority) {
+    uint32_t block_size, void* base_ptr, uint8_t priority, uint64_t trace_id,
+    uint64_t trace_span) {
     if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
@@ -417,6 +418,8 @@ std::unique_ptr<Connection::Request> Connection::build_put(
         m.seg_id = seg->id;
         m.keys = keys;
         m.priority = priority;
+        m.trace_id = trace_id;
+        m.trace_parent = trace_span;
         m.offsets.reserve(offsets.size());
         uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
         for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
@@ -426,7 +429,7 @@ std::unique_ptr<Connection::Request> Connection::build_put(
         bool shm = shm_ok_.load();
         req->op = shm ? kOpPutAlloc : kOpPutBatch;
         req->payload_on_wire = !shm;  // shm: blocks are memcpy'd after PutAlloc
-        BatchMeta meta{block_size, keys, priority};
+        BatchMeta meta{block_size, keys, priority, trace_id, trace_span};
         meta.encode(req->body);
         req->tx_payload.reserve(keys.size());
         for (uint64_t off : offsets)
@@ -438,8 +441,9 @@ std::unique_ptr<Connection::Request> Connection::build_put(
 int Connection::put_batch_async(const std::vector<std::string>& keys,
                                 const std::vector<uint64_t>& offsets, uint32_t block_size,
                                 void* base_ptr, CompletionCb cb, void* ctx,
-                                uint8_t priority) {
-    auto req = build_put(keys, offsets, block_size, base_ptr, priority);
+                                uint8_t priority, uint64_t trace_id, uint64_t trace_span) {
+    auto req = build_put(keys, offsets, block_size, base_ptr, priority, trace_id,
+                         trace_span);
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
@@ -448,8 +452,10 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
 
 int Connection::put_batch(const std::vector<std::string>& keys,
                           const std::vector<uint64_t>& offsets, uint32_t block_size,
-                          void* base_ptr, uint8_t priority) {
-    auto req = build_put(keys, offsets, block_size, base_ptr, priority);
+                          void* base_ptr, uint8_t priority, uint64_t trace_id,
+                          uint64_t trace_span) {
+    auto req = build_put(keys, offsets, block_size, base_ptr, priority, trace_id,
+                         trace_span);
     if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
     uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
     return status == kStatusOk ? 0 : -static_cast<int>(status);
@@ -457,7 +463,8 @@ int Connection::put_batch(const std::vector<std::string>& keys,
 
 std::unique_ptr<Connection::Request> Connection::build_get(
     const std::vector<std::string>& keys, const std::vector<uint64_t>& offsets,
-    uint32_t block_size, void* base_ptr, uint8_t priority) {
+    uint32_t block_size, void* base_ptr, uint8_t priority, uint64_t trace_id,
+    uint64_t trace_span) {
     if (keys.empty() || keys.size() != offsets.size()) return nullptr;
     uint64_t span = 0;
     for (uint64_t off : offsets) span = std::max(span, off + block_size);
@@ -474,13 +481,15 @@ std::unique_ptr<Connection::Request> Connection::build_get(
         m.seg_id = seg->id;
         m.keys = keys;
         m.priority = priority;
+        m.trace_id = trace_id;
+        m.trace_parent = trace_span;
         m.offsets.reserve(offsets.size());
         uint64_t base_off = static_cast<char*>(base_ptr) - seg->base;
         for (uint64_t off : offsets) m.offsets.push_back(base_off + off);
         m.encode(req->body);
     } else {
         req->op = shm_ok_.load() ? kOpGetLoc : kOpGetBatch;
-        BatchMeta meta{block_size, keys, priority};
+        BatchMeta meta{block_size, keys, priority, trace_id, trace_span};
         meta.encode(req->body);
         req->block_size = block_size;
         req->rx_addrs.reserve(keys.size());
@@ -493,8 +502,9 @@ std::unique_ptr<Connection::Request> Connection::build_get(
 int Connection::get_batch_async(const std::vector<std::string>& keys,
                                 const std::vector<uint64_t>& offsets, uint32_t block_size,
                                 void* base_ptr, CompletionCb cb, void* ctx,
-                                uint8_t priority) {
-    auto req = build_get(keys, offsets, block_size, base_ptr, priority);
+                                uint8_t priority, uint64_t trace_id, uint64_t trace_span) {
+    auto req = build_get(keys, offsets, block_size, base_ptr, priority, trace_id,
+                         trace_span);
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
@@ -503,8 +513,10 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
 
 int Connection::get_batch(const std::vector<std::string>& keys,
                           const std::vector<uint64_t>& offsets, uint32_t block_size,
-                          void* base_ptr, uint8_t priority) {
-    auto req = build_get(keys, offsets, block_size, base_ptr, priority);
+                          void* base_ptr, uint8_t priority, uint64_t trace_id,
+                          uint64_t trace_span) {
+    auto req = build_get(keys, offsets, block_size, base_ptr, priority, trace_id,
+                         trace_span);
     if (req == nullptr) return -static_cast<int>(kStatusInvalidReq);
     uint32_t status = sync_roundtrip(std::move(req), nullptr, nullptr, nullptr);
     return status == kStatusOk ? 0 : -static_cast<int>(status);

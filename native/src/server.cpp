@@ -43,14 +43,26 @@ int lat_bucket(uint64_t us) {
     return idx < OpStats::kBuckets ? idx : OpStats::kBuckets - 1;
 }
 
-// Geometric midpoint of a bucket (inverse of lat_bucket).
-double lat_bucket_mid(int idx) {
+// Inverse bucket geometry (single source for every decoder of lat_bucket's
+// index space): bucket ``idx`` covers [base, base + step).
+void lat_bucket_range(int idx, uint64_t* base, uint64_t* step) {
     constexpr int sub = OpStats::kSubBits;
-    if (idx < (1 << sub)) return static_cast<double>(idx);
+    if (idx < (1 << sub)) {
+        *base = static_cast<uint64_t>(idx);
+        *step = 1;
+        return;
+    }
     int group = (idx - (1 << sub)) >> sub;
     int s = (idx - (1 << sub)) & ((1 << sub) - 1);
-    uint64_t base = (static_cast<uint64_t>((1 << sub) + s)) << group;
-    uint64_t step = 1ull << group;
+    *base = (static_cast<uint64_t>((1 << sub) + s)) << group;
+    *step = 1ull << group;
+}
+
+// Geometric midpoint of a bucket (inverse of lat_bucket).
+double lat_bucket_mid(int idx) {
+    uint64_t base, step;
+    lat_bucket_range(idx, &base, &step);
+    if (step == 1) return static_cast<double>(base);
     return static_cast<double>(base) + static_cast<double>(step) / 2.0;
 }
 
@@ -63,6 +75,14 @@ void OpStats::record(uint64_t us, uint64_t in_bytes, uint64_t out_bytes, bool ok
     bytes_out += out_bytes;
     total_us += us;
     lat_buckets[lat_bucket(us)]++;
+}
+
+uint64_t OpStats::bucket_le_us(int idx) {
+    // Inclusive integer upper bound of lat_bucket's bucket ``idx`` (the
+    // Prometheus `le` the /metrics histogram export uses).
+    uint64_t base, step;
+    lat_bucket_range(idx, &base, &step);
+    return base + step - 1;
 }
 
 double OpStats::percentile_us(double q) const {
@@ -104,6 +124,16 @@ struct Server::Conn {
 
     uint8_t cur_op = 0;
     uint64_t op_start_us = 0;
+
+    // Per-op trace stamps (docs/observability.md): set by trace_begin when
+    // the metadata carried a wire trace context, published to the server's
+    // tick ring by trace_finish. Zero trace_id = untraced (every stamp
+    // site is a single-branch no-op).
+    uint64_t trace_id = 0;
+    uint64_t trace_parent = 0;
+    uint64_t trace_prio = 0;
+    uint64_t trace_first_us = 0;
+    uint64_t trace_last_us = 0;
 
     struct OutMsg {
         RespHeader hdr;
@@ -345,7 +375,28 @@ std::string Server::stats_json() {
               ",\"bg_cooldown_us\":" + std::to_string(config_.bg_cooldown_us) +
               ",\"bg_aging_us\":" + std::to_string(config_.bg_aging_us) + "}" +
               ",\"suspended_ops\":" + std::to_string(cont_fg_.size() + cont_bg_.size()) +
-              ",\"ops\":{";
+              // Server-side trace tick ring (docs/observability.md): the
+              // manage plane's /trace endpoint joins these to client spans
+              // by trace id; recorded/dropped size the ring's coverage.
+              ",\"trace\":{\"recorded\":" + std::to_string(trace_next_) +
+              ",\"dropped\":" + std::to_string(trace_dropped_) +
+              ",\"entries\":[";
+        uint64_t t0 = trace_next_ > kTraceRing ? trace_next_ - kTraceRing : 0;
+        for (uint64_t i = t0; i < trace_next_; i++) {
+            const TraceTick& t = trace_ring_[i % kTraceRing];
+            if (i != t0) out += ",";
+            out += "{\"trace_id\":" + std::to_string(t.trace_id) +
+                   ",\"parent_id\":" + std::to_string(t.parent_id) +
+                   ",\"op\":\"" + std::string(1, static_cast<char>(t.op)) + "\"" +
+                   ",\"prio\":" + std::to_string(t.prio) +
+                   ",\"ok\":" + std::to_string(t.ok ? 1 : 0) +
+                   ",\"recv_us\":" + std::to_string(t.recv_us) +
+                   ",\"first_slice_us\":" + std::to_string(t.first_us) +
+                   ",\"last_slice_us\":" + std::to_string(t.last_us) +
+                   ",\"done_us\":" + std::to_string(t.done_us) +
+                   ",\"bytes\":" + std::to_string(t.bytes) + "}";
+        }
+        out += "]},\"ops\":{";
         bool first = true;
         for (const auto& [op, s] : stats_) {
             if (!first) out += ",";
@@ -357,7 +408,23 @@ std::string Server::stats_json() {
                    ",\"bytes_out\":" + std::to_string(s.bytes_out) +
                    ",\"total_us\":" + std::to_string(s.total_us) +
                    ",\"p50_us\":" + std::to_string(s.p50_us()) +
-                   ",\"p99_us\":" + std::to_string(s.p99_us()) + "}";
+                   ",\"p99_us\":" + std::to_string(s.p99_us()) +
+                   // Sparse non-empty latency buckets as [le_us, count]
+                   // pairs (le inclusive; base-2 octaves, 32 sub-buckets =
+                   // ~2% resolution) — the /metrics exporter renders the
+                   // cumulative infinistore_op_duration_us histogram from
+                   // these, and the p50/p99 gauges above are derived from
+                   // the same buckets.
+                   ",\"hist_us\":[";
+            bool hfirst = true;
+            for (int b = 0; b < OpStats::kBuckets; b++) {
+                if (s.lat_buckets[b] == 0) continue;
+                if (!hfirst) out += ",";
+                hfirst = false;
+                out += "[" + std::to_string(OpStats::bucket_le_us(b)) + "," +
+                       std::to_string(s.lat_buckets[b]) + "]";
+            }
+            out += "]}";
         }
         out += "}}";
     });
@@ -503,6 +570,49 @@ void Server::note_op(uint8_t prio) {
     if (prio != kPriorityBackground) last_fg_us_ = now_us();
 }
 
+// ---------------------------------------------------------------------------
+// Trace ticks (docs/observability.md). Begin on dispatch of a traced op,
+// slice on every unit of payload/slice work, finish where the op's stats
+// record — pushing {recv, first_slice, last_slice, done} into the ring the
+// manage plane's /trace endpoint joins to client spans by trace id.
+// ---------------------------------------------------------------------------
+
+void Server::trace_begin(Conn* c, uint64_t trace_id, uint64_t parent,
+                         uint8_t prio) {
+    c->trace_id = trace_id;
+    if (trace_id == 0) return;
+    c->trace_parent = parent;
+    c->trace_prio = prio;
+    c->trace_first_us = 0;
+    c->trace_last_us = 0;
+}
+
+void Server::trace_slice(Conn* c) {
+    if (c->trace_id == 0) return;
+    uint64_t now = now_us();
+    if (c->trace_first_us == 0) c->trace_first_us = now;
+    c->trace_last_us = now;
+}
+
+void Server::trace_finish(Conn* c, uint64_t bytes, bool ok) {
+    if (c->trace_id == 0) return;
+    TraceTick& t = trace_ring_[trace_next_ % kTraceRing];
+    if (trace_next_ >= kTraceRing) trace_dropped_++;
+    t.trace_id = c->trace_id;
+    t.parent_id = c->trace_parent;
+    t.op = c->cur_op;
+    t.prio = static_cast<uint8_t>(c->trace_prio);
+    t.ok = ok;
+    t.recv_us = c->op_start_us;
+    t.first_us = c->trace_first_us;
+    t.last_us = c->trace_last_us;
+    t.done_us = now_us();
+    t.bytes = bytes;
+    trace_next_++;
+    c->trace_id = 0;
+    c->trace_parent = 0;
+}
+
 bool Server::bg_must_defer() const {
     return !cont_fg_.empty() || now_us() - last_fg_us_ < config_.bg_cooldown_us;
 }
@@ -579,6 +689,7 @@ void Server::suspend_for_cont(Conn* c) {
 // Under pressure: bank a budget-sized chunk per slice — banked BlockRefs
 // cannot be stolen by concurrent allocators, so progress is monotone.
 void Server::run_putalloc_slice(Conn* c) {
+    trace_slice(c);
     Conn::SegCont& ct = *c->cont;
     const size_t n = ct.m.keys.size();
     const size_t bs = ct.m.block_size;
@@ -630,6 +741,9 @@ void Server::run_putalloc_slice(Conn* c) {
     pending.start_us = c->op_start_us;
     pending.blocks = std::move(ct.blocks);
     c->pending_puts.emplace(resp.ticket, std::move(pending));
+    // The tick spans the alloc leg (the client memcpy + commit are their
+    // own untraced wire ops); the op-latency stat still spans alloc->commit.
+    trace_finish(c, 0, true);
     c->cont.reset();
     arm_read(c, true);
     send_loc_resp(c, resp, dir);
@@ -698,6 +812,7 @@ Server::PinResult Server::pin_slice(
 // One budget slice of a suspended GetLoc (see pin_slice for the budget
 // discipline).
 void Server::run_getloc_slice(Conn* c) {
+    trace_slice(c);
     Conn::SegCont& ct = *c->cont;
     const size_t bs = ct.m.block_size;
     if (pin_slice(c, [bs](size_t, const BlockRef& b) {
@@ -725,6 +840,7 @@ void Server::run_getloc_slice(Conn* c) {
     }
     c->pending_gets.emplace(resp.ticket, std::move(ct.blocks));
     stats_[kOpGetLoc].record(now_us() - c->op_start_us, 0, total, true);
+    trace_finish(c, total, true);
     c->cont.reset();
     arm_read(c, true);
     send_loc_resp(c, resp, dir);
@@ -754,6 +870,7 @@ void Server::run_cont_slice(Conn* c) {
     const size_t bs = ct.m.block_size;
     const size_t budget_blocks = std::max<size_t>(1, config_.slice_bytes / bs);
 
+    trace_slice(c);  // one tick per PutFrom/GetInto budget slice
     if (ct.op == kOpPutFrom) {
         if (ct.phase == Conn::SegCont::Phase::kAlloc) {
             size_t chunk = std::min(budget_blocks, n - ct.idx);
@@ -785,6 +902,7 @@ void Server::run_cont_slice(Conn* c) {
         if (ct.copied == n) {
             stats_[kOpPutFrom].record(now_us() - c->op_start_us,
                                       static_cast<uint64_t>(n) * bs, 0, true);
+            trace_finish(c, static_cast<uint64_t>(n) * bs, true);
             c->cont.reset();
             arm_read(c, true);
             c->reset_read();
@@ -820,6 +938,7 @@ void Server::run_cont_slice(Conn* c) {
             total += b->size();
         }
         stats_[kOpGetInto].record(now_us() - c->op_start_us, 0, total, true);
+        trace_finish(c, total, true);
         c->cont.reset();
         arm_read(c, true);
         c->reset_read();
@@ -913,6 +1032,7 @@ void Server::conn_readable(Conn* c) {
                     return;
                 }
                 c->rx_cur.advance(c->rx_iov, static_cast<size_t>(r));
+                trace_slice(c);  // one tick per readv of a traced put payload
                 if (c->rx_cur.done(c->rx_iov)) {
                     finish_payload(c);
                     if (c->dead) return;
@@ -1040,6 +1160,9 @@ bool Server::alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases) {
 void Server::handle_put_batch(Conn* c) {
     BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
     size_t n = m.keys.size();
+    // Trace begins at decode so even an op failing validation/404/507
+    // closes its server tick (send_status finishes it as not-ok).
+    trace_begin(c, m.trace_id, m.trace_parent, m.priority);
     if (n == 0 || m.block_size == 0) {
         c->reset_read();
         send_status(c, kStatusInvalidReq);
@@ -1127,6 +1250,7 @@ void Server::handle_shm(Conn* c) {
         }
         case kOpPutAlloc: {
             BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+            trace_begin(c, m.trace_id, m.trace_parent, m.priority);
             size_t n = m.keys.size();
             if (n == 0 || m.block_size == 0 || !mm_->shm_enabled()) {
                 c->reset_read();
@@ -1186,6 +1310,7 @@ void Server::handle_shm(Conn* c) {
         }
         case kOpGetLoc: {
             BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+            trace_begin(c, m.trace_id, m.trace_parent, m.priority);
             if (m.keys.empty() || m.block_size == 0 || !mm_->shm_enabled()) {
                 c->reset_read();
                 send_status(c, kStatusInvalidReq);
@@ -1265,6 +1390,7 @@ void Server::handle_shm(Conn* c) {
             // across loop ticks (run_cont_slice) so other connections are
             // served in between.
             SegBatchMeta m = SegBatchMeta::decode(c->body.data(), c->body.size());
+            trace_begin(c, m.trace_id, m.trace_parent, m.priority);
             size_t n = m.keys.size();
             auto seg_it = c->segments.find(m.seg_id);
             if (n == 0 || m.block_size == 0 || n != m.offsets.size() ||
@@ -1298,6 +1424,7 @@ void Server::handle_shm(Conn* c) {
             // memcpys run budget-sliced, all-or-nothing before the first
             // segment write (pin phase completes before any copy).
             SegBatchMeta m = SegBatchMeta::decode(c->body.data(), c->body.size());
+            trace_begin(c, m.trace_id, m.trace_parent, m.priority);
             if (m.keys.empty() || m.block_size == 0 || m.keys.size() != m.offsets.size() ||
                 c->segments.find(m.seg_id) == c->segments.end()) {
                 c->reset_read();
@@ -1339,12 +1466,14 @@ void Server::finish_payload(Conn* c) {
     uint8_t op = c->cur_op;
     uint64_t us = now_us() - c->op_start_us;
     stats_[op].record(us, in_bytes, 0, true);
+    trace_finish(c, in_bytes, true);
     c->reset_read();
     send_resp(c, kStatusOk, {}, {}, {});
 }
 
 void Server::handle_get_batch(Conn* c) {
     BatchMeta m = BatchMeta::decode(c->body.data(), c->body.size());
+    trace_begin(c, m.trace_id, m.trace_parent, m.priority);
     if (m.keys.empty() || m.block_size == 0) {
         c->reset_read();
         send_status(c, kStatusInvalidReq);
@@ -1387,6 +1516,9 @@ void Server::handle_get_batch(Conn* c) {
     uint8_t op = c->cur_op;
     uint64_t us = now_us() - c->op_start_us;
     stats_[op].record(us, 0, total, true);
+    // The whole gather assembled in one pass: first and last slice coincide.
+    trace_slice(c);
+    trace_finish(c, total, true);
     c->reset_read();
     send_resp(c, kStatusOk, std::move(body), std::move(payload), std::move(refs));
 }
@@ -1449,6 +1581,10 @@ void Server::handle_simple(Conn* c) {
 
 void Server::send_status(Conn* c, uint32_t status) {
     if (status != kStatusOk) stats_[c->cur_op].record(now_us() - c->op_start_us, 0, 0, false);
+    // A traced op erroring out (404/507/400, finish_cont, drain) still
+    // closes its server tick — the client span's error status gets its
+    // server-side timeline either way.
+    trace_finish(c, 0, status == kStatusOk);
     send_resp(c, status, {}, {}, {});
 }
 

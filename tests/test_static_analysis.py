@@ -26,7 +26,14 @@ REPO = Path(__file__).resolve().parent.parent
 if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
-from tools.analysis import core, counters, loop_block, policy, wire_drift  # noqa: E402
+from tools.analysis import (  # noqa: E402
+    core,
+    counters,
+    loop_block,
+    policy,
+    trace_stages,
+    wire_drift,
+)
 
 
 def make_tree(tmp_path, files):
@@ -78,9 +85,11 @@ class TestWireDrift:
         for name in ("BatchMeta", "SegBatchMeta", "ShmLocResp", "SegMeta",
                      "TcpPutMeta", "TicketMeta", "KeyMeta", "KeyListMeta"):
             assert name in cpp.structs and name in py.structs
-        # The QoS tag is an OPTIONAL trailing byte on both batch metas.
-        assert cpp.structs["BatchMeta"][-1] == "u8?"
-        assert cpp.structs["SegBatchMeta"][-1] == "u8?"
+        # The QoS tag is an OPTIONAL trailing byte on both batch metas,
+        # followed by the OPTIONAL trace-context pair (trace id + parent).
+        assert cpp.structs["BatchMeta"][-3:] == ["u8?", "u64?", "u64?"]
+        assert py.structs["BatchMeta"][-3:] == ["u8?", "u64?", "u64?"]
+        assert cpp.structs["SegBatchMeta"][-3:] == ["u8?", "u64?", "u64?"]
 
     def test_changed_field_width_is_caught(self, tmp_path):
         ctx = drifted_ctx(tmp_path, header_sub=(
@@ -522,7 +531,7 @@ class TestFramework:
         payload = json.loads(out.read_text())
         assert payload["failed"] is False
         assert set(payload["per_checker"]) == {
-            "counters", "loop_block", "policy", "wire_drift",
+            "counters", "loop_block", "policy", "trace_stages", "wire_drift",
         }
         assert payload["counts"]["new"] == 0
 
@@ -738,3 +747,149 @@ class TestCountersMembership:
         ctx = core.Context(str(REPO))
         found = [f for f in counters.scan(ctx) if f.rule == "ITS-C005"]
         assert found == []
+
+
+# ---------------------------------------------------------------------------
+# trace_stages (ITS-T*)
+# ---------------------------------------------------------------------------
+
+T_TRACING = '''\
+STAGES = (
+    "enqueue",
+    "submit",
+    "server_recv",
+)
+
+SERVER_TICK_STAGES = {
+    "recv_us": "server_recv",
+}
+'''
+
+T_PRODUCER = '''\
+def run(span, tracing):
+    span.stage("enqueue")
+    with tracing.trace_op("op", stage="submit"):
+        pass
+'''
+
+T_MANAGE = '''\
+def _trace_payload(stats):
+    return {"stages": list(STAGES)}
+
+
+def route(path):
+    if path == "/trace":
+        return _trace_payload({})
+'''
+
+T_CPP = '''\
+void Server::stats_json() {
+    out += ",\\"recv_us\\":" + std::to_string(t.recv_us);
+}
+'''
+
+
+class TestTraceStages:
+    def _tree(self, tmp_path, **overrides):
+        files = {
+            "infinistore_tpu/tracing.py": T_TRACING,
+            "infinistore_tpu/prod.py": T_PRODUCER,
+            "infinistore_tpu/server.py": T_MANAGE,
+            "docs/observability.md": "stages: enqueue submit server_recv\n",
+            "native/src/server.cpp": T_CPP,
+        }
+        files.update(overrides)
+        return make_tree(tmp_path, files)
+
+    def test_clean_fixture(self, tmp_path):
+        assert trace_stages.scan(self._tree(tmp_path)) == []
+
+    def test_unknown_producer_stage_fires(self, tmp_path):
+        ctx = self._tree(tmp_path, **{
+            "infinistore_tpu/prod.py":
+                T_PRODUCER.replace('"enqueue"', '"mystery_stage"'),
+        })
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T001" and "mystery_stage" in f.key for f in found
+        )
+
+    def test_trace_op_stage_kwarg_is_scanned(self, tmp_path):
+        ctx = self._tree(tmp_path, **{
+            "infinistore_tpu/prod.py":
+                T_PRODUCER.replace('stage="submit"', 'stage="kw_rogue"'),
+        })
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T001" and "kw_rogue" in f.key for f in found
+        )
+
+    def test_undocumented_stage_fires(self, tmp_path):
+        ctx = self._tree(
+            tmp_path, **{"docs/observability.md": "stages: enqueue submit\n"}
+        )
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T002" and f.key.endswith("server_recv")
+            for f in found
+        )
+
+    def test_missing_trace_route_fires(self, tmp_path):
+        ctx = self._tree(tmp_path, **{
+            "infinistore_tpu/server.py": T_MANAGE.replace('"/trace"', '"/nope"'),
+        })
+        found = trace_stages.scan(ctx)
+        assert any(f.key.endswith("trace-route") for f in found)
+
+    def test_tick_map_outside_vocabulary_fires(self, tmp_path):
+        ctx = self._tree(tmp_path, **{
+            "infinistore_tpu/tracing.py":
+                T_TRACING.replace('"recv_us": "server_recv"',
+                                  '"recv_us": "not_a_stage"'),
+        })
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T003" and f.key.endswith("tick:recv_us")
+            for f in found
+        )
+
+    def test_native_tick_field_missing_fires(self, tmp_path):
+        ctx = self._tree(
+            tmp_path, **{"native/src/server.cpp": "void nothing() {}\n"}
+        )
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T003" and f.key.endswith("native:recv_us")
+            for f in found
+        )
+
+    def test_dead_vocabulary_fires(self, tmp_path):
+        ctx = self._tree(tmp_path, **{
+            "infinistore_tpu/tracing.py": T_TRACING.replace(
+                '"submit",', '"submit",\n    "never_stamped",'
+            ),
+            "docs/observability.md":
+                "stages: enqueue submit server_recv never_stamped\n",
+        })
+        found = trace_stages.scan(ctx)
+        assert any(
+            f.rule == "ITS-T004" and f.key.endswith("dead:never_stamped")
+            for f in found
+        )
+
+    def test_real_tree_is_clean_modulo_docs(self):
+        """The real repo's producers, tick map, /trace schema and native
+        emitter are in lockstep (T002 pends only on docs/observability.md
+        existing — covered by the clean-suite acceptance test)."""
+        found = [
+            f for f in trace_stages.scan(core.Context(str(REPO)))
+            if f.rule != "ITS-T002"
+        ]
+        assert found == []
+
+    def test_real_vocabulary_inventory(self):
+        stages, tick_map = trace_stages.recorder_stages(core.Context(str(REPO)))
+        assert stages[0] == "enqueue" and "stripe_claim" in stages
+        assert set(tick_map.values()) == {
+            "server_recv", "first_slice", "last_slice",
+        }

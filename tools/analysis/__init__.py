@@ -11,10 +11,13 @@ Four project-specific checkers over invariants unit tests can only sample
   exporters and the API reference — no silent observability drift.
 - ``policy``      (ITS-P*): transport-error handling routes through the
   degrade policy; batched-op producers pass an explicit QoS class.
+- ``trace_stages`` (ITS-T*): every stage name a tracing producer stamps
+  must exist in tracing.STAGES, the /trace schema and
+  docs/observability.md — the span vocabulary never drifts one-sided.
 
 Importing the subpackage registers every checker with core.CHECKERS.
 """
 
 from . import core  # noqa: F401
-from . import counters, loop_block, policy, wire_drift  # noqa: F401
+from . import counters, loop_block, policy, trace_stages, wire_drift  # noqa: F401
 from .core import CHECKERS, Context, Finding, run  # noqa: F401

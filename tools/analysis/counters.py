@@ -71,6 +71,17 @@ MEMBERSHIP_LEDGERS: List[str] = [
 ]
 MEMBERSHIP_EXPORT_FN = "_membership_prometheus_lines"
 
+# Trace-surface exporters (docs/observability.md): the /trace payload
+# builder consumes the native ring's counters from the stats snapshot, and
+# tracing.server_tick_spans consumes every per-entry tick field (its first
+# argument IS the snapshot's "trace" subtree — hence the prefix). Their
+# consumption unions with /metrics for the ITS-C001/C002 cross-checks:
+# trace ticks reach dashboards through GET /trace, not a scrape.
+TRACE_EXPORTERS: List[Tuple[str, str, str]] = [
+    ("infinistore_tpu/server.py", "_trace_payload", ""),
+    ("infinistore_tpu/tracing.py", "server_tick_spans", "trace"),
+]
+
 
 # ---------------------------------------------------------------------------
 # Native side: reconstruct the stats_json() key tree from the C++ string
@@ -126,6 +137,18 @@ def _skeleton_keys(skeleton: str) -> Set[str]:
             pending = None
             i += 1
             continue
+        if c == "[":
+            # Array value: the key itself is a leaf (exporters consume the
+            # list), and objects INSIDE it contribute keys under
+            # ``<key>.*`` (e.g. trace.entries.*.recv_us).
+            if pending is not None:
+                keys.add(".".join([s for s in stack if s] + [pending]))
+                stack.append(pending + ".*")
+            else:
+                stack.append(None)
+            pending = None
+            i += 1
+            continue
         if pending is not None and c not in " \t\n":
             # A leaf value begins (or the literal skeleton jumps straight
             # to the closing brace around a dynamic value): record the
@@ -134,7 +157,7 @@ def _skeleton_keys(skeleton: str) -> Set[str]:
             keys.add(".".join([s for s in stack if s] + [pending]))
             pending = None
             continue
-        if c == "}" and stack:
+        if c in "}]" and stack:
             stack.pop()
         i += 1
     return keys
@@ -145,7 +168,12 @@ def _skeleton_keys(skeleton: str) -> Set[str]:
 # ---------------------------------------------------------------------------
 
 def metrics_consumed_keys(ctx: Context, rel: str = MANAGE_REL,
-                          fn_name: str = "_prometheus_text") -> Set[str]:
+                          fn_name: str = "_prometheus_text",
+                          prefix: str = "") -> Set[str]:
+    """Stats keys the named exporter function consumes (literal subscripts
+    and .get()s reachable from its first argument). ``prefix`` roots the
+    first argument at a subtree of the stats snapshot — e.g.
+    ``tracing.server_tick_spans(server_trace)`` consumes under ``trace``."""
     tree = ast.parse(ctx.read(rel))
     fn = next(
         (
@@ -158,7 +186,7 @@ def metrics_consumed_keys(ctx: Context, rel: str = MANAGE_REL,
     if fn is None:
         return set()
     arg0 = fn.args.args[0].arg if fn.args.args else "stats"
-    ctx_of: Dict[str, str] = {arg0: ""}
+    ctx_of: Dict[str, str] = {arg0: prefix}
     consumed: Set[str] = set()
 
     def sub_key(node) -> Optional[Tuple[str, str]]:
@@ -298,6 +326,11 @@ def scan(
     findings: List[Finding] = []
     native = native_stats_keys(ctx, server_cpp_rel)
     consumed = metrics_consumed_keys(ctx, manage_rel)
+    for rel, fn_name, prefix in TRACE_EXPORTERS:
+        if ctx.exists(rel):
+            consumed |= metrics_consumed_keys(
+                ctx, rel, fn_name=fn_name, prefix=prefix
+            )
     docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
     doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
 

@@ -1,0 +1,186 @@
+"""ITS-T*: trace stage vocabulary lockstep across producers, schema, docs.
+
+The tracing layer (infinistore_tpu/tracing.py, docs/observability.md) works
+only if every layer agrees on the stage names: a producer stamping a name
+the /trace schema does not list yields spans dashboards cannot interpret,
+and a renamed stage that docs/observability.md still describes is silent
+observability drift — the same one-sided-edit failure the counters checker
+(ITS-C) guards for metric keys. This pass extracts:
+
+- the recorder constants — ``tracing.STAGES`` (the canonical tuple) and
+  ``tracing.SERVER_TICK_STAGES`` (native tick field -> stage name),
+- every stage literal a PRODUCER stamps: ``<span>.stage("...")`` calls and
+  ``stage="..."`` keywords to ``trace_op`` anywhere under infinistore_tpu/
+  plus bench.py,
+- the /trace schema surface (``server.py`` must serve the route from the
+  STAGES vocabulary),
+- the documented vocabulary of docs/observability.md,
+
+and cross-checks them:
+
+- ITS-T001 a producer stamps a stage name missing from tracing.STAGES
+- ITS-T002 a STAGES name is missing from docs/observability.md
+- ITS-T003 /trace schema drift: the manage plane must serve GET /trace
+  with the STAGES vocabulary (tracing.STAGES referenced in the payload),
+  and every SERVER_TICK_STAGES value must be a STAGES member
+- ITS-T004 a STAGES name no producer ever stamps (dead vocabulary — the
+  tuple, the docs and the dashboards describe a stage that cannot occur)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Context, Finding, register
+
+TRACING_REL = "infinistore_tpu/tracing.py"
+MANAGE_REL = "infinistore_tpu/server.py"
+DOCS_REL = "docs/observability.md"
+SERVER_CPP_REL = "native/src/server.cpp"
+
+# Producer scan roots: every Python file here may stamp stages.
+PRODUCER_ROOTS = ["infinistore_tpu"]
+PRODUCER_EXTRA = ["bench.py"]
+
+
+def recorder_stages(ctx: Context, rel: str = TRACING_REL) -> Tuple[List[str], Dict[str, str]]:
+    """(STAGES tuple, SERVER_TICK_STAGES dict) from the tracing module."""
+    tree = ast.parse(ctx.read(rel))
+    stages: List[str] = []
+    tick_map: Dict[str, str] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name == "STAGES" and isinstance(node.value, (ast.Tuple, ast.List)):
+            stages = [
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        elif name == "SERVER_TICK_STAGES" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    tick_map[k.value] = v.value
+    return stages, tick_map
+
+
+def producer_stamps(ctx: Context) -> List[Tuple[str, int, str]]:
+    """Every (file, line, stage_name) a producer stamps: ``X.stage("n")``
+    calls and ``stage="n"`` keywords (trace_op's entry stamp)."""
+    out: List[Tuple[str, int, str]] = []
+    files: List[str] = []
+    for root in PRODUCER_ROOTS:
+        files += ctx.walk_py(root)
+    files += [f for f in PRODUCER_EXTRA if ctx.exists(f)]
+    for rel in files:
+        if rel == TRACING_REL:
+            continue  # the module itself (docstrings/constants), not a producer
+        try:
+            tree = ast.parse(ctx.read(rel))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "stage"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                out.append((rel, node.lineno, node.args[0].value))
+            for kw in node.keywords:
+                if (
+                    kw.arg == "stage"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                ):
+                    out.append((rel, node.lineno, kw.value.value))
+    return out
+
+
+def scan(
+    ctx: Context,
+    tracing_rel: str = TRACING_REL,
+    manage_rel: str = MANAGE_REL,
+    docs_rel: str = DOCS_REL,
+    server_cpp_rel: str = SERVER_CPP_REL,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.exists(tracing_rel):
+        return findings
+    stages, tick_map = recorder_stages(ctx, tracing_rel)
+    stage_set: Set[str] = set(stages)
+
+    def f(rule: str, file: str, line: int, slug: str, msg: str):
+        findings.append(Finding(rule=rule, file=file, line=line, message=msg,
+                                key=f"{rule}:{file}:{slug}"))
+
+    # ITS-T001: producer stamps outside the vocabulary.
+    stamps = producer_stamps(ctx)
+    for rel, line, name in sorted(stamps):
+        if name not in stage_set:
+            f("ITS-T001", rel, line, name,
+              f"producer stamps stage {name!r} which is not in "
+              f"tracing.STAGES — add it to the vocabulary (and "
+              f"{docs_rel}) or fix the stamp")
+
+    # ITS-T002: vocabulary undocumented.
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+    for name in stages:
+        if name not in doc_words:
+            f("ITS-T002", docs_rel, 1, name,
+              f"stage {name!r} (tracing.STAGES) is not described in "
+              f"{docs_rel} — the span vocabulary table must cover every "
+              "stage")
+
+    # ITS-T003: /trace schema drift.
+    manage_src = ctx.read(manage_rel) if ctx.exists(manage_rel) else ""
+    if (
+        not re.search(r'[\'"]/trace[\'"]', manage_src)
+        or "_trace_payload" not in manage_src
+    ):
+        f("ITS-T003", manage_rel, 1, "trace-route",
+          "manage plane must serve GET /trace (via _trace_payload) — the "
+          "span dump + Chrome trace export surface (docs/observability.md)")
+    if "STAGES" not in manage_src:
+        f("ITS-T003", manage_rel, 1, "trace-schema",
+          "/trace payload must serve the stage schema (tracing.STAGES) so "
+          "consumers can interpret spans without reading the source")
+    for field, name in sorted(tick_map.items()):
+        if name not in stage_set:
+            f("ITS-T003", tracing_rel, 1, f"tick:{field}",
+              f"SERVER_TICK_STAGES maps native tick {field!r} to "
+              f"{name!r}, which is not in tracing.STAGES")
+    # The native reactor must emit every tick field the mapping names.
+    cpp_src = ctx.read(server_cpp_rel) if ctx.exists(server_cpp_rel) else ""
+    for field in sorted(tick_map):
+        if f'\\"{field}\\"' not in cpp_src and f'"{field}"' not in cpp_src:
+            f("ITS-T003", server_cpp_rel, 1, f"native:{field}",
+              f"native stats_json trace entries do not emit {field!r}, "
+              "but tracing.SERVER_TICK_STAGES maps it — the /trace join "
+              "would silently drop the stage")
+
+    # ITS-T004: dead vocabulary. Native-stamped stages count via tick_map.
+    produced = {name for _, _, name in stamps} | set(tick_map.values())
+    for name in stages:
+        if name not in produced:
+            f("ITS-T004", tracing_rel, 1, f"dead:{name}",
+              f"stage {name!r} is in tracing.STAGES but no producer ever "
+              "stamps it — dead vocabulary (docs and dashboards describe "
+              "a stage that cannot occur)")
+    return findings
+
+
+@register("trace_stages",
+          "trace stage vocabulary in lockstep across producers, /trace schema and docs (ITS-T*)",
+          rule_prefix="ITS-T")
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)

@@ -192,7 +192,10 @@ def _scan_cpp_encode(body: str) -> List[str]:
             pending = {"kind": "for", "out": []}
         elif m.group("ifkw") is not None:
             cond_end = body.index(")", m.end())
-            optional = "priority" in body[m.end(): cond_end]
+            cond = body[m.end(): cond_end]
+            # Trailing-optional extension writers: the QoS priority byte
+            # (PR 4) and the trace-context group (gated on trace_id).
+            optional = "priority" in cond or "trace" in cond
             pending = {"kind": "if-opt" if optional else "if", "out": None}
         elif m.group("open") is not None:
             if pending is not None:
@@ -392,7 +395,7 @@ def _scan_py_encode(fn: ast.FunctionDef) -> List[str]:
                 fields.append(tok + ("?" if optional else ""))
         elif isinstance(stmt, ast.If):
             cond_src = ast.dump(stmt.test)
-            opt = "priority" in cond_src
+            opt = "priority" in cond_src or "trace" in cond_src
             for s in stmt.body:
                 scan_stmt(s, optional or opt)
         elif isinstance(stmt, ast.Return) and stmt.value is not None:

@@ -200,13 +200,62 @@ CHECKS = [
             "with QoS on (must be >= 2x)"
         ),
     ),
+    # Calibration (2026-08-04): honest history 14-19%, but the same leg on
+    # the PRE-tracing HEAD measured 21.0%/20.2% back-to-back that day
+    # (host weather — the tracing tree measured 21.2% in the same window,
+    # i.e. no change), so 0.20 sat ON the honest distribution and flaked.
+    # 0.25 stays far below the pathologies this gate exists for (the
+    # polling-gate resume-lag regression alone cost background ~15-23% ON
+    # TOP of the steady cost; a scheduler silently starving background
+    # shows up as aged-slice starvation and a cost way past 30%).
     Check(
         "qos_bg_cost",
         ["qos_bg_throughput_cost"],
-        lambda m: m["qos_bg_throughput_cost"] <= 0.20,
+        lambda m: m["qos_bg_throughput_cost"] <= 0.25,
         lambda m: (
             f"background gives up {100 * m['qos_bg_throughput_cost']:.1f}% "
-            "throughput under QoS (must be <= 20%)"
+            "throughput under QoS (must be <= 25%)"
+        ),
+    ),
+    # End-to-end tracing (docs/observability.md): the flight-recorder hooks
+    # must be effectively free — tracing-on batched-get throughput within
+    # 3% of tracing-off (measured ~0.3%; sampled interleaved per the
+    # weather rule, min-estimator + bounded noise guard in bench.py) — and
+    # the OFF path must be byte-identical on the wire (an untraced op
+    # encodes zero trace bytes).
+    Check(
+        "trace_overhead",
+        ["trace_overhead_cost", "trace_wire_identical"],
+        lambda m: (
+            m["trace_overhead_cost"] <= 0.03 and m["trace_wire_identical"] == 1
+        ),
+        lambda m: (
+            f"tracing-on costs {100 * m['trace_overhead_cost']:.2f}% batched-get "
+            f"throughput (must be <= 3%), off-path wire identical="
+            f"{m['trace_wire_identical']:.0f} (must be 1)"
+        ),
+    ),
+    # The load-bearing signal is the server-tick JOIN rate: per-span stage
+    # fractions sum to 1.0 by construction over WHATEVER stages are
+    # present, so a silently broken tick join (empty ring, dropped wire
+    # context, clock drift) keeps the sum green while the server-side
+    # stages vanish. Gate: >= 90% of the bench's traced gets joined a
+    # server tick, the sum stays ~1.0 (clock/producer sanity), and GET
+    # /trace actually served Perfetto-loadable events for the ops.
+    Check(
+        "trace_stage_breakdown",
+        ["trace_stage_fraction_sum", "trace_server_join_fraction",
+         "trace_endpoint_events"],
+        lambda m: (
+            abs(m["trace_stage_fraction_sum"] - 1.0) <= 0.02
+            and m["trace_server_join_fraction"] >= 0.9
+            and m["trace_endpoint_events"] > 0
+        ),
+        lambda m: (
+            f"{100 * m['trace_server_join_fraction']:.0f}% of traced gets "
+            f"joined a server tick (must be >= 90%), stage fractions sum to "
+            f"{m['trace_stage_fraction_sum']:.4f} (~1.0), /trace served "
+            f"{m['trace_endpoint_events']:.0f} Chrome trace events"
         ),
     ),
     Check(

@@ -51,6 +51,11 @@ SURFACE = [
     ("infinistore_tpu.faults", [
         "FaultRule", "FaultyConnection", "kill_transport",
     ]),
+    ("infinistore_tpu.tracing", [
+        "configure", "enabled", "recorder", "Span", "FlightRecorder",
+        "trace_op", "start_span", "use_span", "active_span",
+        "server_tick_spans", "chrome_trace_events", "stage_breakdown",
+    ]),
     ("infinistore_tpu.vllm_v1", [
         "KVConnectorRole",
         "KVConnectorBase_V1",
