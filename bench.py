@@ -805,6 +805,296 @@ def _trace_metrics(its, np, srv) -> dict:
     }
 
 
+def _spawn_fleet_servers(n: int = 2, timeout_s: float = 20.0):
+    """``n`` REAL server subprocesses (own manage planes) for the fleet
+    telemetry leg. Returns [{"service_port", "manage_port", "proc"}]."""
+    from tools.fleet import spawn_fleet_servers
+
+    return spawn_fleet_servers(n, timeout_s)
+
+
+def _telemetry_metrics(its, np, srv) -> dict:
+    """Fleet telemetry receipt (docs/observability.md, fleet section),
+    four parts over TWO real server subprocesses:
+
+    1. CLUSTER TRACE JOIN: one traced replicated save fans out to both
+       processes; ``GET /trace?scope=cluster`` (real HTTP, fleet scraper
+       attached) must merge spans from >= 2 distinct server processes for
+       that trace id onto one timeline
+       (``telemetry_cluster_trace_members``, gated >= 2).
+
+    2. SLO BURN-RATE ALERTING, clean vs fault-injected: short-window SLO
+       engine fed by the live cluster + scraper. The clean workload must
+       fire NOTHING (``telemetry_alert_fired_clean`` = 0 — false
+       positives make operators delete alerts); killing one member must
+       fire the availability burn-rate alert within the window
+       (``telemetry_alert_fired_faulty`` = 1). Both gated.
+
+    3. CAUSAL EVENT LINK: the member kill's ``breaker_open`` journal
+       event must carry the trace id of the op that tripped it
+       (``telemetry_event_breaker_trace_linked`` >= 1, gated) — the
+       journal answers "why was this op slow" without log archaeology.
+
+    4. OVERHEAD: batched-get throughput with the fleet scraper actively
+       scraping both members at a tight interval vs stopped — interleaved
+       PAIRED sampling, min(median-of-ratios, ratio-of-sums) estimator
+       (the 2x host-weather rule) — ``telemetry_overhead_cost``, gated
+       <= 3% like tracing.
+    """
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu import telemetry, tracing
+    from infinistore_tpu.cluster import CircuitBreaker, ClusterKVConnector
+    from infinistore_tpu.config import ServerConfig
+    from infinistore_tpu.server import ManageServer
+    from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+    telemetry.reset()
+    fleet = _spawn_fleet_servers(2)
+    conns, cluster = [], None
+    try:
+        spec = PagedKVCacheSpec(
+            num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+            head_dim=32, dtype=jnp.bfloat16,
+        )
+        for m in fleet:
+            conn = its.InfinityConnection(its.ClientConfig(
+                host_addr="127.0.0.1", service_port=m["service_port"],
+                log_level="error", auto_reconnect=True,
+                connect_timeout_ms=500, op_timeout_ms=2000,
+            ))
+            conn.connect()
+            conns.append(conn)
+        cluster = ClusterKVConnector(
+            conns, spec, "telem-bench", max_blocks=8, degrade=True,
+            replicas=2,
+            breaker_factory=lambda i: CircuitBreaker(
+                fail_threshold=2, probe_backoff_s=0.1, max_backoff_s=0.8,
+                seed=i,
+            ),
+        )
+        member_ids = list(cluster.member_ids)
+        scraper = telemetry.FleetScraper(
+            targets=[
+                (member_ids[i], "127.0.0.1", fleet[i]["manage_port"])
+                for i in range(2)
+            ],
+            cluster=cluster, interval_s=0.05, timeout_s=1.0,
+            fail_threshold=2, backoff_s=5.0,
+        )
+        # Short-window burn rules so the fault window fits in a bench leg:
+        # the CLUSTER's op outcomes feed this engine (cluster._done ->
+        # telemetry.slo_engine()), so configure it process-wide.
+        engine = telemetry.configure_slo(telemetry.SloEngine(
+            windows=((2.0, 8.0, 14.4),), bucket_s=0.25,
+            journal=telemetry.get_journal(),
+        ))
+        scraper.slo = engine
+
+        # -- part 1: traced fan-out save + cluster trace join over HTTP --
+        tracing.configure(enabled=True, capacity=512, slow_op_us=0)
+        rng = np.random.default_rng(23)
+        prompts = [
+            rng.integers(0, 1000, size=2 * spec.block_tokens).tolist()
+            for _ in range(24)
+        ]
+
+        def mk_caches(seed):
+            out = []
+            for layer in range(spec.num_layers):
+                k = jax.random.normal(
+                    jax.random.PRNGKey(seed * 10 + layer), spec.cache_shape,
+                    jnp.float32,
+                ).astype(spec.dtype)
+                out.append((k, k))
+            return out
+
+        blocks = np.array([1, 4], np.int32)
+
+        async def traced_save(i):
+            with tracing.trace_op("fanout_save", stage="enqueue") as sp:
+                await cluster.save(prompts[i], mk_caches(i), blocks)
+            return sp
+
+        for i in range(len(prompts) - 1):
+            asyncio.run(traced_save(i))
+        # The JOIN probe is the LAST save: its server ticks cannot have
+        # been evicted from either member's 128-entry native ring by the
+        # seeding saves above.
+        fan_span = asyncio.run(traced_save(len(prompts) - 1))
+
+        async def fetch_cluster_trace() -> dict:
+            manage = ManageServer(
+                ServerConfig(host="127.0.0.1", manage_port=0),
+                scraper=scraper,
+            )
+            manage._server = await asyncio.start_server(
+                manage._handle, host="127.0.0.1", port=0
+            )
+            port = manage._server.sockets[0].getsockname()[1]
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                writer.write(b"GET /trace?scope=cluster HTTP/1.1\r\n\r\n")
+                await writer.drain()
+                raw = await reader.read()
+                writer.close()
+                return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+            finally:
+                manage._server.close()
+                await manage._server.wait_closed()
+
+        doc = asyncio.run(fetch_cluster_trace())
+        ours = [
+            s for s in doc.get("spans", [])
+            if s["trace_id"] == fan_span.trace_id
+        ]
+        joined_members = {
+            s["attrs"]["member"] for s in ours
+            if s["attrs"].get("side") == "server"
+        }
+
+        # -- part 2a: clean window — reads + scrapes, alert must be silent --
+        def sweep(duration_s: float) -> int:
+            t_end = time.perf_counter() + duration_s
+            fired = 0
+            while time.perf_counter() < t_end:
+                for p in prompts:
+                    with tracing.trace_op("slo_lookup", stage="enqueue"):
+                        cluster.lookup(p)
+                scraper.scrape_once()
+                if any(
+                    a["objective"] == "availability"
+                    for a in engine.evaluate()
+                ):
+                    fired = 1
+            return fired
+
+        fired_clean = sweep(2.5)
+
+        # -- part 2b+3: kill one member mid-workload ----------------------
+        victim = member_ids.index(
+            cluster.member_ids[cluster.owner_index(prompts[0])]
+        )
+        fleet[victim]["proc"].kill()
+        fleet[victim]["proc"].wait(timeout=10)
+        fired_faulty = sweep(4.0)
+
+        events = telemetry.get_journal().snapshot()
+        breaker_linked = sum(
+            1 for e in events
+            if e["kind"] == "breaker_open" and e["trace_id"]
+        )
+
+        # -- part 4: scrape+SLO overhead on the batched-get hot path ------
+        tracing.configure(enabled=False)
+        n_keys, block = 128, 64 << 10
+        conn = its.InfinityConnection(
+            its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                             log_level="error")
+        )
+        conn.connect()
+        buf = _staging_buf(np, conn, n_keys * block)
+        buf[:] = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
+        pairs = [(f"tm-{i}", i * block) for i in range(n_keys)]
+
+        async def put():
+            await conn.write_cache_async(pairs, block, buf.ctypes.data)
+
+        def get_once(reps: int = 4) -> float:
+            async def go() -> float:
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    await conn.read_cache_async(pairs, block, buf.ctypes.data)
+                return time.perf_counter() - t0
+
+            return asyncio.run(go())
+
+        asyncio.run(put())
+        warm = get_once()  # warmup; also calibrates the window length
+        # The scraper thread polls the SURVIVING member's manage plane (the
+        # dead one sits in scrape-breaker backoff) and feeds the SLO
+        # engine; the paired estimator isolates that client-side cost.
+        # Honest steady-state geometry: one scrape costs ~3ms of mostly
+        # JSON parsing, so the timed window must span SEVERAL scrape
+        # intervals — a window shorter than the interval measures either
+        # zero scrapes or (since start() scrapes immediately) exactly one
+        # full collision, both artifacts. 4Hz here is already 20x more
+        # aggressive than the 5s production default; windows are
+        # calibrated to ~0.8s so each on-sample amortizes 3-4 scrapes.
+        scraper.interval_s = 0.25
+        reps = max(4, int(round(0.8 / max(warm / 4, 1e-6))))
+        sums = {True: 0.0, False: 0.0}
+        ratios: list = []
+        flip = [0]
+
+        def pair():
+            flip[0] ^= 1
+            sample = {}
+            for scraping in ((True, False) if flip[0] else (False, True)):
+                if scraping:
+                    scraper.start()
+                else:
+                    scraper.stop()
+                sample[scraping] = get_once(reps)
+            scraper.stop()
+            for scraping in (True, False):
+                sums[scraping] += sample[scraping]
+            ratios.append(sample[True] / sample[False])
+
+        def estimate() -> float:
+            med = sorted(ratios)[len(ratios) // 2]
+            return max(0.0, min(med, sums[True] / sums[False]) - 1.0)
+
+        for _ in range(8):
+            pair()
+        for _ in range(12):
+            if estimate() <= 0.02:
+                break
+            pair()
+        overhead = estimate()
+        conn.close()
+
+        return {
+            "telemetry_cluster_trace_members": len(joined_members),
+            "telemetry_cluster_trace_spans": len(ours),
+            "telemetry_alert_fired_clean": fired_clean,
+            "telemetry_alert_fired_faulty": fired_faulty,
+            "telemetry_event_breaker_trace_linked": breaker_linked,
+            "telemetry_events_total": telemetry.get_journal().emitted,
+            "telemetry_overhead_cost": round(overhead, 4),
+            "telemetry_scrapes": scraper.scrapes_total,
+            "telemetry_scrape_failures": scraper.scrape_failures_total,
+            "telemetry_slo_availability": engine.status()["slo_availability"],
+        }
+    finally:
+        tracing.configure(enabled=False)
+        try:
+            # An exception mid-pair must not leak the scrape thread into
+            # the rest of the bench's timing legs.
+            scraper.stop()
+        except NameError:
+            pass
+        telemetry.reset()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for m in fleet:
+            if m["proc"].poll() is None:
+                m["proc"].send_signal(2)
+        for m in fleet:
+            try:
+                m["proc"].wait(timeout=5)
+            except Exception:
+                m["proc"].kill()
+
+
 def _asyncio_efd_floor_us(iters: int = 1500) -> float:
     """The irreducible cost of waking an asyncio loop from another thread via
     eventfd + add_reader — the exact mechanism the async data plane's
@@ -1764,6 +2054,7 @@ def main(argv=None) -> int:
     contended = _contended_latency_us(its, np)
     qos = _qos_isolation_us(its, np)
     trace = _trace_metrics(its, np, srv)
+    telem = _telemetry_metrics(its, np, srv)
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
     churn = _membership_churn_metrics(its, np)
@@ -1862,6 +2153,14 @@ def main(argv=None) -> int:
         # work), GET /trace Perfetto-event count, and the slow-op
         # watchdog's capture count.
         **trace,
+        # Fleet telemetry plane (docs/observability.md, fleet section):
+        # cluster-joined traces over TWO real server subprocesses (>= 2
+        # members must join one traced fan-out op's timeline), SLO
+        # burn-rate alerting (fires under a member kill, silent clean),
+        # the breaker->trace causal event link, and the scrape+SLO
+        # overhead (interleaved paired, <= 3%) — all gated in
+        # tools/bench_check.py.
+        **telem,
         # Engine-shaped connector proof (BASELINE config 4 in spirit): the
         # continuous-batching harness at engine scale — 32 requests 8-way
         # concurrent under a MIXED hit/miss schedule (expected ~0.5), demo

@@ -64,7 +64,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import tracing
+from . import telemetry, tracing
 from .connector import KVConnector, token_chain_hashes
 from .lib import (
     InfiniStoreException,
@@ -752,6 +752,13 @@ class ClusterKVConnector:
 
     # -- failure-domain plumbing ---------------------------------------------
 
+    def _event_member(self, i: int) -> str:
+        """Member id for journal events (index fallback when a stats index
+        outruns the id list mid-transition)."""
+        return (
+            self.member_ids[i] if 0 <= i < len(self.member_ids) else str(i)
+        )
+
     def _begin(self, i: int, heal: bool = True) -> Optional[bool]:
         """Admission through member ``i``'s breaker: None = denied (the op
         fast-fails locally without touching the member), else whether this
@@ -768,10 +775,27 @@ class ClusterKVConnector:
         with self._breaker_lock:  # its: allow[ITS-L003]
             if not h.breaker.allow():
                 h.fast_fails += 1
-                return None
-            probe = h.breaker.state == CircuitBreaker.HALF_OPEN
-            if probe:
-                h.probes += 1
+                denied = True
+            else:
+                denied = False
+                probe = h.breaker.state == CircuitBreaker.HALF_OPEN
+                if probe:
+                    h.probes += 1
+        if denied:
+            # A fast-fail IS an availability event: the member could not
+            # serve the op (the replica may still rescue the READ, but the
+            # per-member SLI must see sustained unavailability — without
+            # this, an OPEN breaker silences the burn-rate alert exactly
+            # while the outage is ongoing).
+            telemetry.slo_engine().record("availability", bad=1)
+            return None
+        if probe:
+            # allow() is the only OPEN->HALF_OPEN transition and this call
+            # won it under the lock: journal the probe admission.
+            telemetry.emit(
+                "breaker_half_open", member=self._event_member(i),
+                epoch=self.membership.view().epoch,
+            )
         if probe and heal:
             self._probe_heal(i)
         return probe
@@ -808,15 +832,45 @@ class ClusterKVConnector:
         Semantic errors (miss / pressure) count as SUCCESS for liveness —
         the member answered."""
         h = self._health[i]
+        opened = recovered = False
         # Audited: O(1) breaker state update (see _breaker_lock).
         with self._breaker_lock:  # its: allow[ITS-L003]
-            if exc is not None and _is_transport(exc):
+            transport = exc is not None and _is_transport(exc)
+            fails = 0
+            if transport:
                 h.errors += 1
                 h.last_error = repr(exc)
+                prev = h.breaker.state
                 h.breaker.record_failure()
+                fails = h.breaker.consecutive_failures
+                opened = (
+                    prev != CircuitBreaker.OPEN
+                    and h.breaker.state == CircuitBreaker.OPEN
+                )
             else:
                 if h.breaker.record_success():
                     h.recoveries += 1
+                    recovered = True
+        # Fleet telemetry (docs/observability.md): every op outcome feeds
+        # the availability SLI, and breaker EDGES land in the event journal
+        # (emitted outside the breaker lock; the journal has its own) with
+        # the active trace id, so "why was this op slow/failed" joins the
+        # op's span tree to the member transition that caused it.
+        telemetry.slo_engine().record(
+            "availability", good=0 if transport else 1,
+            bad=1 if transport else 0,
+        )
+        if opened:
+            telemetry.emit(
+                "breaker_open", member=self._event_member(i),
+                epoch=self.membership.view().epoch,
+                error=repr(exc)[:200], consecutive_failures=fails,
+            )
+        elif recovered:
+            telemetry.emit(
+                "breaker_closed", member=self._event_member(i),
+                epoch=self.membership.view().epoch,
+            )
 
     def _degrade(self, candidates: Sequence[int], exc: Optional[BaseException]):
         """The failure policy, in one place, applied when NO replica served
@@ -836,6 +890,7 @@ class ClusterKVConnector:
                 f"no replica available (circuit open for {open_ids or candidates})"
             )
         self.degraded_ops += 1
+        telemetry.slo_engine().record("miss_rate", bad=1)
         if candidates:
             self._health[candidates[0]].degraded_ops += 1
 
@@ -885,10 +940,13 @@ class ClusterKVConnector:
                 self._health[i].replica_serves += 1
             if tspan is not None:
                 tspan.annotate(cluster_member=i, cluster_rank=rank)
+            telemetry.slo_engine().record("miss_rate", good=1)
             return res
         if answered:
             # Every reachable candidate answered "miss": a legal cache
-            # miss under the contract, not an availability failure.
+            # miss under the contract, not an availability failure (but it
+            # is a miss for the miss-rate SLI).
+            telemetry.slo_engine().record("miss_rate", bad=1)
             return miss_value
         self._degrade(candidates, last)
         return miss_value
@@ -1018,8 +1076,12 @@ class ClusterKVConnector:
                 continue
             if rank:
                 self._health[i].replica_serves += 1
+            telemetry.slo_engine().record(
+                "miss_rate", good=1 if res[1] else 0, bad=0 if res[1] else 1
+            )
             return res
         if answered:
+            telemetry.slo_engine().record("miss_rate", bad=1)
             return list(caches), 0
         self._degrade(candidates, last)
         return list(caches), 0

@@ -30,7 +30,7 @@ from typing import List, Optional, Tuple, Union
 
 import numpy as np
 
-from . import tracing, wire
+from . import telemetry, tracing, wire
 from .wire import PRIORITY_BACKGROUND, PRIORITY_FOREGROUND  # noqa: F401 (re-export)
 from ._native import COMPLETION_CB, LOG_SINK_CB, lib
 from .config import (  # noqa: F401  (re-exported reference names)
@@ -72,27 +72,48 @@ class InfiniStoreNoMatch(InfiniStoreException):
 
 
 class Logger:
-    """Log facade over the native sink (reference Logger, lib.py:155-174)."""
+    """Log facade over the native sink (reference Logger, lib.py:155-174).
+
+    Structured trace context (docs/observability.md): a line emitted while
+    an op span is active carries ``trace_id=``/``span=`` (and ``member=``
+    on cluster-routed paths, from the span's ``cluster_member``
+    annotation), so grep-by-trace-id crosses logs, ``GET /trace`` and
+    ``GET /events``. Costs one module-bool check when tracing is off.
+    """
+
+    @staticmethod
+    def with_context(msg) -> str:
+        """``msg`` suffixed with the active span's trace context (verbatim
+        when tracing is off or no span is bound)."""
+        text = str(msg)
+        span = tracing.active_span()
+        if span is None:
+            return text
+        text += f" trace_id={span.trace_id:#x} span={span.span_id:#x}"
+        member = span.attrs.get("cluster_member")
+        if member is not None:
+            text += f" member={member}"
+        return text
 
     @staticmethod
     def debug(msg):
         """Log at debug level through the native sink."""
-        lib.its_log(0, str(msg).encode())
+        lib.its_log(0, Logger.with_context(msg).encode())
 
     @staticmethod
     def info(msg):
         """Log at info level through the native sink."""
-        lib.its_log(1, str(msg).encode())
+        lib.its_log(1, Logger.with_context(msg).encode())
 
     @staticmethod
     def warn(msg):
         """Log at warning level through the native sink."""
-        lib.its_log(2, str(msg).encode())
+        lib.its_log(2, Logger.with_context(msg).encode())
 
     @staticmethod
     def error(msg):
         """Log at error level through the native sink."""
-        lib.its_log(3, str(msg).encode())
+        lib.its_log(3, Logger.with_context(msg).encode())
 
     @staticmethod
     def set_log_level(level: str):
@@ -246,6 +267,7 @@ async def _bg_gate_wait(conn: "InfinityConnection"):
     )
     if not ok:
         conn._bg_aged += 1
+        telemetry.note_qos_aged()
 
 
 def _bg_gate_wait_sync(conn: "InfinityConnection"):
@@ -255,6 +277,7 @@ def _bg_gate_wait_sync(conn: "InfinityConnection"):
     conn._bg_deferred += 1
     if not _bg_gate_block(time.monotonic() + _BG_AGING_S):
         conn._bg_aged += 1
+        telemetry.note_qos_aged()
 
 
 @COMPLETION_CB
@@ -1531,6 +1554,10 @@ class StripedConnection:
         if not self._quarantined[idx]:
             self._quarantined[idx] = True
             stats["quarantines"] += 1
+            telemetry.emit(
+                "stripe_quarantine", stripe=idx, op=op_name,
+                error=repr(exc)[:200],
+            )
         Logger.warn(
             f"striped {op_name}: stripe {idx} failed ({exc!r}); quarantined, "
             "reconnecting in background — survivors drain the batch"
@@ -1594,6 +1621,7 @@ class StripedConnection:
         if self._quarantined[idx]:
             self._quarantined[idx] = False
             self._sched_stats["rejoins"] += 1
+            telemetry.emit("stripe_revive", stripe=idx)
         return True
 
     def _sweep_quarantine(self):

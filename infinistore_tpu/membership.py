@@ -58,6 +58,7 @@ from .lib import (
     InfiniStoreResourcePressure,
     Logger,
 )
+from . import telemetry
 from .wire import PRIORITY_BACKGROUND
 
 __all__ = ["MemberState", "MembershipView", "Membership", "Resharder"]
@@ -224,15 +225,23 @@ class Membership:
     def _entry(self, member_id: str) -> _Entry:
         return self._entries[self.index_of(member_id)]
 
-    def _mutate(self, fn) -> MembershipView:
+    def _mutate(self, fn, action: str = "", member_id: str = "") -> MembershipView:
         with self._lock:
             if self._prev_placement is None:
                 self._prev_placement = tuple(self._view.placement_ids())
             fn()
             self.epoch += 1
             self.epoch_changes += 1
-            self._view = self._snapshot()
-            return self._view
+            self._view = view = self._snapshot()
+        # Journal the epoch bump OUTSIDE the membership lock (the journal
+        # has its own): which transition, on whom, to which epoch — the
+        # causal anchor reshard/failover traces hang from
+        # (docs/observability.md).
+        telemetry.emit(
+            "membership_epoch", member=member_id, epoch=view.epoch,
+            action=action,
+        )
+        return view
 
     def add_member(self, member_id: str) -> MembershipView:
         """Admit ``member_id`` as JOINING (it immediately takes new writes;
@@ -249,7 +258,7 @@ class Membership:
             self._entries.append(
                 _Entry(member_id, MemberState.JOINING, self.epoch + 1)
             )
-        return self._mutate(apply)
+        return self._mutate(apply, action="add", member_id=member_id)
 
     def remove_member(self, member_id: str) -> MembershipView:
         """Begin a graceful drain: ``member_id`` leaves placement (no new
@@ -275,7 +284,7 @@ class Membership:
                 )
             e.state = MemberState.LEAVING
             e.since_epoch = self.epoch + 1
-        return self._mutate(apply)
+        return self._mutate(apply, action="remove", member_id=member_id)
 
     def mark_dead(self, member_id: str) -> MembershipView:
         """Write a member off: out of placement AND unreadable. Its copies
@@ -288,7 +297,7 @@ class Membership:
                 )
             e.state = MemberState.DEAD
             e.since_epoch = self.epoch + 1
-        return self._mutate(apply)
+        return self._mutate(apply, action="mark_dead", member_id=member_id)
 
     def finalize_transitions(
         self, expected_epoch: Optional[int] = None
@@ -319,8 +328,11 @@ class Membership:
                 return None
             self.epoch += 1
             self.epoch_changes += 1
-            self._view = self._snapshot()
-            return self._view
+            self._view = view = self._snapshot()
+        telemetry.emit(
+            "membership_epoch", epoch=view.epoch, action="finalize",
+        )
+        return view
 
     # -- observability -------------------------------------------------------
 

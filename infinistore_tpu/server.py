@@ -26,7 +26,7 @@ import sys
 import urllib.parse
 
 from . import lib as _lib
-from . import tracing
+from . import telemetry, tracing
 from .config import ServerConfig
 from .lib import Logger, register_server, unregister_server
 
@@ -91,11 +91,18 @@ def _http_response(status: int, payload: dict) -> bytes:
     ).encode() + body
 
 
-def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
+def _prometheus_text(stats: dict, membership_status: dict = None,
+                     slo_status: dict = None, event_counts: dict = None,
+                     exemplars: bool = False) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
     reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
     cluster attached to the manage plane, ``membership_status`` appends
-    the membership/reshard gauge families (docs/membership.md)."""
+    the membership/reshard gauge families (docs/membership.md);
+    ``slo_status``/``event_counts`` append the fleet-telemetry families
+    (docs/observability.md). ``exemplars`` (``GET /metrics?exemplars=1``)
+    attaches OpenMetrics exemplars — the trace id of the slowest recorded
+    op per histogram — to the matching ``_bucket`` line; the default
+    output stays plain Prometheus, byte-identical to pre-exemplar."""
     lines = [
         "# TYPE infinistore_kvmap_entries gauge",
         f"infinistore_kvmap_entries {stats['kvmap_len']}",
@@ -192,17 +199,36 @@ def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
     # sparse as [le_us, count]): dashboards can aggregate/re-quantile it,
     # which the old p99 point-gauges could not. The cumulative `le` walk +
     # +Inf/_sum/_count triplet is the Prometheus histogram contract.
+    # Exemplar sources (``?exemplars=1``, OpenMetrics syntax): the slowest
+    # recorded trace-tick per op, so a p99 bucket links its trace id
+    # straight into the flight recorder (`GET /trace`). Off by default —
+    # the plain exposition bytes are unchanged.
+    slowest: dict = {}
+    if exemplars:
+        tick_entries = tr.get("entries", [])
+        for e in tick_entries:
+            dur = e.get("done_us", 0) - e.get("recv_us", 0)
+            if e.get("trace_id") and dur > 0:
+                cur = slowest.get(e.get("op"))
+                if cur is None or dur > cur[0]:
+                    slowest[e.get("op")] = (dur, e.get("trace_id"))
     lines.append("# TYPE infinistore_op_duration_us histogram")
     for op, s in ops:
         cum = 0
+        ex = slowest.get(op)
         for le, cnt in s.get("hist_us", []):
             cum += cnt
-            lines.append(
-                f'infinistore_op_duration_us_bucket{{op="{op}",le="{le}"}} {cum}'
-            )
-        lines.append(
+            line = f'infinistore_op_duration_us_bucket{{op="{op}",le="{le}"}} {cum}'
+            if ex is not None and ex[0] <= le:
+                line += f' # {{trace_id="{ex[1]:#x}"}} {float(ex[0])}'
+                ex = None
+            lines.append(line)
+        inf_line = (
             f'infinistore_op_duration_us_bucket{{op="{op}",le="+Inf"}} {s["count"]}'
         )
+        if ex is not None:
+            inf_line += f' # {{trace_id="{ex[1]:#x}"}} {float(ex[0])}'
+        lines.append(inf_line)
         lines.append(f'infinistore_op_duration_us_sum{{op="{op}"}} {s["total_us"]}')
         lines.append(f'infinistore_op_duration_us_count{{op="{op}"}} {s["count"]}')
     # p50/p99 stay as DERIVED gauges (computed natively from the same
@@ -218,10 +244,39 @@ def _prometheus_text(stats: dict, membership_status: dict = None) -> bytes:
         lines.append(f'infinistore_op_p99_latency_us{{op="{op}"}} {s["p99_us"]}')
     if membership_status is not None:
         lines += _membership_prometheus_lines(membership_status)
+    if slo_status is not None:
+        lines += _slo_prometheus_lines(slo_status)
+    if event_counts is not None:
+        lines += _events_prometheus_lines(event_counts)
+    # Exemplar syntax is ILLEGAL in the plain 0.0.4 text format (a scraper
+    # parsing it there rejects the whole body) — the exemplar variant must
+    # declare OpenMetrics, whose parser requires the trailing # EOF. That
+    # parser also enforces counter naming: the family is declared by BASE
+    # name and samples carry ``_total``. The legacy counter vocabulary
+    # predates that rule, so here (and only here) the TYPE lines adapt:
+    # ``foo_total``-named families are declared by base (samples already
+    # conform), anything else is declared ``unknown``, which OpenMetrics
+    # accepts with any name. Exemplars ride only the histogram ``_bucket``
+    # lines, where they are legal; sample names/values stay identical to
+    # the plain rendering.
+    if exemplars:
+        def _om_type(ln: str) -> str:
+            if not (ln.startswith("# TYPE ") and ln.endswith(" counter")):
+                return ln
+            family = ln.split(" ")[2]
+            if family.endswith("_total"):
+                return f"# TYPE {family[: -len('_total')]} counter"
+            return ln[: -len("counter")] + "unknown"
+
+        lines = [_om_type(ln) for ln in lines]
+        lines.append("# EOF")
+        ctype = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+    else:
+        ctype = "text/plain; version=0.0.4"
     body = ("\n".join(lines) + "\n").encode()
     return (
         f"HTTP/1.1 200 OK\r\n"
-        f"Content-Type: text/plain; version=0.0.4\r\n"
+        f"Content-Type: {ctype}\r\n"
         f"Content-Length: {len(body)}\r\n"
         f"Connection: close\r\n\r\n"
     ).encode() + body
@@ -281,7 +336,52 @@ def _membership_prometheus_lines(ms: dict) -> list:
     ]
 
 
-def _trace_payload(stats: dict, fmt: str = "json") -> bytes:
+def _slo_prometheus_lines(slo: dict) -> list:
+    """SLO gauge families for /metrics, from the flat ``SloEngine.status``
+    snapshot (the same dict ``GET /slo`` serves). The counters checker
+    (ITS-C006, tools/analysis/counters.py) holds this exporter to the
+    ``slo_*`` status vocabulary — an SLI dashboards cannot see is
+    observability drift (docs/observability.md)."""
+    lines = [
+        "# TYPE infinistore_slo_availability gauge",
+        f"infinistore_slo_availability {slo['slo_availability']}",
+        "# TYPE infinistore_slo_fg_p99_us gauge",
+        f"infinistore_slo_fg_p99_us {slo['slo_fg_p99_us']}",
+        "# TYPE infinistore_slo_miss_rate gauge",
+        f"infinistore_slo_miss_rate {slo['slo_miss_rate']}",
+        "# TYPE infinistore_slo_reshard_drain gauge",
+        f"infinistore_slo_reshard_drain {slo['slo_reshard_drain']}",
+        "# TYPE infinistore_slo_burn_rate_max gauge",
+        f"infinistore_slo_burn_rate_max {slo['slo_burn_rate_max']}",
+        "# TYPE infinistore_slo_alerts_firing gauge",
+        f"infinistore_slo_alerts_firing {slo['slo_alerts_firing']}",
+        "# TYPE infinistore_slo_alerts_total counter",
+        f"infinistore_slo_alerts_total {slo['slo_alerts_total']}",
+        "# TYPE infinistore_slo_burn_rate gauge",
+    ]
+    for name, detail in sorted(slo.get("objectives", {}).items()):
+        for window, burn in sorted(detail.get("burn_rates", {}).items()):
+            lines.append(
+                f'infinistore_slo_burn_rate{{objective="{name}",'
+                f'window="{window}"}} {burn}'
+            )
+    return lines
+
+
+def _events_prometheus_lines(counts: dict) -> list:
+    """Per-kind event-journal emit totals (``EventJournal.counts``); the
+    full vocabulary is enumerated so a kind that never fired still scrapes
+    as an explicit 0 (rate() needs the zero points)."""
+    lines = ["# TYPE infinistore_events_total counter"]
+    for kind in telemetry.EVENT_KINDS:
+        lines.append(
+            f'infinistore_events_total{{kind="{kind}"}} {counts.get(kind, 0)}'
+        )
+    return lines
+
+
+def _trace_payload(stats: dict, fmt: str = "json",
+                   member_spans: dict = None) -> bytes:
     """GET /trace body: recent spans from the process flight recorder
     joined with the local server's trace tick ring (``stats["trace"]``).
 
@@ -289,25 +389,58 @@ def _trace_payload(stats: dict, fmt: str = "json") -> bytes:
     schema (``tracing.STAGES`` — the vocabulary the ITS-T checker holds
     producers and docs to); ``fmt="chrome"`` returns Chrome trace-event
     format — save the body to a file and load it in Perfetto
-    (https://ui.perfetto.dev) or chrome://tracing
-    (docs/observability.md)."""
+    (https://ui.perfetto.dev) or chrome://tracing (docs/observability.md).
+
+    ``member_spans`` (``?scope=cluster`` with a fleet scraper attached):
+    per-member scraped span sets to merge with the local recorder by
+    trace id onto one timeline — a striped/replicated/reshard op that
+    fanned out across processes renders as ONE tree, with one Perfetto
+    track lane per member in the chrome format.
+
+    Either way the payload cross-links the event journal: every journal
+    event carrying a trace id present in the dump rides along in
+    ``events``, so "why was this op slow" (breaker trip? epoch bump? QoS
+    storm?) is answerable from one response."""
     trace = stats.get("trace", {})
     server_spans = tracing.server_tick_spans(trace)
     rec = tracing.recorder()
     client_spans = rec.snapshot() if rec is not None else []
+    scope = "local" if member_spans is None else "cluster"
+    if member_spans is not None:
+        merged = telemetry.cluster_spans(
+            client_spans + server_spans, member_spans
+        )
+    else:
+        merged = client_spans + server_spans
+    events = telemetry.get_journal().for_trace(
+        {s.get("trace_id", 0) for s in merged} - {0}
+    )
     if fmt == "chrome":
         payload = {
-            "traceEvents": tracing.chrome_trace_events(
-                client_spans + server_spans
+            "traceEvents": (
+                telemetry.cluster_chrome_events(merged)
+                if member_spans is not None
+                else tracing.chrome_trace_events(merged)
             ),
             "displayTimeUnit": "ms",
         }
         return _http_response(200, payload)
+    if member_spans is not None:
+        return _http_response(200, {
+            "enabled": tracing.enabled(),
+            "scope": scope,
+            "stages": list(tracing.STAGES),
+            "spans": merged,
+            "members": ["local", *member_spans.keys()],
+            "events": events,
+        })
     return _http_response(200, {
         "enabled": tracing.enabled(),
+        "scope": scope,
         "stages": list(tracing.STAGES),
         "spans": client_spans,
         "server_spans": server_spans,
+        "events": events,
         "slow_ops": rec.slow_snapshot() if rec is not None else [],
         "slow_ops_total": rec.slow_ops_total if rec is not None else 0,
         "recorded": rec.recorded if rec is not None else 0,
@@ -320,9 +453,11 @@ def _trace_payload(stats: dict, fmt: str = "json") -> bytes:
 class ManageServer:
     """The management plane: /purge, /kvmap_len (reference server.py:25-39),
     /selftest (advertised in reference README.md:56-57 but missing), /stats,
-    /usage, /metrics (Prometheus), /health, /trace (the op-tracing dump,
-    docs/observability.md) — plus, with a cluster attached, /membership
-    GET/POST (the elastic-membership control surface, docs/membership.md).
+    /usage, /metrics (Prometheus), /health (SLO-verdict-aware), /trace (the
+    op-tracing dump; ?scope=cluster joins the fleet, docs/observability.md),
+    /slo (burn-rate verdict) and /events (the causal event journal) — plus,
+    with a cluster attached, /membership GET/POST (the elastic-membership
+    control surface, docs/membership.md).
 
     ``cluster``: an optional ``ClusterKVConnector``-shaped object (needs
     ``membership`` / ``resharder`` / ``membership_status()`` / ``health()``
@@ -334,9 +469,15 @@ class ManageServer:
     they are closed on the next control-plane request — HTTP-driven
     join/leave churn never accumulates native connections."""
 
-    def __init__(self, config: ServerConfig, cluster=None):
+    def __init__(self, config: ServerConfig, cluster=None, scraper=None):
         self.config = config
         self.cluster = cluster
+        # Fleet telemetry (docs/observability.md): an attached
+        # ``telemetry.FleetScraper`` lights up ``GET /trace?scope=cluster``
+        # (cluster-joined traces) and the per-member rows of ``GET /slo``.
+        # ``/slo`` and ``/events`` themselves serve the process-wide SLO
+        # engine and event journal and need no scraper.
+        self.scraper = scraper
         self._server = None
         # member_id -> InfinityConnection this manage plane connected
         # (POST add); swept once the member goes terminal.
@@ -401,36 +542,92 @@ class ManageServer:
                     self.cluster.membership_status()
                     if self.cluster is not None else None
                 )
+                params = urllib.parse.parse_qs(query)
+                slo = telemetry.slo_engine().status()
+                counts = telemetry.get_journal().counts()
                 try:
                     stats = await asyncio.to_thread(_lib.get_server_stats)
                 except Exception:
                     # A cluster-side manage plane may run with no local
-                    # store server in-process: membership gauges must
-                    # still scrape. A plain store server's failure stays
-                    # a 500.
+                    # store server in-process: membership + telemetry
+                    # gauges must still scrape. A plain store server's
+                    # failure stays a 500.
                     if ms is None:
                         raise
-                    body = ("\n".join(_membership_prometheus_lines(ms)) + "\n").encode()
+                    lines = (
+                        _membership_prometheus_lines(ms)
+                        + _slo_prometheus_lines(slo)
+                        + _events_prometheus_lines(counts)
+                    )
+                    body = ("\n".join(lines) + "\n").encode()
                     return (
                         f"HTTP/1.1 200 OK\r\n"
                         f"Content-Type: text/plain; version=0.0.4\r\n"
                         f"Content-Length: {len(body)}\r\n"
                         f"Connection: close\r\n\r\n"
                     ).encode() + body
-                return _prometheus_text(stats, membership_status=ms)
+                return _prometheus_text(
+                    stats, membership_status=ms, slo_status=slo,
+                    event_counts=counts,
+                    exemplars=params.get("exemplars") == ["1"],
+                )
             if path == "/health" and method == "GET":
-                return _http_response(200, {"status": "ok"})
+                # The health verdict CONSUMES the SLO engine: a fleet whose
+                # error budget is burning is degraded even though this
+                # process answers (docs/observability.md).
+                slo = telemetry.slo_engine().status()
+                return _http_response(200, {
+                    "status": "ok" if slo["verdict"] == "ok" else "degraded",
+                    "slo_verdict": slo["verdict"],
+                    "slo_alerts_firing": slo["slo_alerts_firing"],
+                })
+            if path == "/slo" and method == "GET":
+                # The SLO verdict endpoint: rolling SLIs, per-window burn
+                # rates, firing alerts — plus the fleet scraper's
+                # per-member health when one is attached.
+                payload = telemetry.slo_engine().status()
+                if self.scraper is not None:
+                    payload["scraper"] = self.scraper.status()
+                return _http_response(200, payload)
+            if path == "/events" and method == "GET":
+                # The causal event journal (?since_seq=N&limit=N): breaker
+                # transitions, epoch bumps, quarantines, slow ops, QoS
+                # storms, SLO alert edges — each with member/epoch/trace id.
+                params = urllib.parse.parse_qs(query)
+                try:
+                    since = int(params.get("since_seq", ["0"])[0])
+                    limit = int(params.get("limit", ["0"])[0]) or None
+                except ValueError:
+                    return _http_response(400, {"error": "bad since_seq/limit"})
+                journal = telemetry.get_journal()
+                return _http_response(200, {
+                    "events": journal.snapshot(since_seq=since, limit=limit),
+                    "counts": journal.counts(),
+                    "emitted": journal.emitted,
+                    "capacity": journal.capacity,
+                })
             if path == "/trace" and method == "GET":
                 # Recent op spans (flight recorder + native tick ring):
                 # default JSON dump, ?fmt=chrome for Perfetto. A manage
                 # plane with no local store still serves the client spans.
+                # ?scope=cluster (fleet scraper attached): refresh the
+                # scrape OFF-loop and merge every member's spans with the
+                # local recorder by trace id — one timeline, one Perfetto
+                # lane per member.
                 try:
                     stats = await asyncio.to_thread(_lib.get_server_stats)
                 except Exception:
                     stats = {}
                 params = urllib.parse.parse_qs(query)
                 fmt = "chrome" if params.get("fmt") == ["chrome"] else "json"
-                return _trace_payload(stats, fmt)
+                member_spans = None
+                if (
+                    params.get("scope") == ["cluster"]
+                    and self.scraper is not None
+                ):
+                    await asyncio.to_thread(self.scraper.scrape_once)
+                    member_spans = self.scraper.member_spans()
+                return _trace_payload(stats, fmt, member_spans=member_spans)
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
             if path == "/membership" and method == "GET":
@@ -438,7 +635,8 @@ class ManageServer:
             if path == "/membership" and method == "POST":
                 return await self._membership_post(body)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
-                        "/selftest", "/health", "/trace", "/membership"):
+                        "/selftest", "/health", "/trace", "/membership",
+                        "/slo", "/events"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request

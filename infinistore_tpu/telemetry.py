@@ -1,0 +1,908 @@
+"""Fleet telemetry plane: cluster-joined traces, SLO burn rates, event journal.
+
+PR 7 made one process legible — per-op spans, a flight recorder, ``GET
+/trace``, latency histograms — but the system the ROADMAP steers toward
+(elastic multi-member clusters) fails at the *fleet* level: a breaker trips
+on member 2, a reshard epoch bumps, foreground p99 drifts, and each of
+those is visible only as a disconnected counter on one process's manage
+plane. This module joins them (docs/observability.md, fleet section):
+
+- :class:`EventJournal` — a bounded structured ring of **cluster events**
+  (the :data:`EVENT_KINDS` vocabulary: breaker transitions, membership
+  epoch changes, stripe quarantine/revive, watchdog slow ops, QoS aging
+  storms, SLO alert edges), each stamped with member id, epoch, and the
+  ACTIVE TRACE ID where one exists — so "why was this op slow" joins the
+  op's span tree to the cluster state change that slowed it. Served at
+  ``GET /events`` and cross-linked from ``GET /trace``.
+- :class:`SloEngine` — rolling multi-window SLIs (availability, fg p99
+  from the ``infinistore_op_duration_us`` histograms, miss rate, reshard
+  debt drain) with **multi-window burn-rate alerting** (short AND long
+  window over threshold fires; hysteresis clears). Exported as
+  ``infinistore_slo_*`` gauges and the ``GET /slo`` verdict consumed by
+  ``/health``. Clock-injectable: the window math is tested with a fake
+  clock, no sleeps.
+- :class:`FleetScraper` — an off-loop, breaker-aware, bounded scraper that
+  pulls each member's ``/trace`` (native tick ring + flight-recorder
+  spans) and ``/stats`` (op counters + histograms) over the manage plane,
+  feeds the SLO engine with the deltas, and keeps the last per-member
+  span set for the **cluster trace join**: ``GET /trace?scope=cluster``
+  merges every member's spans with the local client recorder by trace id
+  onto one monotonic timeline (same-host CLOCK_MONOTONIC; one Perfetto
+  track lane per member in ``?fmt=chrome``).
+
+The ITS-C006 checker (tools/analysis/counters.py) holds the telemetry
+vocabulary in lockstep: every :data:`EVENT_KINDS` entry must have a
+producer and a docs row, every ``slo_*`` status key must reach the
+``/metrics`` exporter, and the manage plane must keep serving ``/slo`` and
+``/events``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import tracing
+
+# ---------------------------------------------------------------------------
+# Event journal.
+# ---------------------------------------------------------------------------
+
+# Canonical cluster-event vocabulary. The ITS-C006 checker fails the build
+# when a producer emits a kind outside this tuple, when a kind has no
+# producer left (dead vocabulary), or when a kind is undocumented in
+# docs/observability.md.
+EVENT_KINDS = (
+    "breaker_open",       # member breaker tripped (CLOSED/HALF_OPEN -> OPEN)
+    "breaker_half_open",  # probe window elapsed; one probe admitted
+    "breaker_closed",     # probe success re-closed the breaker (recovery)
+    "membership_epoch",   # membership transition bumped the epoch
+    "stripe_quarantine",  # striped data plane quarantined a dead stripe
+    "stripe_revive",      # quarantined stripe reconnected and rejoined
+    "slow_op",            # watchdog captured an over-threshold span tree
+    "qos_aging_storm",    # bg aging escapes crossed the storm threshold
+    "slo_alert",          # burn-rate alert fired or cleared (edge)
+)
+
+_DEFAULT_JOURNAL_CAPACITY = 512
+
+
+class EventJournal:
+    """Bounded structured ring of cluster events (causal journal).
+
+    Always on and cheap: events are rare (state transitions, not ops), one
+    lock-guarded append each. Every event records ``seq`` (monotone),
+    ``t_us`` (CLOCK_MONOTONIC microseconds — the same clock trace spans
+    stamp, so events sort onto the trace timeline), wall-clock seconds,
+    the event ``kind``, the ``member`` id and membership ``epoch`` where
+    known, and the active ``trace_id`` when the emitting code ran inside
+    a traced op — that link is what makes the journal *causal* rather
+    than a log.
+    """
+
+    def __init__(self, capacity: int = _DEFAULT_JOURNAL_CAPACITY):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.emitted = 0
+        self._counts: Dict[str, int] = {}
+
+    def emit(self, kind: str, member: str = "", epoch: int = 0,
+             trace_id: Optional[int] = None, **attrs) -> dict:
+        """Record one event. ``trace_id=None`` stamps the active span's
+        trace id (0 when untraced); pass an explicit id when emitting on
+        behalf of another context (the slow-op hook)."""
+        if trace_id is None:
+            span = tracing.active_span()
+            trace_id = span.trace_id if span is not None else 0
+        event = {
+            "kind": kind,
+            "member": member,
+            "epoch": int(epoch),
+            "trace_id": int(trace_id),
+            "t_us": tracing._now_us(),
+            "wall_s": round(time.time(), 3),
+            "attrs": attrs,
+        }
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+            self.emitted += 1
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+        return event
+
+    def snapshot(self, since_seq: int = 0,
+                 limit: Optional[int] = None) -> List[dict]:
+        """Events with ``seq > since_seq``, oldest first (ring-bounded)."""
+        with self._lock:
+            out = [dict(e) for e in self._events if e["seq"] > since_seq]
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def for_trace(self, trace_ids) -> List[dict]:
+        """Events carrying one of ``trace_ids`` — the /trace cross-link."""
+        wanted = set(trace_ids)
+        with self._lock:
+            return [dict(e) for e in self._events if e["trace_id"] in wanted]
+
+    def counts(self) -> Dict[str, int]:
+        """Per-kind emit totals (``infinistore_events_total`` on /metrics;
+        counts survive ring eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._seq = 0
+            self.emitted = 0
+            self._counts = {}
+
+
+class _StormDetector:
+    """Edge-triggered rate detector for QoS aging escapes: emits one
+    ``qos_aging_storm`` event when ``threshold`` escapes land within
+    ``window_s``, then re-arms only after a full quiet window (hysteresis
+    — a sustained storm is one event, not a flood of them)."""
+
+    def __init__(self, threshold: int = 64, window_s: float = 1.0,
+                 clock=time.monotonic):
+        self.threshold = threshold
+        self.window_s = window_s
+        self._clock = clock
+        self._stamps: deque = deque()
+        self._armed = True
+        self._lock = threading.Lock()
+
+    def note(self, n: int = 1) -> int:
+        """Record ``n`` aging escapes; returns the in-window count when a
+        storm edge fired, else 0."""
+        now = self._clock()
+        with self._lock:
+            horizon = now - self.window_s
+            while self._stamps and self._stamps[0] < horizon:
+                self._stamps.popleft()
+            # Re-arm BEFORE recording this note's escapes: an empty window
+            # here means a full quiet window elapsed since the last storm
+            # — checking after the append could never see zero from the
+            # production callers (which always note >= 1).
+            if not self._armed and not self._stamps:
+                self._armed = True
+            for _ in range(n):
+                self._stamps.append(now)
+            count = len(self._stamps)
+            if self._armed and count >= self.threshold:
+                self._armed = False
+                return count
+            return 0
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: rolling multi-window SLIs + burn-rate alerting.
+# ---------------------------------------------------------------------------
+
+class SloObjective:
+    """One SLO: a good/bad ratio target (``kind="ratio"``) or a latency
+    objective (``kind="latency"``: a sample is *bad* when it lands in a
+    histogram bucket above ``latency_threshold_us``; the windowed p99 is
+    kept alongside for display). ``target`` is the success-ratio
+    objective (e.g. 0.999); the error budget is ``1 - target``."""
+
+    def __init__(self, name: str, target: float, kind: str = "ratio",
+                 latency_threshold_us: float = 0.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError("target must be in (0, 1)")
+        if kind not in ("ratio", "latency"):
+            raise ValueError(f"unknown objective kind {kind!r}")
+        self.name = name
+        self.target = target
+        self.kind = kind
+        self.latency_threshold_us = latency_threshold_us
+
+
+def default_objectives() -> List[SloObjective]:
+    """The fleet's standing SLO set (docs/observability.md):
+    availability of data-plane ops, foreground p99 (from the per-op
+    duration histograms), cache miss rate through the degrade machinery,
+    and reshard debt drain (a reshard whose debt stops draining is an
+    incident even though every individual op succeeds)."""
+    return [
+        SloObjective("availability", target=0.999),
+        SloObjective("fg_latency", target=0.99, kind="latency",
+                     latency_threshold_us=50_000.0),
+        SloObjective("miss_rate", target=0.90),
+        SloObjective("reshard_drain", target=0.90),
+    ]
+
+
+# Multi-window burn-rate rules (SRE-workbook shape): (short_s, long_s,
+# burn_threshold). An alert FIRES when the burn rate exceeds the threshold
+# over BOTH windows — the long window proves the budget spend is real, the
+# short window proves it is still happening — and stays firing until the
+# short-window burn drops below ``clear_ratio * threshold`` (hysteresis).
+DEFAULT_BURN_WINDOWS: Tuple[Tuple[float, float, float], ...] = (
+    (300.0, 3600.0, 14.4),   # fast burn: 2% of a 30d budget in 1h
+    (1800.0, 21600.0, 6.0),  # slow burn: 5% of a 30d budget in 6h
+)
+
+
+class SloEngine:
+    """Rolling multi-window SLI store + burn-rate alert evaluator.
+
+    Samples land in coarse time buckets (``bucket_s``) per objective; a
+    window SLI is the good/bad ratio over the buckets it covers, so
+    memory is O(windows/bucket_s) per objective regardless of traffic.
+    The clock is injectable and nothing sleeps — the window math
+    (roll-off, burn monotonicity, hysteresis) is property-tested with a
+    fake clock (tests/test_telemetry.py).
+
+    Key vocabulary: :meth:`status` returns the flat ``slo_*`` snapshot the
+    ``/slo`` endpoint serves and ``server._slo_prometheus_lines`` exports
+    — held in lockstep by ITS-C006.
+    """
+
+    def __init__(self, objectives: Optional[Sequence[SloObjective]] = None,
+                 windows: Sequence[Tuple[float, float, float]] = DEFAULT_BURN_WINDOWS,
+                 clear_ratio: float = 0.5,
+                 bucket_s: float = 5.0,
+                 clock=time.monotonic,
+                 journal: Optional[EventJournal] = None):
+        self.objectives: Dict[str, SloObjective] = {
+            o.name: o for o in (objectives if objectives is not None
+                                else default_objectives())
+        }
+        self.windows = tuple(windows)
+        self.clear_ratio = clear_ratio
+        self.bucket_s = bucket_s
+        self._clock = clock
+        self._journal = journal
+        self._max_window = max((w[1] for w in self.windows), default=3600.0)
+        self._lock = threading.Lock()
+        # name -> deque[[bucket_start_s, good, bad]]
+        self._buckets: Dict[str, deque] = {}
+        # latency objectives: name -> deque[[bucket_start_s, {le_us: count}]]
+        self._lat: Dict[str, deque] = {}
+        # (objective, long_s) -> firing bool; plus the fire-edge counter.
+        self._firing: Dict[Tuple[str, float], bool] = {}
+        self.alerts_total = 0
+
+    # -- feeding -------------------------------------------------------------
+
+    def _bucket(self, store: Dict[str, deque], name: str, now: float,
+                empty) -> list:
+        dq = store.setdefault(name, deque())
+        start = now - (now % self.bucket_s)
+        if not dq or dq[-1][0] != start:
+            dq.append([start, *empty()])
+        horizon = now - self._max_window - self.bucket_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+        return dq[-1]
+
+    def record(self, name: str, good: int = 0, bad: int = 0,
+               t: Optional[float] = None):
+        """Feed good/bad samples to a ratio objective (unknown names are
+        accepted — the objective may be configured later; they simply
+        don't alert until it is)."""
+        now = self._clock() if t is None else t
+        with self._lock:
+            b = self._bucket(self._buckets, name, now, lambda: (0, 0))
+            b[1] += good
+            b[2] += bad
+
+    def record_latency_bucket(self, name: str, le_us: float, count: int = 1,
+                              t: Optional[float] = None):
+        """Feed ``count`` latency samples whose upper bucket bound is
+        ``le_us`` (the scraper feeds histogram DELTAS between scrapes).
+        Samples above the objective's threshold count against the budget;
+        the windowed p99 is derived from the same buckets."""
+        if count <= 0:
+            return
+        now = self._clock() if t is None else t
+        obj = self.objectives.get(name)
+        threshold = obj.latency_threshold_us if obj is not None else 0.0
+        with self._lock:
+            lb = self._bucket(self._lat, name, now, lambda: ({},))
+            hist = lb[1]
+            hist[float(le_us)] = hist.get(float(le_us), 0) + count
+            b = self._bucket(self._buckets, name, now, lambda: (0, 0))
+            if threshold and le_us > threshold:
+                b[2] += count
+            else:
+                b[1] += count
+
+    # -- window math ---------------------------------------------------------
+
+    def _window_counts(self, name: str, window_s: float,
+                       now: float) -> Tuple[int, int]:
+        dq = self._buckets.get(name)
+        if not dq:
+            return 0, 0
+        horizon = now - window_s
+        good = bad = 0
+        for start, g, b in dq:
+            if start + self.bucket_s > horizon:
+                good += g
+                bad += b
+        return good, bad
+
+    def sli(self, name: str, window_s: Optional[float] = None,
+            now: Optional[float] = None) -> float:
+        """Success ratio over the window (1.0 with no samples — an idle
+        SLI is a met SLI, not a firing one)."""
+        now = self._clock() if now is None else now
+        window_s = self._max_window if window_s is None else window_s
+        with self._lock:
+            good, bad = self._window_counts(name, window_s, now)
+        total = good + bad
+        return 1.0 if total == 0 else good / total
+
+    def burn_rate(self, name: str, window_s: float,
+                  now: Optional[float] = None) -> float:
+        """Error-budget burn multiple over the window: observed bad
+        fraction / allowed bad fraction (1.0 = spending exactly on
+        budget; 14.4 = a 30d budget gone in 50h)."""
+        obj = self.objectives.get(name)
+        if obj is None:
+            return 0.0
+        now = self._clock() if now is None else now
+        with self._lock:
+            good, bad = self._window_counts(name, window_s, now)
+        total = good + bad
+        if total == 0:
+            return 0.0
+        budget = 1.0 - obj.target
+        return (bad / total) / budget if budget > 0 else 0.0
+
+    def p99_us(self, name: str, window_s: Optional[float] = None,
+               now: Optional[float] = None) -> float:
+        """Windowed p99 for a latency objective, from its bucket counts
+        (upper bucket bound, the same convention the /metrics histogram
+        export uses). 0.0 with no samples."""
+        now = self._clock() if now is None else now
+        window_s = self._max_window if window_s is None else window_s
+        with self._lock:
+            dq = self._lat.get(name)
+            if not dq:
+                return 0.0
+            horizon = now - window_s
+            merged: Dict[float, int] = {}
+            for start, hist in dq:
+                if start + self.bucket_s > horizon:
+                    for le, cnt in hist.items():
+                        merged[le] = merged.get(le, 0) + cnt
+        total = sum(merged.values())
+        if total == 0:
+            return 0.0
+        goal = 0.99 * total
+        cum = 0
+        for le in sorted(merged):
+            cum += merged[le]
+            if cum >= goal:
+                return le
+        return max(merged)
+
+    # -- alerting ------------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """Evaluate every (objective, rule) pair; returns the FIRING alert
+        list and emits ``slo_alert`` journal events on fire/clear edges.
+        Hysteresis: a firing alert needs the short-window burn to drop
+        below ``clear_ratio * threshold`` to clear — not merely below the
+        threshold — so an alert flapping on the fire line stays up."""
+        now = self._clock() if now is None else now
+        firing: List[dict] = []
+        for name in self.objectives:
+            for short_s, long_s, threshold in self.windows:
+                short = self.burn_rate(name, short_s, now)
+                long = self.burn_rate(name, long_s, now)
+                key = (name, long_s)
+                # The fire/clear edge is check-then-act shared between the
+                # scraper daemon thread and the manage plane's /slo//health
+                # handlers: take it under the engine lock so a concurrent
+                # evaluate() cannot double-count alerts_total or journal a
+                # duplicate edge. The emit itself stays OUTSIDE the lock
+                # (the journal has its own), same discipline as the
+                # cluster breaker edges.
+                with self._lock:
+                    was = self._firing.get(key, False)
+                    if was:
+                        is_firing = short >= self.clear_ratio * threshold
+                    else:
+                        is_firing = short >= threshold and long >= threshold
+                    edge = is_firing != was
+                    if edge:
+                        self._firing[key] = is_firing
+                        if is_firing:
+                            self.alerts_total += 1
+                if edge and self._journal is not None:
+                    self._journal.emit(
+                        "slo_alert", objective=name,
+                        window_s=long_s, state=(
+                            "firing" if is_firing else "cleared"
+                        ),
+                        burn_short=round(short, 3),
+                        burn_long=round(long, 3),
+                    )
+                if is_firing:
+                    firing.append({
+                        "objective": name,
+                        "short_window_s": short_s,
+                        "long_window_s": long_s,
+                        "threshold": threshold,
+                        "burn_short": round(short, 4),
+                        "burn_long": round(long, 4),
+                    })
+        return firing
+
+    def status(self, now: Optional[float] = None) -> dict:
+        """The ``/slo`` verdict payload. Flat ``slo_*`` keys are the gauge
+        vocabulary ``_slo_prometheus_lines`` exports (ITS-C006);
+        ``objectives``/``alerts`` carry the per-objective detail."""
+        now = self._clock() if now is None else now
+        alerts = self.evaluate(now)
+        detail = {}
+        burn_max = 0.0
+        for name, obj in self.objectives.items():
+            burns = {}
+            for short_s, long_s, threshold in self.windows:
+                burns[f"{int(short_s)}s"] = round(
+                    self.burn_rate(name, short_s, now), 4
+                )
+                burns[f"{int(long_s)}s"] = round(
+                    self.burn_rate(name, long_s, now), 4
+                )
+                # Max over BOTH windows: a burst that ended minutes ago has
+                # a zero short-window burn while the long window still
+                # shows the budget spent — the max gauge must not go clean
+                # before the labeled long-window gauge does.
+                burn_max = max(
+                    burn_max,
+                    burns[f"{int(short_s)}s"],
+                    burns[f"{int(long_s)}s"],
+                )
+            detail[name] = {
+                "kind": obj.kind,
+                "target": obj.target,
+                "sli": round(self.sli(name, now=now), 6),
+                "burn_rates": burns,
+            }
+            if obj.kind == "latency":
+                detail[name]["p99_us"] = self.p99_us(name, now=now)
+        return {
+            "slo_availability": round(self.sli("availability", now=now), 6),
+            "slo_fg_p99_us": round(self.p99_us("fg_latency", now=now), 1),
+            "slo_miss_rate": round(1.0 - self.sli("miss_rate", now=now), 6),
+            "slo_reshard_drain": round(self.sli("reshard_drain", now=now), 6),
+            "slo_burn_rate_max": round(burn_max, 4),
+            "slo_alerts_firing": len(alerts),
+            "slo_alerts_total": self.alerts_total,
+            "verdict": "burning" if alerts else "ok",
+            "objectives": detail,
+            "alerts": alerts,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Fleet scraper: off-loop, breaker-aware, bounded.
+# ---------------------------------------------------------------------------
+
+class _TargetState:
+    """Per-target scrape bookkeeping + a minimal availability breaker:
+    after ``fail_threshold`` consecutive scrape failures the target is
+    skipped until ``backoff_s`` elapses (one probe per window — a dead
+    member must cost the scraper one timeout per window, not one per
+    scrape)."""
+
+    def __init__(self, member_id: str, host: str, manage_port: int):
+        self.member_id = member_id
+        self.host = host
+        self.manage_port = manage_port
+        self.consecutive_failures = 0
+        self.skip_until = 0.0
+        self.last_ok_at = 0.0
+        self.scrapes = 0
+        self.failures = 0
+        self.last_error = ""
+        # Cumulative op counters at the last scrape (delta source).
+        self.prev_ops: Dict[str, dict] = {}
+        self.prev_suspended = 0
+        self.ops_per_s = 0.0
+        self.queue_depth = 0
+        self.spans: List[dict] = []
+
+
+class FleetScraper:
+    """Pulls each member's manage plane (``/trace`` + ``/stats``), feeds
+    the SLO engine with counter/histogram deltas, and keeps the last
+    per-member span set for the cluster trace join.
+
+    Off-loop by construction: :meth:`scrape_once` does blocking HTTP and
+    is called either from the background thread (:meth:`start`) or via
+    ``asyncio.to_thread`` (the manage plane's ``scope=cluster`` handler).
+    Bounded: per-member spans are capped at ``max_spans_per_member`` and
+    response bodies at ``max_body_bytes``. Breaker-aware: a target that
+    keeps failing is skipped until its backoff elapses (see
+    :class:`_TargetState`).
+    """
+
+    def __init__(self, targets: Sequence[Tuple[str, str, int]] = (),
+                 slo: Optional[SloEngine] = None,
+                 journal: Optional[EventJournal] = None,
+                 cluster=None,
+                 interval_s: float = 5.0,
+                 timeout_s: float = 2.0,
+                 max_spans_per_member: int = 512,
+                 max_body_bytes: int = 4 << 20,
+                 fail_threshold: int = 3,
+                 backoff_s: float = 10.0,
+                 clock=time.monotonic):
+        self.slo = slo if slo is not None else slo_engine()
+        self.journal = journal if journal is not None else get_journal()
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.max_spans_per_member = max_spans_per_member
+        self.max_body_bytes = max_body_bytes
+        self.fail_threshold = fail_threshold
+        self.backoff_s = backoff_s
+        self._clock = clock
+        self._targets: List[_TargetState] = []
+        self._lock = threading.Lock()
+        # Serializes whole scrape passes: the background thread and an
+        # on-demand ?scope=cluster refresh (asyncio.to_thread) must never
+        # delta the same prev_ops concurrently — that would feed the same
+        # op counters to the SLO engine twice.
+        self._pass_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.scrapes_total = 0
+        self.scrape_failures_total = 0
+        self._prev_debt: Optional[int] = None
+        for t in targets:
+            self.add_target(*t)
+
+    def add_target(self, member_id: str, host: str, manage_port: int):
+        with self._lock:
+            self._targets.append(_TargetState(member_id, host, manage_port))
+
+    # -- one scrape pass -----------------------------------------------------
+
+    def _get_json(self, st: _TargetState, path: str) -> dict:
+        url = f"http://{st.host}:{st.manage_port}{path}"
+        with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+            body = resp.read(self.max_body_bytes)
+        return json.loads(body)
+
+    def _feed_stats(self, st: _TargetState, stats: dict, now: float):
+        """Delta the member's cumulative op counters/histograms into the
+        SLO engine: ok/error deltas feed availability, histogram bucket
+        deltas feed the fg-latency objective.
+
+        With a cluster attached, the availability feed is SKIPPED: the
+        cluster already records every op outcome client-side (including
+        the fast-fails a dead member's scrape can never show), and
+        double-feeding the served ops from server counters would dilute
+        the bad fraction ~2x — a burn-rate alert firing at half strength
+        during an outage. Scrape-fed availability is the standalone
+        deployment's source (no cluster object in-process)."""
+        ops = stats.get("ops", {}) or {}
+        total_delta = 0
+        for op, s in ops.items():
+            prev = st.prev_ops.get(op, {})
+            d_count = s.get("count", 0) - prev.get("count", 0)
+            d_err = s.get("errors", 0) - prev.get("errors", 0)
+            if d_count < 0:  # member restarted: counters reset
+                prev, d_count, d_err = {}, s.get("count", 0), s.get("errors", 0)
+            if d_count > 0:
+                total_delta += d_count
+                if self.cluster is None:
+                    self.slo.record(
+                        "availability",
+                        good=max(0, d_count - d_err), bad=max(0, d_err),
+                    )
+            prev_hist = dict(prev.get("hist", []))
+            for le, cnt in s.get("hist_us", []):
+                d = cnt - prev_hist.get(le, 0)
+                if d > 0:
+                    self.slo.record_latency_bucket("fg_latency", le, d)
+            st.prev_ops[op] = {
+                "count": s.get("count", 0),
+                "errors": s.get("errors", 0),
+                "hist": [(le, cnt) for le, cnt in s.get("hist_us", [])],
+            }
+        if st.last_ok_at:
+            dt = max(1e-6, now - st.last_ok_at)
+            st.ops_per_s = total_delta / dt
+        st.queue_depth = stats.get("suspended_ops", 0)
+
+    def _feed_cluster(self):
+        """Reshard-drain SLI from the attached cluster: a scrape tick is
+        GOOD when the migration debt is zero or shrinking, BAD when debt
+        exists and did not drain since the last look."""
+        if self.cluster is None:
+            return
+        try:
+            debt = int(
+                self.cluster.membership_status().get("reshard_debt_roots", 0)
+            )
+        except Exception:
+            return
+        prev = self._prev_debt
+        self._prev_debt = debt
+        if debt == 0:
+            self.slo.record("reshard_drain", good=1)
+        elif prev is not None and debt < prev:
+            self.slo.record("reshard_drain", good=1)
+        elif prev is not None:
+            self.slo.record("reshard_drain", bad=1)
+
+    def scrape_once(self, spans: bool = True) -> dict:
+        """One blocking pass over every admitted target (callers keep this
+        OFF the event loop; concurrent passes serialize — the second runs
+        after the first and sees zero deltas). Returns a scrape summary.
+
+        ``spans=False`` pulls only ``/stats`` (the SLO feed) and keeps each
+        target's previously-held spans: the span dump is by far the
+        expensive half of a pass, and its only consumer —
+        ``GET /trace?scope=cluster`` — forces a fresh full pass anyway, so
+        the background loop never pays for it."""
+        with self._pass_lock:
+            return self._scrape_pass(spans)
+
+    def _scrape_pass(self, want_spans: bool = True) -> dict:
+        now = self._clock()
+        ok = skipped = failed = 0
+        with self._lock:
+            targets = list(self._targets)
+        for st in targets:
+            if (
+                st.consecutive_failures >= self.fail_threshold
+                and now < st.skip_until
+            ):
+                skipped += 1
+                continue
+            try:
+                stats = self._get_json(st, "/stats")
+                spans = None
+                if want_spans:
+                    trace = self._get_json(st, "/trace")
+                    spans = list(trace.get("spans", [])) + list(
+                        trace.get("server_spans", [])
+                    )
+                    for s in spans:
+                        s.setdefault("attrs", {})["member"] = st.member_id
+                self._feed_stats(st, stats, now)
+                with self._lock:
+                    if spans is not None:
+                        st.spans = spans[-self.max_spans_per_member:]
+                    st.consecutive_failures = 0
+                    st.last_ok_at = now
+                    st.scrapes += 1
+                ok += 1
+                self.scrapes_total += 1
+            # Broad by design: an unexpected-SHAPE payload (version skew, a
+            # proxy answering the manage port) raises TypeError/KeyError in
+            # the feed path, and it must count against THIS target's
+            # breaker instead of aborting the rest of the pass.
+            except Exception as e:
+                failed += 1
+                self.scrape_failures_total += 1
+                with self._lock:
+                    st.failures += 1
+                    st.consecutive_failures += 1
+                    st.last_error = repr(e)
+                    if st.consecutive_failures >= self.fail_threshold:
+                        st.skip_until = self._clock() + self.backoff_s
+        self._feed_cluster()
+        self.slo.evaluate()
+        return {"ok": ok, "failed": failed, "skipped": skipped}
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self):
+        """Run :meth:`scrape_once` every ``interval_s`` on a daemon
+        thread (the off-loop half of the manage plane's fleet view)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="its-fleet-scraper", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        # Scrape immediately on entry — waiting a full interval first would
+        # leave /slo serving empty member rows for interval_s after start().
+        while True:
+            try:
+                self.scrape_once(spans=False)
+            except Exception:
+                # The scraper must never die to one bad payload; per-target
+                # failures are already counted in scrape_once.
+                self.scrape_failures_total += 1
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # -- read side -----------------------------------------------------------
+
+    def member_spans(self) -> Dict[str, List[dict]]:
+        """Last-scrape span dicts per member, each tagged
+        ``attrs.member`` (the cluster-trace-join input)."""
+        with self._lock:
+            return {st.member_id: list(st.spans) for st in self._targets}
+
+    def status(self) -> dict:
+        """Per-member scrape health for the ``/slo`` payload and
+        ``tools.top``."""
+        now = self._clock()
+        with self._lock:
+            members = [
+                {
+                    "member": st.member_id,
+                    "target": f"{st.host}:{st.manage_port}",
+                    "ok": st.consecutive_failures < self.fail_threshold,
+                    "last_scrape_age_s": (
+                        round(now - st.last_ok_at, 3) if st.last_ok_at else -1.0
+                    ),
+                    "scrapes": st.scrapes,
+                    "failures": st.failures,
+                    "consecutive_failures": st.consecutive_failures,
+                    "ops_per_s": round(st.ops_per_s, 1),
+                    "queue_depth": st.queue_depth,
+                    "last_error": st.last_error,
+                    "spans_held": len(st.spans),
+                }
+                for st in self._targets
+            ]
+        return {
+            "interval_s": self.interval_s,
+            "scrapes_total": self.scrapes_total,
+            "scrape_failures_total": self.scrape_failures_total,
+            "members": members,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Cluster trace join.
+# ---------------------------------------------------------------------------
+
+def cluster_spans(local_spans: List[dict],
+                  member_spans: Dict[str, List[dict]],
+                  max_spans: int = 4096) -> List[dict]:
+    """Merge the local client recorder's spans with every scraped
+    member's spans onto one timeline (everything is CLOCK_MONOTONIC us;
+    same-host processes share the timebase — the loopback/bench case —
+    and across hosts per-member deltas remain meaningful). Local spans
+    are tagged ``member="local"`` unless a member already claimed them;
+    output is start-ordered and bounded."""
+    merged: List[dict] = []
+    for s in local_spans:
+        s = dict(s)
+        s["attrs"] = {**s.get("attrs", {})}
+        s["attrs"].setdefault("member", "local")
+        merged.append(s)
+    for member_id, spans in member_spans.items():
+        for s in spans:
+            s = dict(s)
+            s["attrs"] = {**s.get("attrs", {})}
+            s["attrs"].setdefault("member", member_id)
+            merged.append(s)
+    merged.sort(key=lambda s: s.get("start_us", 0))
+    return merged[-max_spans:]
+
+
+def cluster_chrome_events(spans: List[dict]) -> List[dict]:
+    """Chrome trace events for a cluster-joined span list with ONE
+    Perfetto track lane (pid) per member — ``local`` (the client
+    recorder) first, then members in first-seen order — plus process_name
+    metadata events so Perfetto labels the lanes."""
+    lanes: Dict[str, int] = {}
+    events: List[dict] = []
+    for s in spans:
+        member = str(s.get("attrs", {}).get("member", "local"))
+        pid = lanes.setdefault(member, len(lanes))
+        for e in tracing.chrome_trace_events([s]):
+            e["pid"] = pid
+            events.append(e)
+    for member, pid in lanes.items():
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "ts": 0, "args": {"name": f"member:{member}"},
+        })
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singletons + transition-site helpers.
+# ---------------------------------------------------------------------------
+
+_journal = EventJournal()
+_slo: Optional[SloEngine] = None
+_qos_storm = _StormDetector()
+_lock = threading.Lock()
+
+
+def get_journal() -> EventJournal:
+    """The process-wide event journal (always on; events are rare)."""
+    return _journal
+
+
+def emit(kind: str, member: str = "", epoch: int = 0,
+         trace_id: Optional[int] = None, **attrs) -> dict:
+    """Emit into the process journal (see :meth:`EventJournal.emit`)."""
+    return _journal.emit(
+        kind, member=member, epoch=epoch, trace_id=trace_id, **attrs
+    )
+
+
+def slo_engine() -> SloEngine:
+    """The process-wide SLO engine (default objectives), built lazily so
+    importing the package costs nothing."""
+    global _slo
+    if _slo is None:
+        # Audited: O(1) double-checked singleton init — held only for one
+        # constructor call, never across IO.
+        with _lock:  # its: allow[ITS-L003]
+            if _slo is None:
+                _slo = SloEngine(journal=_journal)
+    return _slo
+
+
+def configure_slo(engine: Optional[SloEngine]) -> SloEngine:
+    """Install a custom engine (tests, bench legs with short windows);
+    ``None`` rebuilds the default lazily."""
+    global _slo
+    _slo = engine
+    return slo_engine() if engine is None else engine
+
+
+def note_qos_aged(n: int = 1, member: str = ""):
+    """Transition-site helper for the QoS aging escape: counts toward the
+    storm detector and emits ONE ``qos_aging_storm`` event per storm edge
+    (docs/qos.md — aged slices are the starvation-proof pressure valve;
+    a storm of them means background is systematically starved)."""
+    count = _qos_storm.note(n)
+    if count:
+        _journal.emit("qos_aging_storm", member=member, aged_in_window=count,
+                      window_s=_qos_storm.window_s)
+
+
+def _on_slow_op(span) -> None:
+    """Slow-op watchdog hook (registered with tracing at import): every
+    watchdog capture lands in the journal with the span's own trace id,
+    joining "this op was slow" to the breaker/membership/QoS events
+    around it."""
+    _journal.emit(
+        "slow_op", trace_id=span.trace_id, span=span.name,
+        duration_us=span.duration_us, status=span.status or "open",
+    )
+
+
+tracing.set_slow_op_hook(_on_slow_op)
+
+
+def reset():
+    """Test/bench hook: fresh journal contents, default SLO engine, and a
+    re-armed storm detector (singleton identities are preserved — code
+    that captured ``get_journal()`` keeps a live object)."""
+    global _slo, _qos_storm
+    _journal.clear()
+    _slo = None
+    _qos_storm = _StormDetector()

@@ -212,6 +212,7 @@ class FlightRecorder:
         self.slow_ops_total = 0
 
     def record(self, span: Span):
+        slow = False
         with self._lock:
             idx = self._next % self.capacity
             if self._next >= self.capacity:
@@ -221,6 +222,18 @@ class FlightRecorder:
             self.recorded += 1
             if self.slow_op_us and span.duration_us >= self.slow_op_us:
                 self._capture_slow_locked(span)
+                slow = True
+        if slow:
+            # Outside the (non-reentrant) ring lock: a hook that itself
+            # records or finishes a span must not deadlock the recorder.
+            hook = _slow_op_hook
+            if hook is not None:
+                try:
+                    hook(span)
+                except Exception:
+                    # A listener (the telemetry journal) must never be able
+                    # to fail the recording hot path.
+                    pass
 
     def _capture_slow_locked(self, span: Span):
         self.slow_ops_total += 1
@@ -259,6 +272,20 @@ _recorder: Optional[FlightRecorder] = None
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "its_trace_span", default=None
 )
+
+# Slow-op listener (telemetry.py registers the event journal here at
+# import). A plain module slot, not a list: exactly one fleet-telemetry
+# plane per process, and tracing must not import telemetry (telemetry
+# imports tracing).
+_slow_op_hook = None
+
+
+def set_slow_op_hook(cb) -> None:
+    """Register ``cb(span)`` to run on every slow-op watchdog capture
+    (``None`` unregisters). Exceptions from the hook are swallowed — it
+    observes the recorder, it cannot fail it."""
+    global _slow_op_hook
+    _slow_op_hook = cb
 
 
 def configure(enabled: Optional[bool] = None,

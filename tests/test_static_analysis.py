@@ -750,6 +750,126 @@ class TestCountersMembership:
 
 
 # ---------------------------------------------------------------------------
+# counters ITS-C006: fleet-telemetry vocabulary lockstep
+# ---------------------------------------------------------------------------
+
+C006_TELEMETRY = '''\
+EVENT_KINDS = (
+    "breaker_open",
+    "membership_epoch",
+)
+
+
+class SloEngine:
+    def status(self):
+        return {
+            "slo_availability": 1.0,
+            "slo_burn_rate_max": 0.0,
+            "verdict": "ok",
+        }
+
+
+def emit(kind, **attrs):
+    pass
+
+
+emit("membership_epoch")
+'''
+
+C006_PRODUCER = '''\
+from . import telemetry
+
+telemetry.emit("breaker_open", member="m0")
+'''
+
+C006_MANAGE_OK = '''\
+def _slo_prometheus_lines(slo):
+    return [
+        f"a {slo['slo_availability']}",
+        f"b {slo['slo_burn_rate_max']}",
+    ]
+
+route_a = "/slo"      # served from telemetry.slo_engine
+route_b = "/events"   # served from telemetry.get_journal
+served = (slo_engine, get_journal)
+'''
+
+C006_DOCS = "table: breaker_open membership_epoch slo_availability slo_burn_rate_max\n"
+
+
+class TestCountersTelemetry:
+    def scan(self, tmp_path, manage_src=C006_MANAGE_OK,
+             telemetry_src=C006_TELEMETRY, producer_src=C006_PRODUCER,
+             docs=C006_DOCS):
+        ctx = make_tree(tmp_path, {
+            "manage.py": manage_src,
+            "pkg/telemetry.py": telemetry_src,
+            "pkg/producer.py": producer_src,
+            "docs/obs.md": docs,
+        })
+        return counters._scan_telemetry(
+            ctx, "manage.py", telemetry_rel="pkg/telemetry.py",
+            docs_rel="docs/obs.md", package_rel="pkg",
+        )
+
+    def test_complete_vocabulary_is_clean(self, tmp_path):
+        assert self.scan(tmp_path) == []
+
+    def test_unexported_slo_key_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace(
+            "        f\"b {slo['slo_burn_rate_max']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.rule == "ITS-C006" and f.key.endswith("slo_burn_rate_max")
+            for f in found
+        )
+
+    def test_stale_slo_exporter_key_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace(
+            "slo_burn_rate_max", "slo_gone_key")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("stale:slo_gone_key") for k in keys)
+        assert any(k.endswith(":slo_burn_rate_max") for k in keys)
+
+    def test_undocumented_slo_key_fires(self, tmp_path):
+        docs = C006_DOCS.replace("slo_availability", "")
+        found = self.scan(tmp_path, docs=docs)
+        assert any(
+            f.key.endswith("undocumented:slo_availability") for f in found
+        )
+
+    def test_unknown_event_kind_fires_at_producer(self, tmp_path):
+        producer = C006_PRODUCER.replace("breaker_open", "made_up_kind")
+        found = self.scan(tmp_path, producer_src=producer)
+        hits = [f for f in found if "unknown-kind:made_up_kind" in f.key]
+        assert hits and hits[0].file == "pkg/producer.py"
+        # ...and breaker_open is now dead vocabulary (no producer left).
+        assert any(f.key.endswith("dead:breaker_open") for f in found)
+
+    def test_undocumented_event_kind_fires(self, tmp_path):
+        docs = C006_DOCS.replace("membership_epoch", "")
+        found = self.scan(tmp_path, docs=docs)
+        assert any(
+            f.key.endswith("undocumented:membership_epoch") for f in found
+        )
+
+    def test_missing_slo_route_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace('"/slo"', '"/nope"')
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("slo-route") for f in found)
+
+    def test_missing_events_route_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace("get_journal", "no_journal")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("events-route") for f in found)
+
+    def test_real_telemetry_vocabulary_is_clean(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in counters.scan(ctx) if f.rule == "ITS-C006"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # trace_stages (ITS-T*)
 # ---------------------------------------------------------------------------
 

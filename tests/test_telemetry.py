@@ -1,0 +1,696 @@
+"""Fleet telemetry plane (docs/observability.md, fleet section).
+
+Covers the contracts the telemetry PR established:
+
+- the event journal is a bounded causal ring: seq-monotone, per-kind
+  counts survive eviction, events stamp the ACTIVE trace id;
+- the SLO window math is deterministic under an injected clock (no
+  sleeps): burn-rate monotonicity, window roll-off, multi-window firing,
+  alert hysteresis, latency-bucket classification and windowed p99;
+- the fleet scraper is breaker-aware (a dead target is skipped until its
+  backoff elapses) and feeds the SLO engine from scraped deltas;
+- `GET /trace?scope=cluster` merges spans from TWO real server processes
+  for one traced fan-out op, joined by trace id, over real HTTP — with
+  one Perfetto lane per member in ?fmt=chrome;
+- `/slo`, `/events` and the SLO-aware `/health` verdict over real HTTP;
+- satellites: OpenMetrics exemplars behind ?exemplars=1 (default output
+  unchanged), Logger trace context;
+- (chaos) a breaker trip + recovery lands in the journal with the
+  correct trace link.
+"""
+
+import asyncio
+import json
+import socket
+import subprocess
+import sys
+import time
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu import telemetry, tracing
+from infinistore_tpu.lib import InfiniStoreException, Logger
+from infinistore_tpu.server import ManageServer, _prometheus_text
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    tracing.configure(enabled=False)
+
+
+@pytest.fixture()
+def traced():
+    rec = tracing.configure(enabled=True, capacity=256, slow_op_us=0)
+    rec.clear()
+    yield rec
+    tracing.configure(enabled=False)
+
+
+# ---------------------------------------------------------------------------
+# Event journal.
+# ---------------------------------------------------------------------------
+
+
+class TestEventJournal:
+    def test_ring_bounded_and_seq_monotone(self):
+        j = telemetry.EventJournal(capacity=4)
+        for i in range(10):
+            j.emit("slow_op", idx=i)
+        snap = j.snapshot()
+        assert len(snap) == 4
+        assert [e["seq"] for e in snap] == [7, 8, 9, 10]
+        assert [e["attrs"]["idx"] for e in snap] == [6, 7, 8, 9]
+        # Counts survive ring eviction.
+        assert j.counts() == {"slow_op": 10}
+        assert j.emitted == 10
+
+    def test_since_seq_and_limit(self):
+        j = telemetry.EventJournal(capacity=16)
+        for i in range(6):
+            j.emit("breaker_open", member=f"m{i}")
+        assert [e["seq"] for e in j.snapshot(since_seq=4)] == [5, 6]
+        assert [e["seq"] for e in j.snapshot(limit=2)] == [5, 6]
+
+    def test_active_span_trace_id_is_stamped(self, traced):
+        j = telemetry.EventJournal()
+        with tracing.trace_op("op", stage="enqueue") as sp:
+            j.emit("breaker_open", member="m0")
+        j.emit("breaker_closed", member="m0")
+        ev = j.snapshot()
+        assert ev[0]["trace_id"] == sp.trace_id
+        assert ev[1]["trace_id"] == 0
+        assert j.for_trace({sp.trace_id}) == [ev[0]]
+
+    def test_slow_op_hook_journals_watchdog_captures(self):
+        rec = tracing.FlightRecorder(capacity=8, slow_op_us=50_000)
+        s = tracing.Span("slow_thing")
+        s.t1_us = s.t0_us + 60_000
+        s.status = "ok"
+        rec.record(s)
+        events = telemetry.get_journal().snapshot()
+        assert len(events) == 1
+        assert events[0]["kind"] == "slow_op"
+        assert events[0]["trace_id"] == s.trace_id
+        assert events[0]["attrs"]["span"] == "slow_thing"
+        assert events[0]["attrs"]["duration_us"] >= 50_000
+
+
+class TestStormDetector:
+    def test_edge_trigger_and_rearm_hysteresis(self):
+        clk = [0.0]
+        d = telemetry._StormDetector(
+            threshold=4, window_s=1.0, clock=lambda: clk[0]
+        )
+        assert d.note(3) == 0
+        assert d.note(1) == 4          # edge fires at the threshold
+        assert d.note(10) == 0         # sustained storm: no refire
+        clk[0] = 2.5                   # quiet window drains the deque
+        # Production-shaped re-arm: the callers only ever note(>=1), so the
+        # empty-window check must happen before this note's escapes land.
+        assert d.note(1) == 0          # re-arms, 1 in window: below edge
+        assert d.note(3) == 4          # the NEXT storm fires again
+        assert d.note(4) == 0          # and is again edge-triggered
+
+
+# ---------------------------------------------------------------------------
+# SLO window math (injected clock; no sleeps anywhere).
+# ---------------------------------------------------------------------------
+
+
+def make_engine(clk, windows=((10.0, 60.0, 10.0),), target=0.99,
+                clear_ratio=0.5, journal=None):
+    return telemetry.SloEngine(
+        objectives=[
+            telemetry.SloObjective("availability", target=target),
+            telemetry.SloObjective(
+                "fg_latency", target=0.9, kind="latency",
+                latency_threshold_us=1000.0,
+            ),
+        ],
+        windows=windows, clear_ratio=clear_ratio, bucket_s=1.0,
+        clock=lambda: clk[0], journal=journal,
+    )
+
+
+class TestSloWindows:
+    def test_idle_sli_is_met_and_burn_zero(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        assert e.sli("availability") == 1.0
+        assert e.burn_rate("availability", 10.0) == 0.0
+        assert e.status()["verdict"] == "ok"
+
+    def test_burn_rate_monotone_in_bad_samples(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        e.record("availability", good=100)
+        last = e.burn_rate("availability", 10.0)
+        for _ in range(20):
+            e.record("availability", bad=1)
+            burn = e.burn_rate("availability", 10.0)
+            assert burn >= last  # more bad at fixed time never lowers burn
+            last = burn
+        # 20 bad / 120 total at a 1% budget: ~16.7x burn.
+        assert last == pytest.approx((20 / 120) / 0.01, rel=1e-6)
+
+    def test_window_roll_off(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        e.record("availability", bad=10)
+        assert e.burn_rate("availability", 10.0) > 0
+        clk[0] += 11.0  # the short window passed: old badness ages out
+        assert e.burn_rate("availability", 10.0) == 0.0
+        # ...but the long window still sees it.
+        assert e.burn_rate("availability", 60.0) > 0
+        clk[0] += 60.0
+        assert e.burn_rate("availability", 60.0) == 0.0
+
+    def test_alert_needs_both_windows(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        # Short-window spike only: old GOOD traffic fills the long window.
+        clk[0] = 1000.0
+        e.record("availability", good=10000)
+        clk[0] = 1055.0
+        e.record("availability", bad=30, good=0)
+        short = e.burn_rate("availability", 10.0)
+        long = e.burn_rate("availability", 60.0)
+        assert short >= 10.0 > long  # sanity of the setup
+        assert e.evaluate() == []    # long window vetoes the page
+        # Sustained burn crosses both -> fires.
+        for t in range(60):
+            clk[0] = 1060.0 + t
+            e.record("availability", bad=5, good=5)
+        firing = e.evaluate()
+        assert len(firing) == 1
+        assert firing[0]["objective"] == "availability"
+
+    def test_alert_hysteresis(self):
+        clk = [1000.0]
+        j = telemetry.EventJournal()
+        e = make_engine(clk, journal=j)
+        for t in range(60):
+            clk[0] = 1000.0 + t
+            e.record("availability", bad=1, good=1)  # 50% bad = 50x burn
+        assert len(e.evaluate()) == 1
+        assert e.alerts_total == 1
+        # Burn drops BELOW the fire threshold but above clear_ratio*thr
+        # (10x fire, 5x clear): 6% bad = 6x burn -> still firing.
+        clk[0] = 1070.0
+        e.record("availability", bad=6, good=94)
+        clk[0] = 1070.5
+        assert len(e.evaluate()) == 1, "hysteresis must hold the alert up"
+        # Full roll-off of the short window -> burn under clear -> clears.
+        clk[0] = 1090.0
+        e.record("availability", good=100)
+        assert e.evaluate() == []
+        # Edges (fire + clear), not levels, were journaled.
+        kinds = [ev["attrs"]["state"] for ev in j.snapshot()]
+        assert kinds == ["firing", "cleared"]
+        assert e.alerts_total == 1
+
+    def test_latency_buckets_classify_and_p99(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        # 99 fast samples (le=500us) + 1 slow (le=2000us > 1000us threshold)
+        e.record_latency_bucket("fg_latency", 500.0, count=99)
+        e.record_latency_bucket("fg_latency", 2000.0, count=1)
+        assert e.sli("fg_latency") == pytest.approx(0.99)
+        assert e.p99_us("fg_latency") == 500.0
+        # Push the tail past 1%: p99 moves to the slow bucket.
+        e.record_latency_bucket("fg_latency", 2000.0, count=4)
+        assert e.p99_us("fg_latency") == 2000.0
+
+    def test_status_vocabulary_and_verdict(self):
+        clk = [1000.0]
+        e = make_engine(clk)
+        st = e.status()
+        for key in ("slo_availability", "slo_fg_p99_us", "slo_miss_rate",
+                    "slo_reshard_drain", "slo_burn_rate_max",
+                    "slo_alerts_firing", "slo_alerts_total"):
+            assert key in st, key
+        assert st["verdict"] == "ok"
+        for t in range(60):
+            clk[0] = 1000.0 + t
+            e.record("availability", bad=1)
+        st = e.status()
+        assert st["verdict"] == "burning" and st["slo_alerts_firing"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet scraper: breaker-aware HTTP pulls + SLO feeding.
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+
+
+class TestFleetScraper:
+    def test_scrape_feeds_slo_and_breakers_dead_targets(self, server, traced):
+        from infinistore_tpu import lib as its_lib
+
+        c = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=server["port"],
+            log_level="error",
+        ))
+        c.connect()
+        buf = np.random.randint(0, 256, size=4096, dtype=np.uint8)
+        c.register_mr(buf)
+        with tracing.trace_op("scrape_put", stage="enqueue"):
+            c.write_cache([("sc-0", 0)], 4096, buf.ctypes.data)
+
+        clk = [0.0]
+        engine = telemetry.configure_slo(telemetry.SloEngine(
+            windows=((5.0, 20.0, 10.0),), bucket_s=1.0, clock=lambda: clk[0]
+        ))
+        dead_port = _free_port()  # nothing listens here
+
+        async def run():
+            manage = ManageServer(server["config"])
+            manage._server = await asyncio.start_server(
+                manage._handle, host="127.0.0.1", port=0
+            )
+            port = manage._server.sockets[0].getsockname()[1]
+            scraper = telemetry.FleetScraper(
+                targets=[("m0", "127.0.0.1", port),
+                         ("dead", "127.0.0.1", dead_port)],
+                slo=engine, timeout_s=1.0, fail_threshold=2, backoff_s=30.0,
+                clock=lambda: clk[0],
+            )
+            try:
+                summaries = [await asyncio.to_thread(scraper.scrape_once)
+                             for _ in range(3)]
+            finally:
+                manage._server.close()
+                await manage._server.wait_closed()
+            return scraper, summaries
+
+        old = its_lib._server_handle
+        its_lib._server_handle = server["handle"]
+        try:
+            scraper, summaries = asyncio.run(run())
+        finally:
+            its_lib._server_handle = old
+        c.close()
+
+        # Pass 1: live target ok, dead target fails. Pass 2: dead fails
+        # again and trips its breaker. Pass 3: dead is SKIPPED (backoff).
+        assert [s["ok"] for s in summaries] == [1, 1, 1]
+        assert [s["failed"] for s in summaries] == [1, 1, 0]
+        assert summaries[2]["skipped"] == 1
+        status = scraper.status()
+        by_id = {m["member"]: m for m in status["members"]}
+        assert by_id["m0"]["ok"] and by_id["m0"]["scrapes"] == 3
+        assert not by_id["dead"]["ok"]
+        # The live member's op counters fed the availability SLI, and its
+        # histogram deltas fed the latency objective.
+        assert engine.sli("availability") == 1.0
+        assert engine._buckets.get("availability")
+        assert engine.p99_us("fg_latency") > 0
+        # The traced op's spans were pulled and tagged with the member id.
+        spans = scraper.member_spans()["m0"]
+        assert spans and all(s["attrs"]["member"] == "m0" for s in spans)
+        assert any(s["name"] == "scrape_put" for s in spans)
+
+    def test_reshard_drain_fed_from_cluster(self):
+        clk = [0.0]
+        engine = telemetry.SloEngine(
+            windows=((5.0, 20.0, 10.0),), bucket_s=1.0, clock=lambda: clk[0]
+        )
+
+        class FakeCluster:
+            debt = 5
+
+            def membership_status(self):
+                return {"reshard_debt_roots": self.debt}
+
+        cluster = FakeCluster()
+        scraper = telemetry.FleetScraper(
+            slo=engine, cluster=cluster, clock=lambda: clk[0]
+        )
+        scraper.scrape_once()           # first look: no trend yet
+        cluster.debt = 3
+        scraper.scrape_once()           # draining: good
+        scraper.scrape_once()           # stuck at 3: bad
+        cluster.debt = 0
+        scraper.scrape_once()           # drained: good
+        good, bad = engine._window_counts("reshard_drain", 20.0, clk[0])
+        assert (good, bad) == (2, 1)
+
+
+# ---------------------------------------------------------------------------
+# Cluster trace join over real HTTP: 2 real server processes, one trace.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """TWO real server subprocesses (distinct processes, own manage
+    planes) — the fleet the cluster-scope trace join is specified
+    against. Spawn + readiness live in tools.fleet, shared with the
+    bench telemetry leg so the two fleets cannot diverge."""
+    from tools.fleet import spawn_fleet_servers
+
+    try:
+        members = spawn_fleet_servers(2)
+    except RuntimeError as e:
+        pytest.fail(str(e))
+    procs = [m["proc"] for m in members]
+    yield members
+    for p in procs:
+        p.send_signal(2)
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+class TestClusterTraceJoin:
+    def _mk_cluster(self, fleet):
+        import jax.numpy as jnp
+
+        from infinistore_tpu.cluster import ClusterKVConnector
+        from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+        spec = PagedKVCacheSpec(
+            num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+            head_dim=32, dtype=jnp.bfloat16,
+        )
+        conns = []
+        for m in fleet:
+            c = its.InfinityConnection(its.ClientConfig(
+                host_addr="127.0.0.1", service_port=m["service_port"],
+                log_level="error",
+            ))
+            c.connect()
+            conns.append(c)
+        cluster = ClusterKVConnector(
+            conns, spec, "fleet-test", max_blocks=8, replicas=2,
+        )
+        return spec, conns, cluster
+
+    def test_cluster_scope_merges_two_processes(self, fleet, traced):
+        import jax
+        import jax.numpy as jnp
+
+        spec, conns, cluster = self._mk_cluster(fleet)
+        member_ids = list(cluster.member_ids)
+        caches = []
+        for layer in range(spec.num_layers):
+            k = jax.random.normal(
+                jax.random.PRNGKey(layer), spec.cache_shape, jnp.float32
+            ).astype(spec.dtype)
+            caches.append((k, k))
+        tokens = list(range(2 * spec.block_tokens))
+        blocks = np.array([1, 4], np.int32)
+
+        async def go():
+            # replicas=2 over 2 members: ONE traced save fans out to BOTH
+            # server processes with the same trace context on the wire.
+            with tracing.trace_op("fanout_save", stage="enqueue") as sp:
+                n = await cluster.save(tokens, caches, blocks)
+            assert n > 0
+            return sp
+
+        sp = asyncio.run(go())
+
+        async def fetch():
+            scraper = telemetry.FleetScraper(
+                targets=[
+                    (member_ids[i], "127.0.0.1", fleet[i]["manage_port"])
+                    for i in range(2)
+                ],
+                timeout_s=2.0,
+            )
+            manage = ManageServer(
+                its.ServerConfig(host="127.0.0.1", manage_port=0),
+                scraper=scraper,
+            )
+            manage._server = await asyncio.start_server(
+                manage._handle, host="127.0.0.1", port=0
+            )
+            port = manage._server.sockets[0].getsockname()[1]
+            try:
+                doc = await _http_get(port, "/trace?scope=cluster")
+                chrome = await _http_get(
+                    port, "/trace?scope=cluster&fmt=chrome"
+                )
+            finally:
+                manage._server.close()
+                await manage._server.wait_closed()
+            return doc, chrome
+
+        doc, chrome = asyncio.run(fetch())
+        for c in conns:
+            c.close()
+
+        assert doc["scope"] == "cluster"
+        assert set(member_ids) <= set(doc["members"])
+        ours = [s for s in doc["spans"] if s["trace_id"] == sp.trace_id]
+        served_members = {
+            s["attrs"]["member"] for s in ours
+            if s["attrs"].get("side") == "server"
+        }
+        # THE criterion: one traced fan-out op's spans, joined by trace id,
+        # from >= 2 distinct server processes on one timeline.
+        assert len(served_members) >= 2, (served_members, ours)
+        # The local client span rides the same timeline.
+        assert any(s["attrs"]["member"] == "local" for s in ours)
+        # Timeline is monotonic and ordered: the client span opened before
+        # every server-side tick of the fan-out (same CLOCK_MONOTONIC).
+        client = [s for s in ours if s["attrs"]["member"] == "local"]
+        servers = [s for s in ours if s["attrs"].get("side") == "server"]
+        assert client and servers
+        t0 = min(s["start_us"] for s in client)
+        assert all(s["start_us"] >= t0 for s in servers)
+        # Chrome form: one lane (pid) per member, lanes labeled.
+        events = chrome["traceEvents"]
+        lanes = {
+            e["args"]["name"]: e["pid"] for e in events if e["ph"] == "M"
+        }
+        assert {f"member:{m}" for m in member_ids} <= set(lanes)
+        span_pids = {e["pid"] for e in events if e["ph"] == "X"}
+        assert len(span_pids) >= 3  # local + 2 members
+
+    def test_fleet_slo_events_health_over_http(self, fleet):
+        clk = [0.0]
+        engine = telemetry.configure_slo(telemetry.SloEngine(
+            windows=((5.0, 20.0, 10.0),), bucket_s=1.0, clock=lambda: clk[0],
+            journal=telemetry.get_journal(),
+        ))
+        telemetry.emit("membership_epoch", member="m-x", epoch=7,
+                       action="add")
+
+        async def run(paths):
+            manage = ManageServer(
+                its.ServerConfig(host="127.0.0.1", manage_port=0)
+            )
+            manage._server = await asyncio.start_server(
+                manage._handle, host="127.0.0.1", port=0
+            )
+            port = manage._server.sockets[0].getsockname()[1]
+            try:
+                return [await _http_get(port, p) for p in paths]
+            finally:
+                manage._server.close()
+                await manage._server.wait_closed()
+
+        slo, events, health = asyncio.run(run(["/slo", "/events", "/health"]))
+        assert slo["verdict"] == "ok" and "slo_availability" in slo
+        assert events["counts"] == {"membership_epoch": 1}
+        assert events["events"][0]["member"] == "m-x"
+        assert health["status"] == "ok"
+
+        # Burn the budget -> /health consumes the verdict and degrades.
+        for t in range(30):
+            clk[0] = float(t)
+            engine.record("availability", bad=1)
+        clk[0] = 30.0
+        (health2,) = asyncio.run(run(["/health"]))
+        assert health2["status"] == "degraded"
+        assert health2["slo_verdict"] == "burning"
+        assert health2["slo_alerts_firing"] >= 1
+        # The alert edge itself was journaled.
+        kinds = [e["kind"] for e in telemetry.get_journal().snapshot()]
+        assert "slo_alert" in kinds
+
+
+# ---------------------------------------------------------------------------
+# Satellites: OpenMetrics exemplars + Logger trace context.
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_exemplar_links_bucket_to_trace(self, server, traced):
+        c = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=server["port"],
+            log_level="error",
+        ))
+        c.connect()
+        buf = np.random.randint(0, 256, size=4096, dtype=np.uint8)
+        c.register_mr(buf)
+        with tracing.trace_op("ex_put", stage="enqueue") as sp:
+            c.write_cache([("ex-0", 0)], 4096, buf.ctypes.data)
+        stats = c.get_stats()
+        plain_hdr, plain = (
+            _prometheus_text(stats).decode().split("\r\n\r\n", 1)
+        )
+        ex_hdr, with_ex = (
+            _prometheus_text(stats, exemplars=True)
+            .decode().split("\r\n\r\n", 1)
+        )
+        c.close()
+        # Default output carries NO exemplar syntax (plain Prometheus).
+        assert " # {" not in plain
+        assert "# EOF" not in plain
+        # The exemplar variant declares OpenMetrics (whose parser requires
+        # exemplar syntax + the trailing ``# EOF``); the default stays plain.
+        assert "openmetrics-text" in ex_hdr
+        assert "openmetrics-text" not in plain_hdr
+        assert with_ex.rstrip("\n").endswith("# EOF")
+        # The flagged output attaches the slow op's trace id to exactly the
+        # histogram family, in OpenMetrics exemplar syntax.
+        ex_lines = [ln for ln in with_ex.splitlines() if " # {" in ln]
+        assert ex_lines
+        assert all(
+            ln.startswith("infinistore_op_duration_us_bucket") for ln in ex_lines
+        )
+        assert any(f'trace_id="{sp.trace_id:#x}"' in ln for ln in ex_lines)
+        # Additivity: stripping exemplars recovers the plain SAMPLE lines
+        # exactly — only TYPE declarations may adapt to OpenMetrics
+        # counter-naming rules (family declared by base name, or
+        # downgraded to ``unknown`` for legacy names without ``_total``).
+        om_samples = [
+            ln.split(" # ", 1)[0] for ln in with_ex.splitlines()
+            if not ln.startswith("#")
+        ]
+        plain_samples = [
+            ln for ln in plain.splitlines() if not ln.startswith("#")
+        ]
+        assert om_samples == plain_samples, "exemplars must be additive"
+        om_types = [
+            ln for ln in with_ex.splitlines() if ln.startswith("# TYPE ")
+        ]
+        plain_types = [
+            ln for ln in plain.splitlines() if ln.startswith("# TYPE ")
+        ]
+        assert len(om_types) == len(plain_types)
+        for ln in om_types:
+            family, typ = ln.split(" ")[2], ln.split(" ")[3]
+            if typ == "counter":
+                # Conformant: base-named family with _total samples.
+                assert not family.endswith("_total"), ln
+                assert any(
+                    s.startswith(family + "_total") for s in om_samples
+                ), ln
+
+
+class TestLoggerContext:
+    def test_lines_carry_trace_context_inside_span(self, traced):
+        with tracing.trace_op("log_op", stage="enqueue") as sp:
+            text = Logger.with_context("hello")
+            assert f"trace_id={sp.trace_id:#x}" in text
+            assert f"span={sp.span_id:#x}" in text
+            assert "member=" not in text
+            sp.annotate(cluster_member=3)
+            assert Logger.with_context("x").endswith("member=3")
+
+    def test_plain_outside_span_or_disabled(self, traced):
+        assert Logger.with_context("plain") == "plain"
+        tracing.configure(enabled=False)
+        assert Logger.with_context("off") == "off"
+
+
+# ---------------------------------------------------------------------------
+# Chaos: breaker trip + recovery journaled with the trace link.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestBreakerEventsTraceLink:
+    def test_trip_and_recovery_events_link_to_trace(self, server, traced):
+        import jax.numpy as jnp
+
+        from infinistore_tpu.cluster import CircuitBreaker, ClusterKVConnector
+        from infinistore_tpu.faults import FaultRule, FaultyConnection
+        from infinistore_tpu.tpu.paged import PagedKVCacheSpec
+
+        spec = PagedKVCacheSpec(
+            num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+            head_dim=32, dtype=jnp.bfloat16,
+        )
+        inner = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=server["port"],
+            log_level="error",
+        ))
+        inner.connect()
+        faulty = FaultyConnection(
+            inner, [FaultRule(op="get_match_last_index", action="error")]
+        )
+        cluster = ClusterKVConnector(
+            [faulty], spec, "ev", max_blocks=8, degrade=False,
+            breaker_factory=lambda i: CircuitBreaker(
+                fail_threshold=2, probe_backoff_s=0.05, max_backoff_s=0.2,
+                seed=i,
+            ),
+        )
+        member = cluster.member_ids[0]
+        tokens = list(range(16))
+        spans = []
+        for _ in range(2):
+            with pytest.raises(InfiniStoreException):
+                with tracing.trace_op("trip_lookup", stage="enqueue") as sp:
+                    cluster.lookup(tokens)
+            spans.append(sp)
+        assert cluster.health()["members"][0]["breaker_state"] == "open"
+
+        events = telemetry.get_journal().snapshot()
+        opens = [e for e in events if e["kind"] == "breaker_open"]
+        assert len(opens) == 1
+        assert opens[0]["member"] == member
+        assert opens[0]["epoch"] >= 1
+        # THE causal link: the trip event carries the trace id of the op
+        # that tripped it — and that span is in the flight recorder.
+        assert opens[0]["trace_id"] == spans[-1].trace_id
+        recorded = {s["trace_id"] for s in tracing.recorder().snapshot()}
+        assert opens[0]["trace_id"] in recorded
+
+        # Heal the fault; the half-open probe recovers and is journaled.
+        faulty.rules.clear()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with tracing.trace_op("heal_lookup", stage="enqueue"):
+                try:
+                    cluster.lookup(tokens)
+                except InfiniStoreException:
+                    pass
+            if cluster.health()["members"][0]["breaker_state"] == "closed":
+                break
+            time.sleep(0.02)
+        kinds = [e["kind"] for e in telemetry.get_journal().snapshot()]
+        assert "breaker_half_open" in kinds
+        assert "breaker_closed" in kinds
+        closed = [
+            e for e in telemetry.get_journal().snapshot()
+            if e["kind"] == "breaker_closed"
+        ]
+        assert closed[-1]["member"] == member
+        assert closed[-1]["trace_id"] != 0  # recovery rode a traced lookup
+        inner.close()

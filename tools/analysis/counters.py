@@ -30,6 +30,13 @@ and cross-checks them:
   not consume keys the snapshot no longer emits (KeyError at scrape
   time); the manage plane must keep serving GET/POST ``/membership``
   from ``membership_status``.
+- ITS-C006 fleet-telemetry vocabulary drift (docs/observability.md):
+  every ``slo_*`` key of ``telemetry.SloEngine.status`` must be consumed
+  by the /metrics SLO exporter (``server.py _slo_prometheus_lines``) and
+  documented; every event kind a producer ``emit()``s must be in
+  ``telemetry.EVENT_KINDS``, every kind must keep at least one producer
+  and a docs row; and the manage plane must keep serving ``/slo`` and
+  ``/events``.
 
 Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
 both sides.
@@ -70,6 +77,17 @@ MEMBERSHIP_LEDGERS: List[str] = [
     "Resharder.progress",
 ]
 MEMBERSHIP_EXPORT_FN = "_membership_prometheus_lines"
+
+# The fleet telemetry plane (ITS-C006, docs/observability.md): the SLO
+# status ledger whose ``slo_*`` keys must reach the /metrics SLO exporter,
+# the event-kind vocabulary every ``emit()`` producer must draw from (and
+# every kind of which must have a producer and a docs row), and the manage
+# routes that must keep serving them.
+TELEMETRY_REL = "infinistore_tpu/telemetry.py"
+TELEMETRY_SLO_LEDGER = "SloEngine.status"
+SLO_EXPORT_FN = "_slo_prometheus_lines"
+TELEMETRY_DOCS_REL = "docs/observability.md"
+TELEMETRY_PACKAGE_REL = "infinistore_tpu"
 
 # Trace-surface exporters (docs/observability.md): the /trace payload
 # builder consumes the native ring's counters from the stats snapshot, and
@@ -385,6 +403,162 @@ def scan(
             key=f"ITS-C004:{manage_rel}:stats-route",
         ))
     findings += _scan_membership(ctx, manage_rel, MEMBERSHIP_REL)
+    findings += _scan_telemetry(ctx, manage_rel)
+    return findings
+
+
+def _event_kinds(ctx: Context, telemetry_rel: str) -> List[str]:
+    """The EVENT_KINDS tuple literal of the telemetry module."""
+    tree = ast.parse(ctx.read(telemetry_rel))
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "EVENT_KINDS"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return [
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return []
+
+
+def _emit_producers(ctx: Context, package_rel: str) -> List[Tuple[str, int, str]]:
+    """Every ``emit("<kind literal>", ...)`` call site in the package —
+    ``telemetry.emit``, ``journal.emit`` and the bare imported name all
+    count: the first positional string IS the producer's kind claim."""
+    out: List[Tuple[str, int, str]] = []
+    for rel in ctx.walk_py(package_rel):
+        try:
+            tree = ast.parse(ctx.read(rel))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name != "emit":
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+                out.append((rel, node.lineno, arg0.value))
+    return out
+
+
+def _scan_telemetry(
+    ctx: Context,
+    manage_rel: str = MANAGE_REL,
+    telemetry_rel: str = TELEMETRY_REL,
+    docs_rel: str = TELEMETRY_DOCS_REL,
+    package_rel: str = TELEMETRY_PACKAGE_REL,
+) -> List[Finding]:
+    """ITS-C006: the fleet-telemetry vocabulary in lockstep — ``slo_*``
+    status keys vs the /metrics SLO exporter and the fleet docs, event
+    kinds vs their producers and the fleet docs, and the /slo + /events
+    manage routes (docs/observability.md, fleet section)."""
+    findings: List[Finding] = []
+    if not ctx.exists(telemetry_rel):
+        return findings
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+
+    # -- slo_* status keys vs the exporter + docs ---------------------------
+    status_keys, status_line = ledger_keys(
+        ctx, telemetry_rel, TELEMETRY_SLO_LEDGER
+    )
+    status_keys = {k.rsplit(".", 1)[-1] for k in status_keys}
+    status_keys = {k for k in status_keys if k.startswith("slo_")}
+    consumed = {
+        k for k in metrics_consumed_keys(ctx, manage_rel, fn_name=SLO_EXPORT_FN)
+        if k.startswith("slo_")
+    }
+    for key in sorted(status_keys - consumed):
+        findings.append(Finding(
+            rule="ITS-C006", file=manage_rel, line=1,
+            message=f"SLO status key {key!r} is not exported by the /metrics "
+                    f"SLO exporter ({SLO_EXPORT_FN}) — an SLI dashboards "
+                    "cannot see is observability drift "
+                    "(docs/observability.md)",
+            key=f"ITS-C006:{manage_rel}:{key}",
+        ))
+    for key in sorted(consumed - status_keys):
+        findings.append(Finding(
+            rule="ITS-C006", file=manage_rel, line=1,
+            message=f"/metrics SLO exporter consumes key {key!r} which "
+                    f"{TELEMETRY_SLO_LEDGER} no longer emits (KeyError at "
+                    "scrape time)",
+            key=f"ITS-C006:{manage_rel}:stale:{key}",
+        ))
+    for key in sorted(status_keys):
+        if key not in doc_words:
+            findings.append(Finding(
+                rule="ITS-C006", file=telemetry_rel, line=status_line,
+                message=f"SLO status key {key!r} is undocumented in "
+                        f"{docs_rel} — the SLO vocabulary table must "
+                        "enumerate it",
+                key=f"ITS-C006:{telemetry_rel}:undocumented:{key}",
+            ))
+
+    # -- event kinds vs producers + docs ------------------------------------
+    kinds = _event_kinds(ctx, telemetry_rel)
+    produced: Dict[str, List[Tuple[str, int]]] = {}
+    for rel, line, kind in _emit_producers(ctx, package_rel):
+        produced.setdefault(kind, []).append((rel, line))
+    for kind, sites in sorted(produced.items()):
+        if kind not in kinds:
+            rel, line = sites[0]
+            findings.append(Finding(
+                rule="ITS-C006", file=rel, line=line,
+                message=f"event kind {kind!r} emitted outside the "
+                        f"EVENT_KINDS vocabulary ({telemetry_rel}) — add it "
+                        "there (and to the docs event table) or fix the "
+                        "producer",
+                key=f"ITS-C006:{rel}:unknown-kind:{kind}",
+            ))
+    for kind in kinds:
+        if kind not in produced:
+            findings.append(Finding(
+                rule="ITS-C006", file=telemetry_rel, line=1,
+                message=f"event kind {kind!r} has no emit() producer left — "
+                        "dead vocabulary (remove it or restore the "
+                        "transition-site emit)",
+                key=f"ITS-C006:{telemetry_rel}:dead:{kind}",
+            ))
+        if kind not in doc_words:
+            findings.append(Finding(
+                rule="ITS-C006", file=telemetry_rel, line=1,
+                message=f"event kind {kind!r} is undocumented in {docs_rel} "
+                        "— the event schema table must enumerate it",
+                key=f"ITS-C006:{telemetry_rel}:undocumented:{kind}",
+            ))
+
+    # -- manage routes -------------------------------------------------------
+    manage_src = ctx.read(manage_rel)
+    if not re.search(r'[\'"]/slo[\'"]', manage_src) or "slo_engine" not in manage_src:
+        findings.append(Finding(
+            rule="ITS-C006", file=manage_rel, line=1,
+            message="manage plane must serve GET /slo from the telemetry "
+                    "SLO engine (the burn-rate verdict surface, "
+                    "docs/observability.md)",
+            key=f"ITS-C006:{manage_rel}:slo-route",
+        ))
+    if (
+        not re.search(r'[\'"]/events[\'"]', manage_src)
+        or "get_journal" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C006", file=manage_rel, line=1,
+            message="manage plane must serve GET /events from the telemetry "
+                    "event journal (the causal cluster-event surface, "
+                    "docs/observability.md)",
+            key=f"ITS-C006:{manage_rel}:events-route",
+        ))
     return findings
 
 

@@ -258,6 +258,60 @@ CHECKS = [
             f"{m['trace_endpoint_events']:.0f} Chrome trace events"
         ),
     ),
+    # Fleet telemetry (docs/observability.md, fleet section). Binary gates:
+    # the availability burn-rate alert must FIRE during the fault-injected
+    # window and be SILENT in the clean run (a false positive teaches
+    # operators to delete the alert — silence-when-clean is as load-bearing
+    # as firing-when-burning), and the member kill's breaker_open journal
+    # event must carry a live trace id (the causal link the journal exists
+    # for).
+    Check(
+        "telemetry_slo_alerts",
+        ["telemetry_alert_fired_faulty", "telemetry_alert_fired_clean"],
+        lambda m: (
+            m["telemetry_alert_fired_faulty"] == 1
+            and m["telemetry_alert_fired_clean"] == 0
+        ),
+        lambda m: (
+            f"burn-rate alert fired_faulty="
+            f"{m['telemetry_alert_fired_faulty']:.0f} (must be 1), "
+            f"fired_clean={m['telemetry_alert_fired_clean']:.0f} "
+            "(must be 0: zero false positives)"
+        ),
+    ),
+    Check(
+        "telemetry_breaker_link",
+        ["telemetry_event_breaker_trace_linked"],
+        lambda m: m["telemetry_event_breaker_trace_linked"] >= 1,
+        lambda m: (
+            f"{m['telemetry_event_breaker_trace_linked']:.0f} breaker_open "
+            "event(s) linked to a live trace id (must be >= 1)"
+        ),
+    ),
+    # The cluster trace join: one traced fan-out op's spans must arrive
+    # from >= 2 DISTINCT server processes through GET /trace?scope=cluster
+    # over real HTTP — the whole point of the fleet scraper.
+    Check(
+        "telemetry_cluster_trace",
+        ["telemetry_cluster_trace_members"],
+        lambda m: m["telemetry_cluster_trace_members"] >= 2,
+        lambda m: (
+            f"{m['telemetry_cluster_trace_members']:.0f} server processes "
+            "joined one traced fan-out op (must be >= 2)"
+        ),
+    ),
+    # Scrape+SLO overhead, same discipline as the tracing gate: <= 3% on
+    # the batched-get hot path, interleaved paired sampling with the
+    # min(median-of-ratios, ratio-of-sums) estimator.
+    Check(
+        "telemetry_overhead",
+        ["telemetry_overhead_cost"],
+        lambda m: m["telemetry_overhead_cost"] <= 0.03,
+        lambda m: (
+            f"fleet scraping costs {100 * m['telemetry_overhead_cost']:.2f}% "
+            "batched-get throughput (must be <= 3%)"
+        ),
+    ),
     Check(
         "async_bridge_overhead",
         ["p50_fetch_4k_us", "sync_p50_fetch_4k_us"],

@@ -1,0 +1,58 @@
+"""Fleet-of-server-subprocesses spawner, shared by the bench telemetry leg
+and tests/test_telemetry.py: both drive the same N-process fleet (real
+server subprocesses with their own manage planes), and the spawn argv +
+readiness protocol must not diverge between them."""
+
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn_fleet_servers(n: int = 2, timeout_s: float = 20.0):
+    """``n`` REAL server subprocesses (own manage planes), ready to serve:
+    the service socket accepts and ``GET /health`` answers. Returns
+    ``[{"service_port", "manage_port", "proc"}]``; on a readiness timeout
+    every spawned process is killed and RuntimeError raised."""
+    members = []
+    for _ in range(n):
+        service_port, manage_port = free_port(), free_port()
+        proc = subprocess.Popen([
+            sys.executable, "-m", "infinistore_tpu.server",
+            "--host", "127.0.0.1",
+            "--service-port", str(service_port),
+            "--manage-port", str(manage_port),
+            "--prealloc-size", "1", "--minimal-allocate-size", "16",
+            "--no-pin-memory", "--log-level", "error",
+        ])
+        members.append({
+            "service_port": service_port, "manage_port": manage_port,
+            "proc": proc,
+        })
+    deadline = time.time() + timeout_s
+    pending = list(members)
+    while pending and time.time() < deadline:
+        m = pending[0]
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", m["service_port"]), timeout=0.3
+            ):
+                pass
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{m['manage_port']}/health", timeout=0.5
+            )
+            pending.pop(0)
+        except OSError:
+            time.sleep(0.1)
+    if pending:
+        for m in members:
+            m["proc"].kill()
+        raise RuntimeError("fleet servers did not come up")
+    return members

@@ -56,6 +56,12 @@ SURFACE = [
         "trace_op", "start_span", "use_span", "active_span",
         "server_tick_spans", "chrome_trace_events", "stage_breakdown",
     ]),
+    ("infinistore_tpu.telemetry", [
+        "EventJournal", "SloObjective", "SloEngine", "FleetScraper",
+        "default_objectives", "cluster_spans", "cluster_chrome_events",
+        "get_journal", "emit", "slo_engine", "configure_slo",
+        "note_qos_aged",
+    ]),
     ("infinistore_tpu.vllm_v1", [
         "KVConnectorRole",
         "KVConnectorBase_V1",

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""``python -m tools.top`` — live fleet dashboard over the manage plane.
+
+One screen over ``GET /metrics`` + ``GET /slo`` + ``GET /events``
+(docs/observability.md, fleet section): the SLO verdict and firing
+burn-rate alerts, per-objective SLI/burn gauges, per-member scraper rows
+(throughput, queue depths, scrape health), breaker states when a cluster
+is attached to the manage plane (``GET /membership``), and the tail of
+the causal event journal.
+
+Usage:
+    python -m tools.top --manage 127.0.0.1:28080             # live (curses)
+    python -m tools.top --manage 127.0.0.1:28080 --once      # one frame
+    python -m tools.top --manage 127.0.0.1:28080 --plain     # no curses
+
+Stdlib only (urllib + optional curses), like the rest of tools/.
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(base: str, path: str, timeout: float):
+    try:
+        with urllib.request.urlopen(f"http://{base}{path}", timeout=timeout) as r:
+            body = r.read()
+    except (urllib.error.URLError, OSError) as e:
+        return None, repr(e)
+    try:
+        return json.loads(body), None
+    except ValueError:
+        return body.decode(errors="replace"), None
+
+
+def _metric_families(text: str) -> dict:
+    """Flat ``name{labels} -> value`` map from Prometheus exposition text
+    (exemplar suffixes, comments and TYPE lines skipped)."""
+    out = {}
+    if not isinstance(text, str):
+        return out
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        # An exemplar suffix (" # {...} v") never appears without the flag,
+        # but strip defensively.
+        line = line.split(" # ", 1)[0]
+        parts = line.rsplit(" ", 1)
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+def snapshot(base: str, timeout: float = 2.0) -> dict:
+    """One dashboard frame's raw data."""
+    slo, slo_err = _get(base, "/slo", timeout)
+    events, _ = _get(base, "/events?limit=12", timeout)
+    metrics, _ = _get(base, "/metrics", timeout)
+    membership, _ = _get(base, "/membership", timeout)
+    return {
+        "t": time.strftime("%H:%M:%S"),
+        "base": base,
+        "error": slo_err,
+        "slo": slo if isinstance(slo, dict) else {},
+        "events": events if isinstance(events, dict) else {},
+        "metrics": _metric_families(metrics),
+        "membership": membership if isinstance(membership, dict) else {},
+    }
+
+
+def render(frame: dict, width: int = 100) -> list:
+    """Plain-text lines for one frame (shared by --plain/--once and the
+    curses loop)."""
+    lines = []
+    slo = frame["slo"]
+    verdict = slo.get("verdict", "?")
+    lines.append(
+        f"infinistore top · {frame['base']} · {frame['t']} · "
+        f"verdict={verdict.upper()}"
+    )
+    if frame["error"]:
+        lines.append(f"  manage plane unreachable: {frame['error']}")
+        return lines
+    lines.append("-" * min(width, 100))
+
+    # SLO gauges + firing alerts.
+    lines.append(
+        f"SLO  avail={slo.get('slo_availability', 1.0):.6f}  "
+        f"fg_p99={slo.get('slo_fg_p99_us', 0.0):.0f}us  "
+        f"miss={slo.get('slo_miss_rate', 0.0):.4f}  "
+        f"reshard_drain={slo.get('slo_reshard_drain', 1.0):.3f}  "
+        f"burn_max={slo.get('slo_burn_rate_max', 0.0):.2f}"
+    )
+    alerts = slo.get("alerts", [])
+    if alerts:
+        for a in alerts:
+            lines.append(
+                f"  ALERT {a['objective']}: burn {a['burn_short']:.1f}x/"
+                f"{int(a['short_window_s'])}s {a['burn_long']:.1f}x/"
+                f"{int(a['long_window_s'])}s (>= {a['threshold']}x)"
+            )
+    else:
+        lines.append("  no burn-rate alerts firing")
+
+    # Per-member scraper rows.
+    members = slo.get("scraper", {}).get("members", [])
+    if members:
+        lines.append(
+            f"{'MEMBER':<22}{'OPS/S':>8}{'QUEUE':>7}{'AGE':>7}"
+            f"{'SCRAPES':>9}{'FAILS':>7}  STATE"
+        )
+        for m in members:
+            state = "ok" if m["ok"] else f"skip({m['consecutive_failures']})"
+            age = m["last_scrape_age_s"]
+            lines.append(
+                f"{m['member']:<22}{m['ops_per_s']:>8.1f}"
+                f"{m['queue_depth']:>7}{(f'{age:.1f}s' if age >= 0 else '-'):>7}"
+                f"{m['scrapes']:>9}{m['failures']:>7}  {state}"
+            )
+    # Breaker states from the cluster's manage surface, when attached.
+    ms = frame["membership"]
+    if ms.get("enabled"):
+        pairs = ", ".join(
+            f"{m['member_id']}:{m['state']}" for m in ms.get("members", [])
+        )
+        lines.append(
+            f"membership epoch={ms.get('membership_epoch', '?')} "
+            f"settled={ms.get('membership_settled', '?')} "
+            f"debt={ms.get('reshard_debt_roots', 0)} [{pairs}]"
+        )
+
+    # Local process gauges from /metrics.
+    fam = frame["metrics"]
+    if fam:
+        kv = fam.get("infinistore_kvmap_entries")
+        usage = fam.get("infinistore_pool_usage_ratio")
+        fgq = fam.get('infinistore_qos_queued{class="fg"}')
+        bgq = fam.get('infinistore_qos_queued{class="bg"}')
+        bits = []
+        if kv is not None:
+            bits.append(f"kvmap={kv:.0f}")
+        if usage is not None:
+            bits.append(f"pool={100 * usage:.1f}%")
+        if fgq is not None or bgq is not None:
+            bits.append(f"queued fg={fgq or 0:.0f} bg={bgq or 0:.0f}")
+        if bits:
+            lines.append("local " + "  ".join(bits))
+
+    # Event journal tail.
+    events = frame["events"].get("events", [])
+    lines.append("-" * min(width, 100))
+    lines.append(f"EVENTS (last {len(events)} of {frame['events'].get('emitted', 0)})")
+    for e in events:
+        trace = f" trace={e['trace_id']:#x}" if e.get("trace_id") else ""
+        member = f" member={e['member']}" if e.get("member") else ""
+        epoch = f" epoch={e['epoch']}" if e.get("epoch") else ""
+        attrs = ""
+        if e.get("attrs"):
+            attrs = " " + " ".join(f"{k}={v}" for k, v in e["attrs"].items())
+        lines.append(
+            f"  #{e['seq']:<5} {e['kind']:<18}{member}{epoch}{trace}{attrs}"[:width]
+        )
+    return lines
+
+
+def _curses_loop(base: str, interval: float):
+    import curses
+
+    def loop(stdscr):
+        curses.curs_set(0)
+        stdscr.nodelay(True)
+        while True:
+            frame = snapshot(base)
+            stdscr.erase()
+            h, w = stdscr.getmaxyx()
+            for i, line in enumerate(render(frame, width=w - 1)[: h - 1]):
+                try:
+                    stdscr.addstr(i, 0, line[: w - 1])
+                except curses.error:
+                    pass
+            stdscr.addstr(
+                h - 1, 0, "q to quit · refresh every "
+                f"{interval:g}s"[: w - 1]
+            )
+            stdscr.refresh()
+            t0 = time.time()
+            while time.time() - t0 < interval:
+                ch = stdscr.getch()
+                if ch in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(loop)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.top",
+        description="live fleet dashboard over /metrics + /slo + /events",
+    )
+    parser.add_argument(
+        "--manage", default="127.0.0.1:28080",
+        help="manage-plane host:port (default 127.0.0.1:28080)",
+    )
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh seconds (default 2)")
+    parser.add_argument("--once", action="store_true",
+                        help="print one plain-text frame and exit")
+    parser.add_argument("--plain", action="store_true",
+                        help="plain-text loop (no curses)")
+    args = parser.parse_args(argv)
+
+    if args.once:
+        print("\n".join(render(snapshot(args.manage))))
+        return 0
+    if args.plain or not sys.stdout.isatty():
+        try:
+            while True:
+                print("\n".join(render(snapshot(args.manage))), flush=True)
+                print()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+    try:
+        _curses_loop(args.manage, args.interval)
+    except ImportError:
+        print("curses unavailable; falling back to --plain", file=sys.stderr)
+        return main([*(argv or sys.argv[1:]), "--plain"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
