@@ -99,12 +99,26 @@ lib.its_server_stats_json.argtypes = [c_void_p, c_char_p, c_int]
 lib.its_server_stats_json.restype = c_int
 
 # ---- client ----
-lib.its_conn_create.argtypes = [c_char_p, c_int, c_int, c_int, c_int, c_int]
+# Trailing two ints: enable_ring (descriptor-ring data plane,
+# docs/descriptor_ring.md) and ring_slots (0 = native default).
+lib.its_conn_create.argtypes = [
+    c_char_p, c_int, c_int, c_int, c_int, c_int, c_int, c_int,
+]
 lib.its_conn_create.restype = c_void_p
 lib.its_conn_connect.argtypes = [c_void_p]
 lib.its_conn_connect.restype = c_int
 lib.its_conn_shm_active.argtypes = [c_void_p]
 lib.its_conn_shm_active.restype = c_int
+lib.its_conn_ring_active.argtypes = [c_void_p]
+lib.its_conn_ring_active.restype = c_int
+lib.its_conn_ring_name.argtypes = [c_void_p, c_char_p, c_int]
+lib.its_conn_ring_name.restype = c_int
+# Client ring ledger: posted, doorbells, full fallbacks, meta fallbacks,
+# completions (lib.InfinityConnection.ring_stats).
+lib.its_conn_ring_counters.argtypes = [
+    c_void_p, POINTER(c_uint64), POINTER(c_uint64), POINTER(c_uint64),
+    POINTER(c_uint64), POINTER(c_uint64),
+]
 lib.its_conn_close.argtypes = [c_void_p]
 lib.its_conn_destroy.argtypes = [c_void_p]
 lib.its_conn_connected.argtypes = [c_void_p]

@@ -51,6 +51,17 @@ class ClientConfig:
     # stream on loopback (tools/striping_emulation.py). Caps PUTs; the
     # server-side knob caps GETs.
     pacing_rate_mbps: int = 0
+    # Descriptor-ring data plane (docs/descriptor_ring.md): when the shm
+    # fast path is up, batched segment ops post as fixed-slot descriptors in
+    # a shared submission ring (no per-op socket writes; the socket is
+    # demoted to a doze/wake doorbell) and complete via a shared completion
+    # ring. Auto-degrades to the byte-identical socket path when shm is
+    # unavailable or the server declines the attach.
+    enable_ring: bool = True
+    # Submission-slot count (power of two; 0 = native default, 64). The
+    # in-flight ring-op bound equals it; a full ring falls back to the
+    # socket path per-op (counted backpressure, never an error).
+    ring_slots: int = 0
     # Opt-in recovery: when the native reactor reports the connection dead,
     # blocking ops reconnect (re-registering plain MRs) and retry once. A
     # restarted server looks like a cold cache, never a dead engine. The

@@ -457,6 +457,8 @@ class InfinityConnection:
             1 if self.config.enable_shm else 0,
             self.config.op_timeout_ms,
             self.config.pacing_rate_mbps,
+            1 if self.config.enable_ring else 0,
+            self.config.ring_slots,
         )
         rc = lib.its_conn_connect(handle)
         if rc != 0:
@@ -486,6 +488,23 @@ class InfinityConnection:
     def shm_active(self) -> bool:
         """True when the same-host shm fast path is in use for batched ops."""
         return self._handle is not None and lib.its_conn_shm_active(self._handle) == 1
+
+    @property
+    def ring_active(self) -> bool:
+        """True when the descriptor-ring data plane is posting batched
+        segment ops as shared-memory descriptors (docs/descriptor_ring.md);
+        False degrades to the byte-identical socket path."""
+        return self._handle is not None and lib.its_conn_ring_active(self._handle) == 1
+
+    def ring_name(self) -> str:
+        """Shm name of this connection's descriptor-ring segment (empty when
+        the ring is inactive) — the introspection hook the torn-descriptor
+        tests use to map and tamper with the ring from outside the client."""
+        if self._handle is None:
+            return ""
+        buf = ctypes.create_string_buffer(128)
+        n = lib.its_conn_ring_name(self._handle, buf, len(buf))
+        return buf.raw[:n].decode() if n > 0 else ""
 
     async def connect_async(self):
         """connect() off the event loop thread (reference connect_async)."""
@@ -1192,6 +1211,41 @@ class InfinityConnection:
             ),
         }
 
+    def ring_stats(self) -> dict:
+        """Client half of the descriptor-ring ledger
+        (docs/descriptor_ring.md; the server half is
+        ``get_stats()["ring"]``): ``ring_posted`` descriptors published to
+        the submission ring, ``ring_doorbells`` doorbell frames actually
+        sent (empty->non-empty doze transitions only — the
+        ``ring_doorbell_ratio`` = posted / doorbells is the submit-side
+        coalescing the bench watches), ``ring_full_fallbacks`` /
+        ``ring_meta_fallbacks`` ops that rode the socket path instead
+        (ring-full backpressure / descriptor body over the slot stride —
+        counted, never an error), and ``ring_completions`` consumed from
+        the completion ring."""
+        posted = ctypes.c_uint64()
+        doorbells = ctypes.c_uint64()
+        full = ctypes.c_uint64()
+        meta = ctypes.c_uint64()
+        completions = ctypes.c_uint64()
+        with self._lock:
+            if self._handle is not None:
+                lib.its_conn_ring_counters(
+                    self._handle, ctypes.byref(posted), ctypes.byref(doorbells),
+                    ctypes.byref(full), ctypes.byref(meta),
+                    ctypes.byref(completions),
+                )
+        return {
+            "ring_posted": posted.value,
+            "ring_doorbells": doorbells.value,
+            "ring_full_fallbacks": full.value,
+            "ring_meta_fallbacks": meta.value,
+            "ring_completions": completions.value,
+            "ring_doorbell_ratio": (
+                posted.value / doorbells.value if doorbells.value else 0.0
+            ),
+        }
+
     def qos_stats(self) -> dict:
         """Client-side per-class batched-op counters (the QoS ledger's
         client half; the server's scheduler counters are
@@ -1228,6 +1282,16 @@ class InfinityConnection:
           ``bg_queued``, plus the ``bg_cooldown_us``/``bg_aging_us``
           tunables — the two-class slice scheduler (docs/qos.md);
         - ``suspended_ops`` — sliced ops parked in the reactor;
+        - ``ring``: the descriptor-ring data plane
+          (docs/descriptor_ring.md) — ``attached`` lifetime successful
+          attaches, ``conns`` live attached connections, ``descriptors``
+          consumed from submission rings, ``doorbells_rx`` /
+          ``cq_doorbells_tx`` doorbell frames each direction (vs
+          ``descriptors``: the doze/wake coalescing ratio),
+          ``completions`` CQEs published, ``bad_descriptors`` rejected
+          per-descriptor (400 CQE), ``torn_descriptors`` generation-tag
+          mismatches (fatal), and the live ``sq_depth`` /``pending``
+          queue depths;
         - ``trace``: the server-side trace tick ring
           (docs/observability.md) — ``recorded``/``dropped`` ring
           counters and ``entries``, each ``{trace_id, parent_id, op,
@@ -1428,6 +1492,33 @@ class StripedConnection:
     @property
     def shm_active(self) -> bool:
         return self.conns[0].shm_active
+
+    @property
+    def ring_active(self) -> bool:
+        """True when stripe 0 posts batched ops over the descriptor ring
+        (same-host collapse routes batched ops there anyway)."""
+        return self.conns[0].ring_active
+
+    def ring_stats(self) -> dict:
+        """Aggregate descriptor-ring ledger across stripes (see
+        InfinityConnection.ring_stats)."""
+        out = {
+            "ring_posted": 0,
+            "ring_doorbells": 0,
+            "ring_full_fallbacks": 0,
+            "ring_meta_fallbacks": 0,
+            "ring_completions": 0,
+        }
+        for c in self.conns:
+            st = c.ring_stats()
+            for k in out:
+                out[k] += st[k]
+        out["ring_doorbell_ratio"] = (
+            out["ring_posted"] / out["ring_doorbells"]
+            if out["ring_doorbells"]
+            else 0.0
+        )
+        return out
 
     # -- memory registration (fan out: a batch may land on any stripe) -------
 

@@ -161,6 +161,34 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
             "# TYPE infinistore_qos_bg_aging_us gauge",
             f"infinistore_qos_bg_aging_us {qos['bg_aging_us']}",
         ]
+    # Descriptor-ring data plane (docs/descriptor_ring.md): attach/consume/
+    # complete lifetime counters, the doorbell-vs-descriptor coalescing
+    # ratio (one doorbell per doze, not per op), live ring depths, and the
+    # two rejection classes (bad = per-descriptor 400 CQE, torn =
+    # generation-tag mismatch, fatal for the connection).
+    ring = stats.get("ring")
+    if ring is not None:
+        lines += [
+            "# TYPE infinistore_ring_conns gauge",
+            f"infinistore_ring_conns {ring['conns']}",
+            "# TYPE infinistore_ring_attached counter",
+            f"infinistore_ring_attached {ring['attached']}",
+            "# TYPE infinistore_ring_descriptors counter",
+            f"infinistore_ring_descriptors {ring['descriptors']}",
+            "# TYPE infinistore_ring_doorbells counter",
+            f'infinistore_ring_doorbells{{dir="rx"}} {ring["doorbells_rx"]}',
+            f'infinistore_ring_doorbells{{dir="tx"}} {ring["cq_doorbells_tx"]}',
+            "# TYPE infinistore_ring_completions counter",
+            f"infinistore_ring_completions {ring['completions']}",
+            "# TYPE infinistore_ring_bad_descriptors counter",
+            f"infinistore_ring_bad_descriptors {ring['bad_descriptors']}",
+            "# TYPE infinistore_ring_torn_descriptors counter",
+            f"infinistore_ring_torn_descriptors {ring['torn_descriptors']}",
+            "# TYPE infinistore_ring_sq_depth gauge",
+            f"infinistore_ring_sq_depth {ring['sq_depth']}",
+            "# TYPE infinistore_ring_pending gauge",
+            f"infinistore_ring_pending {ring['pending']}",
+        ]
     # Tracing surfaces (docs/observability.md): the client flight
     # recorder's counters (span volume + the slow-op watchdog) and the
     # server-side trace tick ring's coverage counters. The spans/ticks

@@ -34,8 +34,16 @@ OP_RELEASE = ord("r")
 OP_REG_SEGMENT = ord("B")
 OP_PUT_FROM = ord("F")
 OP_GET_INTO = ord("I")
+# Descriptor-ring data plane (docs/descriptor_ring.md): batched segment ops
+# post as fixed-slot descriptors in a client-created shm ring; the socket
+# carries only the attach handshake and doze/wake doorbells.
+OP_RING_ATTACH = ord("Q")
+OP_RING_DOORBELL = ord("q")
 
 # Status codes (reference src/protocol.h:55-62).
+# STATUS_RING_EVENT is the unsolicited server->client completion-ring
+# doorbell frame — 1xx so it can never collide with a real response status.
+STATUS_RING_EVENT = 100
 STATUS_OK = 200
 STATUS_TASK_ACCEPTED = 202
 STATUS_INVALID_REQ = 400
@@ -48,6 +56,94 @@ STATUS_OOM = STATUS_OUT_OF_MEMORY
 
 _REQ_HEADER = struct.Struct("<IBI")  # magic, op, body_size (9 bytes)
 _RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 bytes)
+
+# ---------------------------------------------------------------------------
+# Descriptor-ring slot layout (docs/descriptor_ring.md). These structs are
+# MEMORY-MAPPED by both processes, so field NAMES and widths are protocol
+# surface exactly like the packed wire headers: the formats below are held
+# in lockstep with native RingCtrl/RingSlot/RingCqe by the wire-drift
+# checker (ITS-W004 widths, ITS-W005 named-field order via RING_LAYOUTS).
+# ---------------------------------------------------------------------------
+
+RING_MAGIC = 0x52535449  # "ITSR" little-endian
+RING_VERSION = 1
+RING_SQ_SLOTS = 64  # default submission-slot count (ClientConfig.ring_slots)
+RING_META_STRIDE = 128 << 10  # per-SQ-slot descriptor-body capacity
+RING_CTRL_SPAN = 4096  # RingCtrl's reserved span at the segment head
+
+_RING_CTRL = struct.Struct("<IIIIIIIIQQQQII")  # 72 bytes
+_RING_SLOT = struct.Struct("<QQIBBH")  # 24 bytes
+_RING_CQE = struct.Struct("<QQQII")  # 32 bytes
+
+# Named-field twins of the native ring structs. Same-width field swaps are
+# invisible to a width-sequence diff (ITS-W004) but fatal for shared memory
+# — the checker's ITS-W005 compares these (name, width) sequences against
+# the packed C++ declarations field by field.
+RING_LAYOUTS = {
+    "RingCtrl": (
+        ("magic", "u32"),
+        ("version", "u32"),
+        ("sq_slots", "u32"),
+        ("cq_slots", "u32"),
+        ("slot_bytes", "u32"),
+        ("cqe_bytes", "u32"),
+        ("meta_stride", "u32"),
+        ("flags", "u32"),
+        ("sq_tail", "u64"),
+        ("sq_head", "u64"),
+        ("cq_tail", "u64"),
+        ("cq_head", "u64"),
+        ("srv_waiting", "u32"),
+        ("cli_waiting", "u32"),
+    ),
+    "RingSlot": (
+        ("gen", "u64"),
+        ("token", "u64"),
+        ("meta_len", "u32"),
+        ("op", "u8"),
+        ("flags", "u8"),
+        ("reserved", "u16"),
+    ),
+    "RingCqe": (
+        ("gen", "u64"),
+        ("token", "u64"),
+        ("bytes", "u64"),
+        ("status", "u32"),
+        ("flags", "u32"),
+    ),
+}
+
+
+def _ring_align64(v: int) -> int:
+    return (v + 63) & ~63
+
+
+def ring_sq_off() -> int:
+    """Submission-slot array offset inside a ring segment (native ring.h)."""
+    return RING_CTRL_SPAN
+
+
+def ring_cq_off(sq_slots: int) -> int:
+    return ring_sq_off() + _ring_align64(sq_slots * _RING_SLOT.size)
+
+
+def ring_meta_off(sq_slots: int, cq_slots: int) -> int:
+    return ring_cq_off(sq_slots) + _ring_align64(cq_slots * _RING_CQE.size)
+
+
+def ring_segment_bytes(sq_slots: int, cq_slots: int, meta_stride: int) -> int:
+    return ring_meta_off(sq_slots, cq_slots) + sq_slots * meta_stride
+
+
+def ring_ctrl_offset(fld: str) -> int:
+    """Byte offset of a RingCtrl field — the tamper/inspection hook the ring
+    tests use to poke cursors in a mapped segment from Python."""
+    off = 0
+    for name, prim in RING_LAYOUTS["RingCtrl"]:
+        if name == fld:
+            return off
+        off += {"u8": 1, "u16": 2, "u32": 4, "u64": 8}[prim]
+    raise KeyError(fld)
 
 # Two-class QoS service model (docs/qos.md). FOREGROUND is the default and
 # encodes as NO wire bytes (the priority-off path stays byte-identical);
@@ -265,6 +361,26 @@ class SegMeta:
     def decode(cls, data: bytes) -> "SegMeta":
         r = Reader(data)
         return cls(seg_id=r.u16(), name=r.str(), size=r.u64())
+
+
+@dataclass
+class RingMeta:
+    """Descriptor-ring segment registration (native RingMeta: RingAttach).
+
+    Only names the shm segment — the ring geometry lives in the mapped
+    RingCtrl itself, single-sourced so the attach body can never drift
+    from the control block."""
+
+    name: str = ""
+    size: int = 0
+
+    def encode(self) -> bytes:
+        return encode_str(self.name) + struct.pack("<Q", self.size)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RingMeta":
+        r = Reader(data)
+        return cls(name=r.str(), size=r.u64())
 
 
 @dataclass

@@ -51,6 +51,17 @@ struct ClientConfig {
     // fairness on a shared DCN link. Test use: emulating a bandwidth-capped
     // cross-host stream on loopback to exercise connection striping.
     uint32_t pacing_rate_mbps = 0;
+    // Descriptor-ring data plane (docs/descriptor_ring.md): when the shm
+    // fast path is up, create a shared submission/completion ring and post
+    // batched segment ops as descriptors instead of per-op socket writes.
+    // Degrades automatically (socket path, byte-identical) when shm is
+    // unavailable or the server declines the attach.
+    bool enable_ring = true;
+    // Submission-slot count (power of two; 0 = kRingSqSlots default). The
+    // completion ring is sized equal and in-flight ring ops are bounded by
+    // it; a full ring falls back to the socket path for that op (counted —
+    // ring-full backpressure, never an error).
+    uint32_t ring_slots = 0;
 };
 
 using CompletionCb = void (*)(void* ctx, int code);
@@ -142,6 +153,19 @@ class Connection {
     // True when the same-host shm fast path is active for batched ops.
     bool shm_active() const { return shm_ok_.load(); }
 
+    // True when the descriptor-ring data plane is active (shm fast path up,
+    // ring segment attached by the server).
+    bool ring_active() const { return ring_ok_.load(); }
+    // Shm name of this connection's ring segment (empty when inactive).
+    // Introspection surface for tests/tools — the torn-descriptor tests map
+    // the segment by name and tamper with it.
+    std::string ring_name() const;
+    // Client-side ring ledger: descriptors posted, submission doorbells
+    // sent (empty->non-empty / doze transitions only), ring-full and
+    // meta-too-big socket fallbacks, completions consumed from the CQ.
+    void ring_counters(uint64_t* posted, uint64_t* doorbells, uint64_t* full_fallbacks,
+                       uint64_t* meta_fallbacks, uint64_t* completions) const;
+
     // Event-fd completion ring (the low-fixed-cost asyncio bridge). When a
     // completion fd is set, async batched ops submitted with cb == nullptr
     // and ctx != nullptr complete by pushing (ctx-as-token, code) into a
@@ -171,6 +195,9 @@ class Connection {
 
     void reactor();
     int submit(std::unique_ptr<Request> req);
+    // Route a built batched request: descriptor ring when eligible (segment
+    // op, ring active, fits a slot, ring not full), else the socket pipeline.
+    int submit_any(std::unique_ptr<Request> req);
     void fail_all(int code);
     bool flush_send();
     bool read_ready();
@@ -197,6 +224,18 @@ class Connection {
                                        uint8_t priority, uint64_t trace_id,
                                        uint64_t trace_span);
     void shm_handshake();
+    // Create + attach the descriptor ring (after a successful shm
+    // handshake). Failure is silent degradation to the socket path.
+    void ring_setup();
+    void ring_teardown();
+    // Try to post ``req`` as a ring descriptor. Returns 0 when posted (the
+    // request is parked in ring_inflight_ until its CQE arrives) or -1 when
+    // the caller must fall back to the socket path (ring full / in-flight
+    // cap / descriptor body exceeds meta_stride — counted).
+    int try_ring_post(std::unique_ptr<Request>* req);
+    // Reactor-side: drain the completion ring, completing parked requests.
+    // Returns false on a corrupt ring (fails the connection).
+    bool drain_cq();
     char* map_pool(uint16_t pool_id, const std::string& name, uint64_t size);
     // Reactor-side: handle a PutAlloc/GetLoc response. Returns the request
     // back if it must be re-queued (put commit phase), nullptr when done.
@@ -270,6 +309,28 @@ class Connection {
     std::atomic<bool> shm_ok_{false};
     mutable std::mutex shm_mu_;
     std::unordered_map<uint16_t, ShmMap> shm_pools_;
+
+    // Descriptor-ring state (docs/descriptor_ring.md; "dring" because the
+    // PR 2 completion ring above already owns the plain ring_/ring_mu_
+    // names). The view and name are written once at connect (ring_setup)
+    // and torn down in close(); submit-side cursors + the in-flight map are
+    // guarded by dring_mu_ (producers are arbitrary caller threads; the
+    // reactor erases on completion). CQ consumption is reactor-only.
+    struct RingState;
+    std::unique_ptr<RingState> dring_;
+    std::atomic<bool> ring_ok_{false};
+    mutable std::mutex dring_mu_;
+    std::unordered_map<uint64_t, std::unique_ptr<Request>> ring_inflight_;
+    uint64_t ring_next_token_ = 1;  // guarded by dring_mu_
+    uint64_t ring_sq_seq_ = 0;      // descriptors posted (guarded by dring_mu_)
+    uint64_t ring_cq_seq_ = 0;      // completions consumed (reactor-only)
+    // Ledger (ring_counters): posted descriptors, doorbells actually sent,
+    // ring-full and oversized-meta socket fallbacks, CQ completions.
+    std::atomic<uint64_t> ring_posted_{0};
+    std::atomic<uint64_t> ring_doorbells_{0};
+    std::atomic<uint64_t> ring_full_fallbacks_{0};
+    std::atomic<uint64_t> ring_meta_fallbacks_{0};
+    std::atomic<uint64_t> ring_completions_{0};
 };
 
 }  // namespace its

@@ -55,6 +55,14 @@ enum Op : uint8_t {
     kOpRegSegment = 'B',     // register a client shm segment {id, name, size}
     kOpPutFrom = 'F',        // pull blocks from client segment offsets; commit
     kOpGetInto = 'I',        // push stored blocks into client segment offsets
+    // Descriptor-ring data plane (docs/descriptor_ring.md): batched segment
+    // ops post as fixed-slot descriptors in a client-created shm ring
+    // instead of per-op socket writes. The socket is demoted to a doze/wake
+    // doorbell in both directions — written only when the other side has
+    // parked itself (the empty->non-empty discipline the PR 2 completion
+    // ring established for the native->Python eventfd).
+    kOpRingAttach = 'Q',     // register a descriptor-ring shm segment {name, size}
+    kOpRingDoorbell = 'q',   // submission-ring doorbell; empty body, NO response
 };
 
 // Two-class QoS service model (docs/qos.md): FOREGROUND (decode-blocking
@@ -79,6 +87,12 @@ constexpr uint64_t kTraceIdNone = 0;
 
 // HTTP-like status codes (reference /root/reference/src/protocol.h:55-62).
 enum Status : uint32_t {
+    // Unsolicited server->client frame: "your completion ring has entries"
+    // (the CQ doorbell). Carries no body/payload and is NOT matched to an
+    // in-flight request — the client drains its completion ring and keeps
+    // reading. 1xx (informational) so it can never collide with a real
+    // response status.
+    kStatusRingEvent = 100,
     kStatusOk = 200,
     kStatusTaskAccepted = 202,
     kStatusInvalidReq = 400,
@@ -88,6 +102,34 @@ enum Status : uint32_t {
     kStatusUnavailable = 503,
     kStatusOutOfMemory = 507,
 };
+
+// ---------------------------------------------------------------------------
+// Descriptor ring (docs/descriptor_ring.md). The client creates one shm
+// segment per connection laid out as [RingCtrl | SQ slots | CQ entries |
+// per-SQ-slot meta arena] and registers it with kOpRingAttach; from then on
+// batched segment ops (kOpPutFrom / kOpGetInto) post as RingSlot descriptors
+// whose meta region holds the op's ordinary SegBatchMeta encoding — the
+// EXACT body bytes the socket path would have carried, so decode, QoS
+// tagging and the trace-context extensions are shared with the wire format.
+// Completion rides back as a RingCqe. These structs are memory-mapped by
+// BOTH processes, so field NAMES and widths are protocol surface exactly
+// like the packed wire headers; the wire-drift checker (ITS-W004/W005)
+// holds them in lockstep with their wire.py twins.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kRingMagic = 0x52535449;  // "ITSR" little-endian
+constexpr uint32_t kRingVersion = 1;
+// Default submission-slot count (power of two; ClientConfig::ring_slots
+// overrides). The completion ring is sized equal and the client bounds its
+// in-flight ring ops to it, so the CQ can never overflow.
+constexpr uint32_t kRingSqSlots = 64;
+// Per-slot descriptor-body capacity: bounds one posted op's SegBatchMeta
+// encoding (~1700 64-char keys + offsets). Bigger bodies fall back to the
+// socket path (counted, never an error).
+constexpr uint32_t kRingMetaStride = 128u << 10;
+// RingCtrl's reserved span at the segment head (page-sized so the slot
+// arrays start page-aligned).
+constexpr uint32_t kRingCtrlSpan = 4096;
 
 #pragma pack(push, 1)
 struct ReqHeader {
@@ -100,10 +142,55 @@ struct RespHeader {
     uint32_t body_size;    // op-specific response body (sizes, counts, ...)
     uint64_t payload_size; // raw KV payload streamed after the body
 };
+// Ring control block (segment offset 0). The four cursors are monotonic
+// 64-bit sequence numbers (never wrapped; slot index = seq % slots). Fields
+// are naturally aligned by construction so cross-process atomic access
+// (__atomic builtins) is valid despite the packed layout.
+struct RingCtrl {
+    uint32_t magic;        // kRingMagic
+    uint32_t version;      // kRingVersion
+    uint32_t sq_slots;     // submission slots (power of two)
+    uint32_t cq_slots;     // completion slots (== sq_slots today)
+    uint32_t slot_bytes;   // sizeof(RingSlot) echo — cross-build guard
+    uint32_t cqe_bytes;    // sizeof(RingCqe) echo
+    uint32_t meta_stride;  // per-SQ-slot descriptor-body capacity
+    uint32_t flags;        // reserved (0)
+    uint64_t sq_tail;      // client publish cursor (release store)
+    uint64_t sq_head;      // server consume cursor — slot reusable below it
+    uint64_t cq_tail;      // server publish cursor
+    uint64_t cq_head;      // client consume cursor — entry reusable below it
+    uint32_t srv_waiting;  // server parked in epoll; poster must doorbell
+    uint32_t cli_waiting;  // client reactor parked; completer must doorbell
+};
+// One posted descriptor. The slot's meta region (meta_stride bytes at
+// ring_meta_off + index * meta_stride) holds meta_len bytes of the op's
+// SegBatchMeta encoding; ``gen`` is the publish tag, written LAST with
+// release order as sequence+1 — a slot whose gen does not match the
+// consumer's expected sequence is torn/corrupt and rejected.
+struct RingSlot {
+    uint64_t gen;       // publish tag: submission sequence + 1
+    uint64_t token;     // completion-matching token (client-chosen)
+    uint32_t meta_len;  // SegBatchMeta body bytes in the slot's meta region
+    uint8_t op;         // kOpPutFrom or kOpGetInto
+    uint8_t flags;      // reserved (0)
+    uint16_t reserved;  // reserved (0)
+};
+// One completion. Same publish discipline as RingSlot (gen = sequence + 1,
+// release-stored last).
+struct RingCqe {
+    uint64_t gen;       // publish tag: completion sequence + 1
+    uint64_t token;     // echoes RingSlot::token
+    uint64_t bytes;     // payload bytes moved (either direction)
+    uint32_t status;    // HTTP-like op status
+    uint32_t flags;     // reserved (0)
+};
 #pragma pack(pop)
 
 static_assert(sizeof(ReqHeader) == 9, "wire header must stay packed");
 static_assert(sizeof(RespHeader) == 16, "wire resp header must stay packed");
+static_assert(sizeof(RingCtrl) == 72, "ring control block layout is shared state");
+static_assert(sizeof(RingSlot) == 24, "ring slot layout is shared state");
+static_assert(sizeof(RingCqe) == 32, "ring cqe layout is shared state");
 
 // ---------------------------------------------------------------------------
 // Encoding helpers. Little-endian, length-prefixed. Python mirror: wire.py.
@@ -347,6 +434,28 @@ struct SegMeta {
         WireReader r(data, size);
         SegMeta m;
         m.seg_id = r.u16();
+        m.name = r.str();
+        m.size = r.u64();
+        return m;
+    }
+};
+
+// Descriptor-ring segment registration (RingAttach): the client names the
+// shm segment holding its RingCtrl + slot arrays; geometry rides in the
+// mapped RingCtrl itself (single source — the attach body never duplicates
+// it, so the two can't drift).
+struct RingMeta {
+    std::string name;
+    uint64_t size = 0;
+
+    void encode(std::vector<uint8_t>& out) const {
+        WireWriter w(out);
+        w.str(name);
+        w.u64(size);
+    }
+    static RingMeta decode(const uint8_t* data, size_t size) {
+        WireReader r(data, size);
+        RingMeta m;
         m.name = r.str();
         m.size = r.u64();
         return m;

@@ -1,0 +1,135 @@
+// Descriptor-ring shared-memory view (docs/descriptor_ring.md).
+//
+// The ring segment is created by the client, mapped by both processes, and
+// laid out deterministically from the geometry in its RingCtrl:
+//
+//   [0, kRingCtrlSpan)                    RingCtrl (page-sized span)
+//   [sq_off, sq_off + sq_slots * 24)      RingSlot submission array
+//   [cq_off, cq_off + cq_slots * 32)      RingCqe completion array
+//   [meta_off, meta_off + sq_slots * meta_stride)   per-SQ-slot meta arena
+//
+// Cursors are monotonic u64 sequence numbers; slot index = seq % slots.
+// Single producer / single consumer per ring direction:
+//   SQ: client threads produce (serialized by the connection's ring mutex),
+//       the server reactor consumes.
+//   CQ: the server reactor produces, the client reactor consumes.
+// Publish discipline both directions: write the record, release-store its
+// gen = seq + 1, release-store the tail = seq + 1. The consumer
+// acquire-loads the tail, then checks gen == seq + 1 — a mismatch under an
+// advanced tail means a torn or corrupt descriptor (generation-tag
+// validation) and poisons the ring. Record memory is reusable only once the
+// consumer has release-stored its head past the sequence.
+//
+// Doze/wake doorbells: each consumer parks by seq_cst-storing its *_waiting
+// flag, then re-checking the tail before blocking in epoll (Dekker pairing
+// with the producer's publish + seq_cst flag read). A producer that
+// observes the flag set CASes it down and sends exactly one doorbell over
+// the socket — kOpRingDoorbell client->server, a kStatusRingEvent response
+// frame server->client. While the consumer is awake, posting is pure shared
+// memory: zero syscalls per op.
+//
+// All cross-process field access goes through the __atomic helpers below
+// (std::atomic_ref is C++20; these are the C++17 equivalent and TSAN
+// understands them).
+#pragma once
+
+#include <cstdint>
+
+#include "its/protocol.h"
+
+namespace its {
+
+inline uint64_t ring_align64(uint64_t v) { return (v + 63) & ~uint64_t{63}; }
+
+inline uint64_t ring_sq_off() { return kRingCtrlSpan; }
+inline uint64_t ring_cq_off(uint32_t sq_slots) {
+    return ring_sq_off() + ring_align64(uint64_t{sq_slots} * sizeof(RingSlot));
+}
+inline uint64_t ring_meta_off(uint32_t sq_slots, uint32_t cq_slots) {
+    return ring_cq_off(sq_slots) + ring_align64(uint64_t{cq_slots} * sizeof(RingCqe));
+}
+inline uint64_t ring_segment_bytes(uint32_t sq_slots, uint32_t cq_slots,
+                                   uint32_t meta_stride) {
+    return ring_meta_off(sq_slots, cq_slots) + uint64_t{sq_slots} * meta_stride;
+}
+
+// -- cross-process atomics (all fields naturally aligned; see RingCtrl) -----
+
+inline uint64_t ring_load_acq(const uint64_t* p) {
+    return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void ring_store_rel(uint64_t* p, uint64_t v) {
+    __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+// Full barrier between a publish (tail store / flag park) and the paired
+// re-read on the other variable — the classic lost-wakeup Dekker fence.
+inline void ring_fence() { __atomic_thread_fence(__ATOMIC_SEQ_CST); }
+
+inline void ring_flag_park(uint32_t* flag) {
+    __atomic_store_n(flag, 1u, __ATOMIC_SEQ_CST);
+}
+inline void ring_flag_clear(uint32_t* flag) {
+    __atomic_store_n(flag, 0u, __ATOMIC_SEQ_CST);
+}
+// True when the producer should send a doorbell: the consumer was parked
+// and this caller won the unpark (exactly one doorbell per doze).
+inline bool ring_flag_take(uint32_t* flag) {
+    uint32_t expect = 1u;
+    return __atomic_load_n(flag, __ATOMIC_SEQ_CST) == 1u &&
+           __atomic_compare_exchange_n(flag, &expect, 0u, false, __ATOMIC_SEQ_CST,
+                                       __ATOMIC_SEQ_CST);
+}
+
+// Mapped view over a ring segment. The geometry is SNAPSHOTTED out of the
+// control block at ring_view_init (after validation) and never re-read:
+// the ctrl fields live in memory the peer can scribble on, and index
+// arithmetic against a live `sq_slots` would hand a hostile writer a
+// div-by-zero / out-of-bounds primitive. The shared ctrl is dereferenced
+// only for the cursors and doze flags, whose values are never trusted
+// beyond bounded comparisons.
+struct RingView {
+    char* base = nullptr;
+    uint64_t size = 0;
+    RingCtrl* ctrl = nullptr;
+    RingSlot* sq = nullptr;
+    RingCqe* cq = nullptr;
+    char* meta = nullptr;
+    uint32_t sq_slots = 0;     // snapshot (validated power of two)
+    uint32_t cq_slots = 0;     // snapshot
+    uint32_t meta_stride = 0;  // snapshot
+
+    RingSlot* slot(uint64_t seq) { return &sq[seq % sq_slots]; }
+    RingCqe* cqe(uint64_t seq) { return &cq[seq % cq_slots]; }
+    char* meta_at(uint64_t seq) {
+        return meta + (seq % sq_slots) * uint64_t{meta_stride};
+    }
+};
+
+// Build a view over mapped memory, validating the control block against
+// this build's struct sizes and the mapped span. Returns false (view
+// untouched) on any mismatch — the caller must fall back to the socket
+// path rather than trust a layout it does not share.
+inline bool ring_view_init(RingView* v, char* base, uint64_t size) {
+    if (base == nullptr || size < kRingCtrlSpan) return false;
+    RingCtrl* ctrl = reinterpret_cast<RingCtrl*>(base);
+    if (ctrl->magic != kRingMagic || ctrl->version != kRingVersion) return false;
+    if (ctrl->slot_bytes != sizeof(RingSlot) || ctrl->cqe_bytes != sizeof(RingCqe))
+        return false;
+    uint32_t sq = ctrl->sq_slots, cq = ctrl->cq_slots, stride = ctrl->meta_stride;
+    if (sq == 0 || (sq & (sq - 1)) != 0 || cq == 0 || (cq & (cq - 1)) != 0)
+        return false;
+    if (stride == 0 || stride > kMaxBodySize) return false;
+    if (ring_segment_bytes(sq, cq, stride) > size) return false;
+    v->base = base;
+    v->size = size;
+    v->ctrl = ctrl;
+    v->sq = reinterpret_cast<RingSlot*>(base + ring_sq_off());
+    v->cq = reinterpret_cast<RingCqe*>(base + ring_cq_off(sq));
+    v->meta = base + ring_meta_off(sq, cq);
+    v->sq_slots = sq;
+    v->cq_slots = cq;
+    v->meta_stride = stride;
+    return true;
+}
+
+}  // namespace its

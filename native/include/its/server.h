@@ -164,6 +164,19 @@ class Server {
     void handle_tcp_put(Conn* c);
     void handle_shm(Conn* c);
     void handle_simple(Conn* c);
+    // Descriptor-ring copy engine (docs/descriptor_ring.md): pop published
+    // descriptors out of every attached submission ring into per-conn
+    // pending queues (freeing the slots — backpressure relief), start them
+    // through the same budget-sliced SegCont machinery the socket segment
+    // ops use (QoS classes, aging, trace ticks all preserved), and finish
+    // by publishing a completion-ring entry instead of a socket response.
+    void handle_ring_attach(Conn* c);
+    void drain_rings();
+    bool drain_ring_conn(Conn* c);  // false = ring poisoned, close the conn
+    void start_ring_descs(Conn* c);
+    void start_ring_desc(Conn* c, uint8_t op, uint64_t token, SegBatchMeta m);
+    void ring_push_cqe(Conn* c, uint64_t token, uint32_t status, uint64_t bytes);
+    void ring_finish(Conn* c, uint32_t status, uint64_t bytes);
     bool alloc_blocks(size_t size, size_t n, std::vector<Lease>* leases);
     // Budget-sliced segment ops (see ServerConfig::slice_bytes).
     void queue_cont(Conn* c);
@@ -269,6 +282,23 @@ class Server {
     std::vector<std::unique_ptr<Conn>> graveyard_;
     std::unordered_map<uint8_t, OpStats> stats_;
     uint64_t conns_accepted_ = 0;
+
+    // Descriptor-ring plane: connections with an attached ring (drained
+    // every loop pass) and the server half of the ring ledger
+    // (stats_json()["ring"] → /metrics infinistore_ring_*).
+    std::vector<Conn*> ring_conns_;
+    struct RingCounters {
+        uint64_t attached = 0;         // lifetime successful attaches
+        uint64_t descriptors = 0;      // descriptors consumed from SQs
+        uint64_t doorbells_rx = 0;     // client->server doorbell frames
+        uint64_t cq_doorbells_tx = 0;  // server->client doorbell frames
+        uint64_t completions = 0;      // CQEs published
+        uint64_t bad_descriptors = 0;  // rejected per-descriptor (CQE 400)
+        uint64_t torn_descriptors = 0; // generation-tag mismatches (fatal)
+    } ring_counters_;
+    // Mirror of run_cont_pass's idle streak for the ring copy engine's
+    // adaptive slice budget (see run_cont_slice).
+    int idle_streak_ = 0;
 
     // Trace tick ring (docs/observability.md): server_recv/first_slice/
     // last_slice/done stamps for ops that carried a wire trace context.

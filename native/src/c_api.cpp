@@ -113,8 +113,13 @@ int its_server_stats_json(void* s, char* buf, int buf_len) {
 }
 
 // ---- client ----
+// ``enable_ring``/``ring_slots``: descriptor-ring data plane
+// (docs/descriptor_ring.md) — batched segment ops post as shared-memory
+// descriptors instead of per-op socket writes when the shm fast path is up.
+// ring_slots 0 = default (its::kRingSqSlots).
 void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm,
-                      int op_timeout_ms, int pacing_rate_mbps) {
+                      int op_timeout_ms, int pacing_rate_mbps, int enable_ring,
+                      int ring_slots) {
     ClientConfig cfg;
     cfg.host = host;
     cfg.port = port;
@@ -122,10 +127,28 @@ void* its_conn_create(const char* host, int port, int timeout_ms, int enable_shm
     cfg.op_timeout_ms = op_timeout_ms;
     cfg.enable_shm = enable_shm != 0;
     cfg.pacing_rate_mbps = pacing_rate_mbps > 0 ? static_cast<uint32_t>(pacing_rate_mbps) : 0;
+    cfg.enable_ring = enable_ring != 0;
+    cfg.ring_slots = ring_slots > 0 ? static_cast<uint32_t>(ring_slots) : 0;
     return new Connection(cfg);
 }
 int its_conn_connect(void* c) { return static_cast<Connection*>(c)->connect(); }
 int its_conn_shm_active(void* c) { return static_cast<Connection*>(c)->shm_active() ? 1 : 0; }
+int its_conn_ring_active(void* c) { return static_cast<Connection*>(c)->ring_active() ? 1 : 0; }
+// Shm name of the connection's descriptor-ring segment (empty when
+// inactive): the introspection hook the torn-descriptor tests use to map
+// and tamper with the ring from outside the client.
+int its_conn_ring_name(void* c, char* buf, int buf_len) {
+    return copy_out(static_cast<Connection*>(c)->ring_name(), buf, buf_len);
+}
+// Client half of the ring ledger (lib.InfinityConnection.ring_stats):
+// descriptors posted, submission doorbells sent (doze transitions only),
+// ring-full + oversized-meta socket fallbacks, completions consumed.
+void its_conn_ring_counters(void* c, uint64_t* posted, uint64_t* doorbells,
+                            uint64_t* full_fallbacks, uint64_t* meta_fallbacks,
+                            uint64_t* completions) {
+    static_cast<Connection*>(c)->ring_counters(posted, doorbells, full_fallbacks,
+                                               meta_fallbacks, completions);
+}
 void its_conn_close(void* c) { static_cast<Connection*>(c)->close(); }
 void its_conn_destroy(void* c) { delete static_cast<Connection*>(c); }
 int its_conn_connected(void* c) { return static_cast<Connection*>(c)->connected() ? 1 : 0; }

@@ -24,8 +24,16 @@
 #include "its/net_util.h"
 #include "its/log.h"
 #include "its/mempool.h"  // shm_registry_* (crash-time segment cleanup)
+#include "its/ring.h"
 
 namespace its {
+
+// Descriptor-ring segment (docs/descriptor_ring.md): mapped view + the shm
+// name needed to unlink it at close.
+struct Connection::RingState {
+    RingView view;
+    std::string name;
+};
 
 // Shared landing zone for sync ops. The waiter and the Request each hold a
 // reference, so a caller that times out can abandon the wait and a late
@@ -173,8 +181,9 @@ int Connection::connect() {
     connected_.store(true);
     thread_ = std::thread([this] { reactor(); });
     if (config_.enable_shm) shm_handshake();
-    ITS_LOG_DEBUG("connected to %s:%d (shm=%d)", config_.host.c_str(), config_.port,
-                  static_cast<int>(shm_ok_.load()));
+    if (shm_ok_.load() && config_.enable_ring) ring_setup();
+    ITS_LOG_DEBUG("connected to %s:%d (shm=%d ring=%d)", config_.host.c_str(), config_.port,
+                  static_cast<int>(shm_ok_.load()), static_cast<int>(ring_ok_.load()));
     return 0;
 }
 
@@ -220,6 +229,201 @@ char* Connection::map_pool(uint16_t pool_id, const std::string& name, uint64_t s
     return it->second.base;
 }
 
+// Create the descriptor-ring segment and ask the server to attach it.
+// Failure at any step is silent degradation: the socket path stays
+// byte-identical and every batched op keeps working.
+void Connection::ring_setup() {
+    uint32_t slots = config_.ring_slots != 0 ? config_.ring_slots : kRingSqSlots;
+    if (slots < 2 || (slots & (slots - 1)) != 0) {
+        ITS_LOG_WARN("ring_slots=%u invalid (need power of two >= 2); using %u",
+                     config_.ring_slots, kRingSqSlots);
+        slots = kRingSqSlots;
+    }
+    uint64_t bytes = ring_segment_bytes(slots, slots, kRingMetaStride);
+    char name[96];
+    std::random_device rd;
+    snprintf(name, sizeof(name), "/its.%d.%08x.ring", static_cast<int>(getpid()), rd());
+    int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return;
+    // Liveness marker for shm_sweep_stale (see alloc_shm_mr): the flock'd fd
+    // is intentionally leaked for the connection lifetime.
+    flock(fd, LOCK_EX | LOCK_NB);
+    if (ftruncate(fd, static_cast<off_t>(bytes)) != 0 ||
+        posix_fallocate(fd, 0, static_cast<off_t>(bytes)) != 0) {
+        ::close(fd);
+        shm_unlink(name);
+        return;
+    }
+    void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    if (mem == MAP_FAILED) {
+        ::close(fd);
+        shm_unlink(name);
+        return;
+    }
+    shm_registry_add(name);
+    // The segment is zero-filled; publish the geometry (plain writes — the
+    // server cannot see it until the attach below).
+    RingCtrl* ctrl = static_cast<RingCtrl*>(mem);
+    ctrl->magic = kRingMagic;
+    ctrl->version = kRingVersion;
+    ctrl->sq_slots = slots;
+    ctrl->cq_slots = slots;
+    ctrl->slot_bytes = sizeof(RingSlot);
+    ctrl->cqe_bytes = sizeof(RingCqe);
+    ctrl->meta_stride = kRingMetaStride;
+    auto state = std::make_unique<RingState>();
+    if (!ring_view_init(&state->view, static_cast<char*>(mem), bytes)) {
+        munmap(mem, bytes);
+        shm_registry_remove(name);
+        shm_unlink(name);
+        return;
+    }
+    state->name = name;
+    auto req = std::make_unique<Request>();
+    req->op = kOpRingAttach;
+    RingMeta{name, bytes}.encode(req->body);
+    uint32_t status =
+        sync_roundtrip(std::move(req), nullptr, nullptr, nullptr, config_.connect_timeout_ms);
+    if (status != kStatusOk) {
+        munmap(mem, bytes);
+        shm_registry_remove(name);
+        shm_unlink(name);
+        ITS_LOG_DEBUG("server declined descriptor ring (%u); socket path only", status);
+        return;
+    }
+    dring_ = std::move(state);
+    ring_ok_.store(true);
+    ITS_LOG_DEBUG("descriptor ring %s attached (%u slots)", name, slots);
+}
+
+void Connection::ring_teardown() {
+    ring_ok_.store(false);
+    std::lock_guard<std::mutex> lock(dring_mu_);  // vs a late try_ring_post
+    if (dring_ == nullptr) return;
+    munmap(dring_->view.base, dring_->view.size);
+    shm_registry_remove(dring_->name.c_str());
+    shm_unlink(dring_->name.c_str());
+    dring_.reset();
+    ring_sq_seq_ = 0;
+    ring_cq_seq_ = 0;
+}
+
+std::string Connection::ring_name() const {
+    std::lock_guard<std::mutex> lock(dring_mu_);
+    return dring_ != nullptr ? dring_->name : std::string();
+}
+
+void Connection::ring_counters(uint64_t* posted, uint64_t* doorbells,
+                               uint64_t* full_fallbacks, uint64_t* meta_fallbacks,
+                               uint64_t* completions) const {
+    if (posted != nullptr) *posted = ring_posted_.load(std::memory_order_relaxed);
+    if (doorbells != nullptr) *doorbells = ring_doorbells_.load(std::memory_order_relaxed);
+    if (full_fallbacks != nullptr)
+        *full_fallbacks = ring_full_fallbacks_.load(std::memory_order_relaxed);
+    if (meta_fallbacks != nullptr)
+        *meta_fallbacks = ring_meta_fallbacks_.load(std::memory_order_relaxed);
+    if (completions != nullptr)
+        *completions = ring_completions_.load(std::memory_order_relaxed);
+}
+
+// Post a built segment op as a ring descriptor: its body (the SegBatchMeta
+// encoding the socket path would have sent) is copied into the slot's meta
+// region and published with a generation tag — no socket write, no syscall,
+// unless the server has parked itself (then exactly one doorbell frame).
+int Connection::try_ring_post(std::unique_ptr<Request>* reqp) {
+    Request* req = reqp->get();
+    bool doorbell = false;
+    {
+        std::lock_guard<std::mutex> lock(dring_mu_);
+        // Re-check under the lock: a concurrent close() tears the ring down
+        // after failing the connection.
+        if (dring_ == nullptr || !connected_.load()) return -1;
+        RingView& v = dring_->view;
+        if (req->body.size() > v.meta_stride) {
+            ring_meta_fallbacks_.fetch_add(1, std::memory_order_relaxed);
+            return -1;
+        }
+        uint64_t head = ring_load_acq(&v.ctrl->sq_head);
+        if (ring_sq_seq_ - head >= v.sq_slots ||
+            ring_inflight_.size() >= v.cq_slots) {
+            // Ring-full backpressure: the op rides the socket path instead
+            // of blocking the caller (the async submitter may be an event
+            // loop). Counted — the bench watches this.
+            ring_full_fallbacks_.fetch_add(1, std::memory_order_relaxed);
+            return -1;
+        }
+        uint64_t seq = ring_sq_seq_;
+        uint64_t token = ring_next_token_++;
+        memcpy(v.meta_at(seq), req->body.data(), req->body.size());
+        RingSlot* s = v.slot(seq);
+        s->token = token;
+        s->meta_len = static_cast<uint32_t>(req->body.size());
+        s->op = req->op;
+        s->flags = 0;
+        s->reserved = 0;
+        ring_store_rel(&s->gen, seq + 1);
+        ring_inflight_.emplace(token, std::move(*reqp));
+        ring_sq_seq_ = seq + 1;
+        ring_store_rel(&v.ctrl->sq_tail, seq + 1);
+        ring_posted_.fetch_add(1, std::memory_order_relaxed);
+        ring_fence();
+        doorbell = ring_flag_take(&v.ctrl->srv_waiting);
+    }
+    if (doorbell) {
+        // The server parked in epoll: wake it with one 9-byte frame. While
+        // it is awake (the common case under load), posts are socket-free.
+        ring_doorbells_.fetch_add(1, std::memory_order_relaxed);
+        auto db = std::make_unique<Request>();
+        db->op = kOpRingDoorbell;
+        db->no_response = true;
+        submit(std::move(db));
+    }
+    return 0;
+}
+
+// Reactor-side completion-ring drain. Returns false only on a corrupt ring
+// (generation-tag mismatch / unknown token), which fails the connection.
+bool Connection::drain_cq() {
+    if (!ring_ok_.load(std::memory_order_acquire)) return true;
+    RingView& v = dring_->view;
+    while (ring_load_acq(&v.ctrl->cq_tail) != ring_cq_seq_) {
+        RingCqe* e = v.cqe(ring_cq_seq_);
+        if (ring_load_acq(&e->gen) != ring_cq_seq_ + 1) {
+            ITS_LOG_ERROR("ring: torn completion at seq %llu",
+                          static_cast<unsigned long long>(ring_cq_seq_));
+            return false;
+        }
+        uint64_t token = e->token;
+        uint32_t status = e->status;
+        std::unique_ptr<Request> req;
+        {
+            std::lock_guard<std::mutex> lock(dring_mu_);
+            auto it = ring_inflight_.find(token);
+            if (it != ring_inflight_.end()) {
+                req = std::move(it->second);
+                ring_inflight_.erase(it);
+            }
+        }
+        ring_cq_seq_++;
+        ring_store_rel(&v.ctrl->cq_head, ring_cq_seq_);
+        if (req == nullptr) {
+            ITS_LOG_ERROR("ring: completion for unknown token");
+            return false;
+        }
+        ring_completions_.fetch_add(1, std::memory_order_relaxed);
+        complete(std::move(req), static_cast<int>(status), /*take_body=*/false);
+    }
+    return true;
+}
+
+int Connection::submit_any(std::unique_ptr<Request> req) {
+    if (ring_ok_.load(std::memory_order_acquire) &&
+        (req->op == kOpPutFrom || req->op == kOpGetInto)) {
+        if (try_ring_post(&req) == 0) return 0;
+    }
+    return submit(std::move(req));
+}
+
 void Connection::close() {
     if (fd_ < 0) return;
     stop_.store(true);
@@ -233,6 +437,7 @@ void Connection::close() {
     fd_ = wake_fd_ = epoll_fd_ = -1;
     connected_.store(false);
     shm_ok_.store(false);
+    ring_teardown();  // in-flight ring ops were failed by the reactor's fail_all
     {
         std::lock_guard<std::mutex> lock(shm_mu_);
         for (auto& [id, m] : shm_pools_) munmap(m.base, m.size);
@@ -447,7 +652,7 @@ int Connection::put_batch_async(const std::vector<std::string>& keys,
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
-    return submit(std::move(req));
+    return submit_any(std::move(req));
 }
 
 int Connection::put_batch(const std::vector<std::string>& keys,
@@ -508,7 +713,7 @@ int Connection::get_batch_async(const std::vector<std::string>& keys,
     if (req == nullptr) return -1;
     req->cb = cb;
     req->ctx = ctx;
-    return submit(std::move(req));
+    return submit_any(std::move(req));
 }
 
 int Connection::get_batch(const std::vector<std::string>& keys,
@@ -529,7 +734,7 @@ uint32_t Connection::sync_roundtrip(std::unique_ptr<Request> req,
     state->seg_op = req->op == kOpPutFrom || req->op == kOpGetInto;
     req->sync = state;
     auto fut = state->prom.get_future();
-    if (submit(std::move(req)) != 0) return kStatusUnavailable;
+    if (submit_any(std::move(req)) != 0) return kStatusUnavailable;
     bool forever = false;
     if (timeout_ms < 0) {
         // Default deadline from config; config <= 0 opts into wait-forever.
@@ -730,6 +935,16 @@ void Connection::fail_all(int code) {
         for (auto& req : submitted_) sendq_.push_back(std::move(req));
         submitted_.clear();
     }
+    // Ring-posted ops: connected_ is false now, so no new descriptor can be
+    // parked after this drain (try_ring_post checks under ring_mu_).
+    std::vector<std::unique_ptr<Request>> ring_ops;
+    {
+        std::lock_guard<std::mutex> lock(dring_mu_);
+        ring_ops.reserve(ring_inflight_.size());
+        for (auto& [token, req] : ring_inflight_) ring_ops.push_back(std::move(req));
+        ring_inflight_.clear();
+    }
+    for (auto& req : ring_ops) complete(std::move(req), code, /*take_body=*/false);
     while (!awaiting_.empty()) {
         auto req = std::move(awaiting_.front());
         awaiting_.pop_front();
@@ -820,6 +1035,17 @@ bool Connection::read_ready() {
             if (r < 0) return errno == EAGAIN || errno == EWOULDBLOCK;
             rhdr_got_ += static_cast<size_t>(r);
             if (rhdr_got_ < sizeof(RespHeader)) continue;
+            if (rhdr_.status == kStatusRingEvent) {
+                // Unsolicited completion-ring doorbell: not matched to an
+                // in-flight request — drain the CQ and keep reading.
+                if (rhdr_.body_size != 0 || rhdr_.payload_size != 0) {
+                    ITS_LOG_ERROR("protocol error: ring doorbell with body");
+                    return false;
+                }
+                rhdr_got_ = 0;
+                if (!drain_cq()) return false;
+                continue;
+            }
             if (awaiting_.empty() || rhdr_.body_size > kMaxBodySize) {
                 ITS_LOG_ERROR("protocol error: unexpected response");
                 return false;
@@ -1067,7 +1293,29 @@ void Connection::reactor() {
     bool ok = true;
     while (ok && !stop_.load(std::memory_order_relaxed)) {
         if (poison_.load()) break;  // abandoned segment op: fail everything
-        int n = epoll_wait(epoll_fd_, events, kMaxEvents, 200);
+        int timeout = 200;
+        if (ring_ok_.load(std::memory_order_acquire)) {
+            // Park-then-recheck (Dekker pairing with the server's CQE
+            // publish + flag read): either we see the new tail here, or the
+            // server sees cli_waiting and sends a doorbell frame.
+            if (!drain_cq()) break;
+            ring_flag_park(&dring_->view.ctrl->cli_waiting);
+            ring_fence();
+            if (ring_load_acq(&dring_->view.ctrl->cq_tail) != ring_cq_seq_) {
+                ring_flag_clear(&dring_->view.ctrl->cli_waiting);
+                if (!drain_cq()) break;
+                // The recheck hit, so the flag is DOWN: a CQE published
+                // while we slept would send no doorbell. Poll instead of
+                // blocking — the next loop iteration re-parks properly
+                // (the server's loop() applies the same discipline).
+                timeout = 0;
+            }
+        }
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
+        if (ring_ok_.load(std::memory_order_acquire)) {
+            ring_flag_clear(&dring_->view.ctrl->cli_waiting);
+            if (!drain_cq()) break;
+        }
         if (n < 0) {
             if (errno == EINTR) continue;
             break;

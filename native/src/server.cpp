@@ -20,6 +20,7 @@
 #include "its/iovec_util.h"
 #include "its/net_util.h"
 #include "its/log.h"
+#include "its/ring.h"
 
 namespace its {
 
@@ -167,9 +168,31 @@ struct Server::Conn {
         size_t idx = 0;     // blocks allocated (PutFrom) / pinned (GetInto)
         size_t copied = 0;  // blocks memcpy'd
         std::vector<BlockRef> blocks;
+        // Descriptor-ring source (docs/descriptor_ring.md): completion goes
+        // to the ring (ring_finish) instead of a socket response.
+        bool from_ring = false;
+        uint64_t ring_token = 0;
     };
     std::unique_ptr<SegCont> cont;
     bool queued_cont = false;
+
+    // Attached descriptor ring (kOpRingAttach). SQ consumption and CQ
+    // publication are reactor-thread-only; the client process is the other
+    // side of the shared cursors (ring.h discipline). Decoded descriptors
+    // wait in the per-class pending queues until the conn's single cont
+    // slot frees up — foreground first.
+    struct RingSrv {
+        RingView view;
+        uint64_t sq_seq = 0;  // descriptors consumed
+        uint64_t cq_seq = 0;  // completions published
+        struct PendingDesc {
+            uint8_t op = 0;
+            uint64_t token = 0;
+            SegBatchMeta m;
+        };
+        std::deque<PendingDesc> pending_fg, pending_bg;
+    };
+    std::unique_ptr<RingSrv> ring;
 
     // Shm fast-path tickets. A put ticket holds allocated-but-unpublished
     // blocks between PutAlloc and PutCommit; a get ticket pins committed
@@ -197,6 +220,8 @@ struct Server::Conn {
     ~Conn() {
         for (auto& [id, seg] : segments)
             if (seg.base != nullptr) munmap(seg.base, seg.size);
+        if (ring != nullptr && ring->view.base != nullptr)
+            munmap(ring->view.base, ring->view.size);
     }
 
     void reset_read() {
@@ -375,6 +400,35 @@ std::string Server::stats_json() {
               ",\"bg_cooldown_us\":" + std::to_string(config_.bg_cooldown_us) +
               ",\"bg_aging_us\":" + std::to_string(config_.bg_aging_us) + "}" +
               ",\"suspended_ops\":" + std::to_string(cont_fg_.size() + cont_bg_.size()) +
+              // Descriptor-ring plane (docs/descriptor_ring.md): lifetime
+              // attach/descriptor/doorbell/completion counters plus the
+              // LIVE submission-ring depth (published-but-unconsumed) and
+              // decoded-but-not-started pending depth across attached
+              // conns. doorbells_rx vs descriptors is the submit-side
+              // coalescing ratio the bench watches (one doorbell per doze,
+              // not per op).
+              ",\"ring\":{\"attached\":" + std::to_string(ring_counters_.attached) +
+              ",\"conns\":" + std::to_string(ring_conns_.size()) +
+              ",\"descriptors\":" + std::to_string(ring_counters_.descriptors) +
+              ",\"doorbells_rx\":" + std::to_string(ring_counters_.doorbells_rx) +
+              ",\"cq_doorbells_tx\":" + std::to_string(ring_counters_.cq_doorbells_tx) +
+              ",\"completions\":" + std::to_string(ring_counters_.completions) +
+              ",\"bad_descriptors\":" + std::to_string(ring_counters_.bad_descriptors) +
+              ",\"torn_descriptors\":" + std::to_string(ring_counters_.torn_descriptors) +
+              ",\"sq_depth\":" + [this] {
+                  uint64_t depth = 0;
+                  for (Conn* rc : ring_conns_)
+                      depth += ring_load_acq(&rc->ring->view.ctrl->sq_tail) -
+                               rc->ring->sq_seq;
+                  return std::to_string(depth);
+              }() +
+              ",\"pending\":" + [this] {
+                  size_t pending = 0;
+                  for (Conn* rc : ring_conns_)
+                      pending += rc->ring->pending_fg.size() +
+                                 rc->ring->pending_bg.size();
+                  return std::to_string(pending);
+              }() + "}" +
               // Server-side trace tick ring (docs/observability.md): the
               // manage plane's /trace endpoint joins these to client spans
               // by trace id; recorded/dropped size the ring's coverage.
@@ -453,7 +507,28 @@ void Server::loop() {
             timeout =
                 now_us() - last_fg_us_ < config_.bg_cooldown_us ? 1 : 0;
         }
+        if (timeout != 0 && !ring_conns_.empty()) {
+            // About to block: park on every attached submission ring, then
+            // re-check the tails — the Dekker pairing with the client's
+            // descriptor publish + flag read guarantees either we see the
+            // new tail here or the client sends a doorbell frame.
+            for (Conn* rc : ring_conns_)
+                ring_flag_park(&rc->ring->view.ctrl->srv_waiting);
+            ring_fence();
+            for (Conn* rc : ring_conns_) {
+                if (ring_load_acq(&rc->ring->view.ctrl->sq_tail) !=
+                    rc->ring->sq_seq) {
+                    timeout = 0;
+                    break;
+                }
+            }
+            if (timeout == 0)
+                for (Conn* rc : ring_conns_)
+                    ring_flag_clear(&rc->ring->view.ctrl->srv_waiting);
+        }
         int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
+        for (Conn* rc : ring_conns_)
+            ring_flag_clear(&rc->ring->view.ctrl->srv_waiting);
         if (n < 0) {
             if (errno == EINTR) continue;
             ITS_LOG_ERROR("epoll_wait: %s", strerror(errno));
@@ -486,6 +561,7 @@ void Server::loop() {
                 if (!c->dead && (events[i].events & EPOLLIN)) conn_readable(c);
             }
         }
+        drain_rings();
         run_cont_pass(n, &idle_streak);
         graveyard_.clear();
     }
@@ -537,6 +613,9 @@ void Server::close_conn(Conn* c) {
         cont_bg_.erase(std::remove(cont_bg_.begin(), cont_bg_.end(), c),
                        cont_bg_.end());
     }
+    if (c->ring != nullptr)
+        ring_conns_.erase(std::remove(ring_conns_.begin(), ring_conns_.end(), c),
+                          ring_conns_.end());
     epoll_ctl(epoll_fd_, EPOLL_CTL_DEL, c->fd, nullptr);
     close(c->fd);
     auto it = conns_.find(c->fd);
@@ -617,6 +696,194 @@ bool Server::bg_must_defer() const {
     return !cont_fg_.empty() || now_us() - last_fg_us_ < config_.bg_cooldown_us;
 }
 
+// ---------------------------------------------------------------------------
+// Descriptor-ring copy engine (docs/descriptor_ring.md). Submission rings
+// are drained every loop pass: descriptors validate and queue per-conn by
+// QoS class, then ride the SAME budget-sliced SegCont machinery as socket
+// segment ops — fg-first scheduling, bg cooldown/aging, trace ticks, and
+// the op-latency histograms all behave identically; only the completion
+// leaves over the ring.
+// ---------------------------------------------------------------------------
+
+void Server::drain_rings() {
+    for (size_t i = 0; i < ring_conns_.size();) {
+        Conn* c = ring_conns_[i];
+        if (!drain_ring_conn(c)) {
+            // Torn/corrupt descriptor: the ring is untrustworthy — close
+            // the connection (the client fails over / reconnects).
+            close_conn(c);
+        } else {
+            start_ring_descs(c);
+        }
+        // Either call can close_conn (CQE overflow inside the drain, error
+        // CQE on a bad descriptor), which erases c from ring_conns_ — then
+        // the element at i is already the NEXT conn and i must not advance.
+        if (i < ring_conns_.size() && ring_conns_[i] == c) i++;
+    }
+}
+
+bool Server::drain_ring_conn(Conn* c) {
+    Conn::RingSrv& r = *c->ring;
+    uint64_t tail = ring_load_acq(&r.view.ctrl->sq_tail);
+    while (r.sq_seq < tail) {
+        // Decoded-but-not-started descriptors are bounded by the ring
+        // depth: a CONFORMING client caps in-flight ops at cq_slots, so
+        // hitting this means a hostile/buggy peer is refilling freed slots
+        // without waiting for completions. Stop consuming (sq_head stays
+        // put — the natural backpressure) instead of growing an unbounded
+        // heap queue; draining resumes as pending ops start.
+        if (r.pending_fg.size() + r.pending_bg.size() >= r.view.cq_slots)
+            break;
+        RingSlot* s = r.view.slot(r.sq_seq);
+        if (ring_load_acq(&s->gen) != r.sq_seq + 1) {
+            // The publish discipline stores gen before tail, so a mismatch
+            // under an advanced tail is a torn or corrupt descriptor.
+            ring_counters_.torn_descriptors++;
+            ITS_LOG_WARN("ring: torn descriptor at seq %llu fd=%d, closing",
+                         static_cast<unsigned long long>(r.sq_seq), c->fd);
+            return false;
+        }
+        uint8_t op = s->op;
+        uint64_t token = s->token;
+        uint32_t meta_len = s->meta_len;
+        SegBatchMeta m;
+        bool ok = (op == kOpPutFrom || op == kOpGetInto) &&
+                  meta_len <= r.view.meta_stride;
+        if (ok) {
+            try {
+                m = SegBatchMeta::decode(
+                    reinterpret_cast<const uint8_t*>(r.view.meta_at(r.sq_seq)),
+                    meta_len);
+            } catch (const std::exception&) {
+                ok = false;
+            }
+        }
+        // Slot consumed: advance the head so the client can reuse it (the
+        // decoded copy above is ours now) — this is the backpressure relief
+        // that keeps a deep pipeline posting while ops are still running.
+        r.sq_seq++;
+        ring_store_rel(&r.view.ctrl->sq_head, r.sq_seq);
+        ring_counters_.descriptors++;
+        if (!ok) {
+            ring_counters_.bad_descriptors++;
+            ring_push_cqe(c, token, kStatusInvalidReq, 0);
+            if (c->dead) return true;  // cqe overflow closed it
+            continue;
+        }
+        auto& q = m.priority == kPriorityBackground ? r.pending_bg : r.pending_fg;
+        q.push_back(Conn::RingSrv::PendingDesc{op, token, std::move(m)});
+    }
+    return true;
+}
+
+// Feed pending descriptors into the conn's single continuation slot —
+// foreground before background (a bg descriptor never heads-of-line a
+// later fg one), FIFO within a class. Invalid descriptors complete with an
+// error CQE right here and the loop moves on.
+void Server::start_ring_descs(Conn* c) {
+    while (!c->dead && c->cont == nullptr && c->rstate == Conn::RState::kHeader &&
+           c->hdr_got == 0) {
+        Conn::RingSrv& r = *c->ring;
+        auto& q = !r.pending_fg.empty() ? r.pending_fg : r.pending_bg;
+        if (q.empty()) return;
+        Conn::RingSrv::PendingDesc d = std::move(q.front());
+        q.pop_front();
+        start_ring_desc(c, d.op, d.token, std::move(d.m));
+    }
+}
+
+void Server::start_ring_desc(Conn* c, uint8_t op, uint64_t token, SegBatchMeta m) {
+    c->cur_op = op;
+    c->op_start_us = now_us();
+    trace_begin(c, m.trace_id, m.trace_parent, m.priority);
+    size_t n = m.keys.size();
+    auto seg_it = c->segments.find(m.seg_id);
+    uint32_t status = kStatusOk;
+    // Same validation the socket dispatch runs (handle_shm PutFrom/GetInto).
+    if (n == 0 || m.block_size == 0 || n != m.offsets.size() ||
+        seg_it == c->segments.end()) {
+        status = kStatusInvalidReq;
+    } else {
+        const Conn::SegMap& seg = seg_it->second;
+        for (uint64_t off : m.offsets) {
+            if (off > seg.size || m.block_size > seg.size - off) {
+                status = kStatusInvalidReq;
+                break;
+            }
+        }
+        if (status == kStatusOk && op == kOpGetInto) {
+            for (const auto& key : m.keys) {
+                if (!kv_->exists(key)) {
+                    status = kStatusKeyNotFound;
+                    break;
+                }
+            }
+        }
+    }
+    if (status != kStatusOk) {
+        stats_[op].record(now_us() - c->op_start_us, 0, 0, false);
+        trace_finish(c, 0, false);
+        ring_push_cqe(c, token, status, 0);
+        return;
+    }
+    note_op(m.priority);
+    auto cont = std::make_unique<Conn::SegCont>();
+    cont->op = op;
+    cont->prio = m.priority;
+    cont->m = std::move(m);
+    if (op == kOpGetInto) cont->phase = Conn::SegCont::Phase::kPin;
+    cont->blocks.reserve(n);
+    cont->from_ring = true;
+    cont->ring_token = token;
+    c->cont = std::move(cont);
+    suspend_for_cont(c);  // slices run in this pass's run_cont_pass
+}
+
+void Server::ring_push_cqe(Conn* c, uint64_t token, uint32_t status, uint64_t bytes) {
+    Conn::RingSrv& r = *c->ring;
+    if (r.cq_seq - ring_load_acq(&r.view.ctrl->cq_head) >= r.view.cq_slots) {
+        // The client bounds in-flight ring ops to cq_slots, so this can
+        // only happen with a broken/hostile client: fail the connection
+        // rather than overwrite an unconsumed completion.
+        ITS_LOG_WARN("ring: completion ring overflow fd=%d, closing", c->fd);
+        close_conn(c);
+        return;
+    }
+    RingCqe* e = r.view.cqe(r.cq_seq);
+    e->token = token;
+    e->bytes = bytes;
+    e->status = status;
+    e->flags = 0;
+    ring_store_rel(&e->gen, r.cq_seq + 1);
+    r.cq_seq++;
+    ring_store_rel(&r.view.ctrl->cq_tail, r.cq_seq);
+    ring_counters_.completions++;
+    ring_fence();
+    if (ring_flag_take(&r.view.ctrl->cli_waiting)) {
+        // The client reactor parked: one 16-byte doorbell frame wakes it;
+        // completions landing while it is awake piggyback silently.
+        ring_counters_.cq_doorbells_tx++;
+        send_resp(c, kStatusRingEvent, {}, {}, {});
+    }
+}
+
+// Completion of a ring-sourced continuation: stats + trace tick close like
+// the socket path, then a CQE instead of a response frame — and the next
+// pending descriptor starts immediately (same tick, no doorbell needed).
+void Server::ring_finish(Conn* c, uint32_t status, uint64_t bytes) {
+    uint64_t token = c->cont->ring_token;
+    uint8_t op = c->cont->op;
+    bool ok = status == kStatusOk;
+    stats_[op].record(now_us() - c->op_start_us, op == kOpPutFrom ? bytes : 0,
+                      op == kOpGetInto ? bytes : 0, ok);
+    trace_finish(c, bytes, ok);
+    c->cont.reset();
+    arm_read(c, true);
+    c->reset_read();
+    ring_push_cqe(c, token, status, bytes);
+    if (!c->dead) start_ring_descs(c);
+}
+
 // One scheduling pass over the suspended sliced ops, run after each tick's
 // epoll events (fairness: events first, then slices).
 //
@@ -643,10 +910,20 @@ void Server::run_cont_pass(int events_seen, int* idle_streak) {
     size_t total = cont_fg_.size() + cont_bg_.size();
     if (total == 0) {
         *idle_streak = 0;
+        idle_streak_ = 0;
         return;
     }
     *idle_streak = events_seen == 0 ? std::min(*idle_streak + 1, 8) : 0;
-    int rounds = 1 + (total == 1 ? *idle_streak : 0);
+    idle_streak_ = *idle_streak;  // run_cont_slice's ring budget reads this
+    // A solo RING cont spends the idle boost on slice SIZE (one big slice,
+    // see run_cont_slice) instead of slice COUNT — same per-tick work and
+    // preemption bound, far less per-slice overhead.
+    Conn* solo = total == 1
+                     ? (cont_fg_.empty() ? cont_bg_.front() : cont_fg_.front())
+                     : nullptr;
+    bool ring_solo =
+        solo != nullptr && solo->cont != nullptr && solo->cont->from_ring;
+    int rounds = 1 + (total == 1 && !ring_solo ? *idle_streak : 0);
     for (int r = 0; r < rounds && !(cont_fg_.empty() && cont_bg_.empty()); r++) {
         if (r > 0 && !cont_bg_.empty()) {
             epoll_event peek;
@@ -752,6 +1029,10 @@ void Server::run_putalloc_slice(Conn* c) {
 void Server::finish_cont(Conn* c, uint32_t status) {
     // Error exit: uncommitted blocks free via BlockRef; nothing touched the
     // client segment yet on any failing path (alloc/pin precede copies).
+    if (c->cont->from_ring) {
+        ring_finish(c, status, 0);
+        return;
+    }
     stats_[c->cont->op].record(now_us() - c->op_start_us, 0, 0, false);
     c->cont.reset();
     arm_read(c, true);
@@ -868,7 +1149,18 @@ void Server::run_cont_slice(Conn* c) {
     const Conn::SegMap& seg = seg_it->second;
     const size_t n = ct.m.keys.size();
     const size_t bs = ct.m.block_size;
-    const size_t budget_blocks = std::max<size_t>(1, config_.slice_bytes / bs);
+    // Adaptive slice budget for ring-sourced ops (docs/descriptor_ring.md):
+    // when this is the ONLY pending sliced op and the loop has seen
+    // event-free polls (idle_streak_), grow the quantum up to 8x — per-slice
+    // fixed cost (queue churn, clock reads, loop overhead) was the dominant
+    // non-copy term inside first_slice->last_slice. Any epoll event resets
+    // the streak, so a contending request waits at most one boosted slice —
+    // the same bound the pre-existing multi-round idle boost imposed. Socket
+    // conts keep the exact legacy budget (off-path behavior unchanged).
+    size_t eff_slice_bytes = config_.slice_bytes;
+    if (ct.from_ring && cont_fg_.empty() && cont_bg_.empty() && idle_streak_ > 0)
+        eff_slice_bytes *= 1 + static_cast<size_t>(std::min(idle_streak_, 7));
+    const size_t budget_blocks = std::max<size_t>(1, eff_slice_bytes / bs);
 
     trace_slice(c);  // one tick per PutFrom/GetInto budget slice
     if (ct.op == kOpPutFrom) {
@@ -900,6 +1192,10 @@ void Server::run_cont_slice(Conn* c) {
         }
         ct.copied += chunk;
         if (ct.copied == n) {
+            if (ct.from_ring) {
+                ring_finish(c, kStatusOk, static_cast<uint64_t>(n) * bs);
+                return;
+            }
             stats_[kOpPutFrom].record(now_us() - c->op_start_us,
                                       static_cast<uint64_t>(n) * bs, 0, true);
             trace_finish(c, static_cast<uint64_t>(n) * bs, true);
@@ -929,6 +1225,12 @@ void Server::run_cont_slice(Conn* c) {
     }
     ct.copied += chunk;
     if (ct.copied == n) {
+        if (ct.from_ring) {
+            uint64_t total = 0;
+            for (const auto& b : ct.blocks) total += b->size();
+            ring_finish(c, kStatusOk, total);
+            return;
+        }
         std::vector<uint8_t> body;
         WireWriter w(body);
         w.u32(static_cast<uint32_t>(n));
@@ -1094,6 +1396,16 @@ void Server::dispatch(Conn* c) {
             case kOpPutFrom:
             case kOpGetInto:
                 handle_shm(c);
+                break;
+            case kOpRingAttach:
+                handle_ring_attach(c);
+                break;
+            case kOpRingDoorbell:
+                // Submission-ring doorbell: no body, no response. The wake
+                // itself is the payload — drain_rings() runs right after
+                // this pass's events.
+                ring_counters_.doorbells_rx++;
+                c->reset_read();
                 break;
             case kOpTcpGet:
             case kOpCheckExist:
@@ -1453,6 +1765,43 @@ void Server::handle_shm(Conn* c) {
             c->reset_read();
             send_status(c, kStatusInvalidReq);
     }
+}
+
+// Map + validate a client-created descriptor ring. Geometry comes from the
+// mapped RingCtrl itself (ring_view_init checks magic/version/struct-size
+// echoes/bounds); the attach body only names the segment. Same trust rules
+// as RegSegment: our own "/its." namespace, tmpfs really backing the
+// declared size.
+void Server::handle_ring_attach(Conn* c) {
+    RingMeta m = RingMeta::decode(c->body.data(), c->body.size());
+    uint32_t status = kStatusInvalidReq;
+    if (mm_->shm_enabled() && c->ring == nullptr && m.size >= kRingCtrlSpan &&
+        m.name.rfind("/its.", 0) == 0) {
+        int fd = shm_open(m.name.c_str(), O_RDWR, 0);
+        if (fd >= 0) {
+            struct stat st;
+            if (fstat(fd, &st) == 0 && st.st_size >= static_cast<off_t>(m.size)) {
+                void* mem =
+                    mmap(nullptr, m.size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+                if (mem != MAP_FAILED) {
+                    auto ring = std::make_unique<Conn::RingSrv>();
+                    if (ring_view_init(&ring->view, static_cast<char*>(mem), m.size)) {
+                        ring->sq_seq = ring_load_acq(&ring->view.ctrl->sq_tail);
+                        ring->cq_seq = ring_load_acq(&ring->view.ctrl->cq_tail);
+                        c->ring = std::move(ring);
+                        ring_conns_.push_back(c);
+                        ring_counters_.attached++;
+                        status = kStatusOk;
+                    } else {
+                        munmap(mem, m.size);
+                    }
+                }
+            }
+            ::close(fd);
+        }
+    }
+    c->reset_read();
+    send_status(c, status);
 }
 
 void Server::finish_payload(Conn* c) {

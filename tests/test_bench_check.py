@@ -75,6 +75,75 @@ def test_fails_on_pathological_async_bridge(tmp_path):
     assert bench_check.main([str(p)]) == 0
 
 
+def _ring_receipt(**over):
+    """A healthy descriptor-ring receipt slice; override keys to break it."""
+    doc = {
+        "ring_ceiling_fraction": 0.82,
+        "ring_vs_socket_speedup": 1.01,
+        "ring_posted": 84,
+        "ring_completions": 84,
+        "ring_full_fallbacks": 0,
+        "ring_meta_fallbacks": 0,
+        "ring_doorbell_ratio": 10.5,
+        "trace_frac_first_slice_to_last_slice": 0.764,
+    }
+    doc.update(over)
+    return doc
+
+
+def test_ring_gates_pass_on_healthy_receipt(tmp_path):
+    p = tmp_path / "ring_ok.json"
+    p.write_text(json.dumps(_ring_receipt()))
+    assert bench_check.main([str(p)]) == 0
+
+
+def test_ring_ceiling_fraction_gate(tmp_path):
+    """The ROADMAP-2 target: the ring-backed batched leg must reach 0.75
+    of the paired memcpy ceiling — 0.54 is the pre-ring r05 state."""
+    p = tmp_path / "ring_slow.json"
+    p.write_text(json.dumps(_ring_receipt(ring_ceiling_fraction=0.54)))
+    assert bench_check.main([str(p)]) == 1
+
+
+def test_ring_never_loses_to_socket(tmp_path):
+    p = tmp_path / "ring_loses.json"
+    p.write_text(json.dumps(_ring_receipt(ring_vs_socket_speedup=0.80)))
+    assert bench_check.main([str(p)]) == 1
+
+
+def test_ring_mechanism_gate(tmp_path):
+    """Silent fallbacks would A/B the socket against itself; a 1.0
+    doorbell ratio means every post paid the syscall the ring removes; a
+    completion deficit means ring ops vanished."""
+    for over in (
+        {"ring_full_fallbacks": 3},
+        {"ring_meta_fallbacks": 1},
+        {"ring_doorbell_ratio": 1.0},
+        {"ring_completions": 80},
+        {"ring_posted": 0, "ring_completions": 0},
+    ):
+        p = tmp_path / "ring_mech.json"
+        p.write_text(json.dumps(_ring_receipt(**over)))
+        assert bench_check.main([str(p)]) == 1, over
+
+
+def test_ring_stage_shift_gate(tmp_path):
+    """first_slice->last_slice must stay visibly below the PR 7 receipt's
+    ~0.80 — and the check binds only on ring-era receipts (a PR 7 receipt
+    without ring keys skips instead of failing retroactively)."""
+    p = tmp_path / "ring_frac.json"
+    p.write_text(json.dumps(
+        _ring_receipt(trace_frac_first_slice_to_last_slice=0.81)
+    ))
+    assert bench_check.main([str(p)]) == 1
+    # Pre-ring receipt: same fraction, no ring keys -> not applicable.
+    p.write_text(json.dumps({
+        "trace_frac_first_slice_to_last_slice": 0.81,
+        "striped_1_gbps": 5.0, "striped_4_gbps": 5.1,
+    }))
+    assert bench_check.main([str(p)]) == 0
+
+
 def test_parses_truncated_driver_tail(tmp_path):
     """Driver receipts wrap the bench line and clip its head; metrics must
     still be recovered by key-value scan from the tail string."""

@@ -78,13 +78,26 @@ class TestWireDrift:
         cpp = wire_drift.parse_header(ctx)
         py = wire_drift.parse_wire(ctx)
         ops = [k for k in cpp.constants if k.startswith("OP_")]
-        assert len(ops) == 16
-        assert len([k for k in cpp.constants if k.startswith("STATUS_")]) == 8
+        assert len(ops) == 18
+        assert len([k for k in cpp.constants if k.startswith("STATUS_")]) == 9
         assert cpp.constants["PRIORITY_BACKGROUND"] == 1
-        assert cpp.header_asserts == {"ReqHeader": 9, "RespHeader": 16}
+        assert cpp.header_asserts == {
+            "ReqHeader": 9, "RespHeader": 16,
+            "RingCtrl": 72, "RingSlot": 24, "RingCqe": 32,
+        }
         for name in ("BatchMeta", "SegBatchMeta", "ShmLocResp", "SegMeta",
-                     "TcpPutMeta", "TicketMeta", "KeyMeta", "KeyListMeta"):
+                     "RingMeta", "TcpPutMeta", "TicketMeta", "KeyMeta",
+                     "KeyListMeta"):
             assert name in cpp.structs and name in py.structs
+        # The mapped ring structs are parsed on BOTH representations: packed
+        # width sequences (W004) and named-field layouts (W005).
+        for name in ("RingCtrl", "RingSlot", "RingCqe"):
+            assert name in cpp.headers and name in py.headers
+            assert name in py.ring_layouts
+            assert py.ring_layouts[name] == [
+                (f, {1: "u8", 2: "u16", 4: "u32", 8: "u64"}[w])
+                for f, w in cpp.headers[name]
+            ]
         # The QoS tag is an OPTIONAL trailing byte on both batch metas,
         # followed by the OPTIONAL trace-context pair (trace id + parent).
         assert cpp.structs["BatchMeta"][-3:] == ["u8?", "u64?", "u64?"]
@@ -175,6 +188,58 @@ class TestWireDrift:
         found = wire_drift.compare(ctx)
         assert any(
             f.rule == "ITS-W004" and "_ROGUE_HEADER" in f.message for f in found
+        )
+
+    def test_ring_same_width_field_swap_is_caught(self, tmp_path):
+        """THE gap ITS-W005 exists for: swapping sq_tail/sq_head is
+        invisible to the width diff (both u64) but misroutes every cursor
+        access in mapped memory."""
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "uint64_t sq_tail;",
+            "uint64_t sq_head_x;",
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W005" and "RingCtrl" in f.message for f in found
+        )
+        # And the width diff alone would indeed have stayed silent.
+        assert not any(
+            f.rule == "ITS-W004" and "RingCtrl" in f.message for f in found
+        )
+
+    def test_ring_width_change_is_caught_by_both(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            "uint32_t meta_len;",
+            "uint16_t meta_len;",
+        ))
+        rules = {f.rule for f in wire_drift.compare(ctx) if "RingSlot" in f.message}
+        assert "ITS-W005" in rules
+        assert "ITS-W004" in rules  # width sequence AND static_assert sum
+
+    def test_ring_layout_removed_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_sub=(
+            '"RingCqe": (',
+            '"RingCqeX": (',
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W005" and "RingCqe has no named-field" in f.message
+            for f in found
+        )
+        assert any(
+            f.rule == "ITS-W005" and "RingCqeX has no packed struct" in f.message
+            for f in found
+        )
+
+    def test_ring_python_field_rename_is_caught(self, tmp_path):
+        ctx = drifted_ctx(tmp_path, wire_sub=(
+            '("token", "u64"),\n        ("meta_len", "u32"),',
+            '("tok", "u64"),\n        ("meta_len", "u32"),',
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W005" and "RingSlot" in f.message and "drifted" in f.message
+            for f in found
         )
 
     def test_block_comment_preserves_line_anchors(self, tmp_path):

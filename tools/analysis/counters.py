@@ -59,6 +59,8 @@ DOCS_REL = "docs/api_reference.md"
 LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/lib.py", "InfinityConnection.qos_stats"),
     ("infinistore_tpu/lib.py", "InfinityConnection.completion_stats"),
+    ("infinistore_tpu/lib.py", "InfinityConnection.ring_stats"),
+    ("infinistore_tpu/lib.py", "StripedConnection.ring_stats"),
     ("infinistore_tpu/lib.py", "StripedConnection.data_plane_stats"),
     ("infinistore_tpu/lib.py", "StripedConnection.completion_stats"),
     ("infinistore_tpu/cluster.py", "_MemberHealth.as_dict"),

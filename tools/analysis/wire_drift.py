@@ -22,6 +22,11 @@ Rules:
 - ITS-W002 struct field sequence drift (reorder / width change / optionality)
 - ITS-W003 struct present in the header but absent from the mirror
 - ITS-W004 fixed header layout/size drift
+- ITS-W005 shared-memory ring struct NAMED-field drift (RingCtrl/RingSlot/
+  RingCqe vs wire.RING_LAYOUTS). Ring slots are memory-mapped by both
+  processes, and a swap of two same-width fields — invisible to the
+  width-sequence diff of W004 — silently misroutes cursors; this rule
+  diffs (name, width) pairs in declaration order.
 """
 
 from __future__ import annotations
@@ -256,9 +261,19 @@ class WireIR:
         self.header_lines: Dict[str, int] = {}
         self.structs: Dict[str, List[str]] = {}
         self.struct_lines: Dict[str, int] = {}
+        # Named-field ring layouts (wire.RING_LAYOUTS): struct -> [(field,
+        # prim)] in declaration order, for the ITS-W005 shared-memory diff.
+        self.ring_layouts: Dict[str, List[Tuple[str, str]]] = {}
+        self.ring_layout_line: int = 1
 
 
-_PY_HEADER_NAMES = {"_REQ_HEADER": "ReqHeader", "_RESP_HEADER": "RespHeader"}
+_PY_HEADER_NAMES = {
+    "_REQ_HEADER": "ReqHeader",
+    "_RESP_HEADER": "RespHeader",
+    "_RING_CTRL": "RingCtrl",
+    "_RING_SLOT": "RingSlot",
+    "_RING_CQE": "RingCqe",
+}
 
 
 def _eval_const(node: ast.expr, env: Dict[str, int]) -> Optional[int]:
@@ -308,6 +323,21 @@ def parse_wire(ctx: Context, rel: str = WIRE_REL) -> WireIR:
                 canonical = _PY_HEADER_NAMES.get(name, name)
                 ir.headers[canonical] = _fmt_to_prims(node.value.args[0].value)
                 ir.header_lines[canonical] = node.lineno
+                continue
+            if name == "RING_LAYOUTS" and isinstance(node.value, ast.Dict):
+                ir.ring_layout_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (isinstance(k, ast.Constant) and isinstance(v, (ast.Tuple, ast.List))):
+                        continue
+                    fields: List[Tuple[str, str]] = []
+                    for elt in v.elts:
+                        if (
+                            isinstance(elt, (ast.Tuple, ast.List))
+                            and len(elt.elts) == 2
+                            and all(isinstance(e, ast.Constant) for e in elt.elts)
+                        ):
+                            fields.append((elt.elts[0].value, elt.elts[1].value))
+                    ir.ring_layouts[k.value] = fields
                 continue
             val = _eval_const(node.value, env)
             if val is not None:
@@ -475,6 +505,27 @@ def compare(ctx: Context, header_rel: str = HEADER_REL, wire_rel: str = WIRE_REL
         f("ITS-W004", wire_rel, py.header_lines.get(name, 1), name,
           f"Python struct format {name} has no packed header in "
           f"{header_rel} — a fixed frame only one side understands")
+
+    # Shared-memory ring structs: NAMED fields in declaration order. The
+    # width diff above cannot see two same-width fields swapped, but both
+    # processes index these structs by field offset in mapped memory.
+    _PRIM = {1: "u8", 2: "u16", 4: "u32", 8: "u64"}
+    for name, fields in sorted(cpp.headers.items()):
+        if not name.startswith("Ring"):
+            continue
+        cpp_named = [(fname, _PRIM[w]) for fname, w in fields]
+        if name not in py.ring_layouts:
+            f("ITS-W005", wire_rel, py.ring_layout_line, name,
+              f"shared-memory struct {name} has no named-field layout in "
+              f"wire.RING_LAYOUTS — field offsets are unverifiable")
+        elif py.ring_layouts[name] != cpp_named:
+            f("ITS-W005", wire_rel, py.ring_layout_line, name,
+              f"shared-memory struct {name} named-field layout drifted: "
+              f"C++ {cpp_named} vs Python {py.ring_layouts[name]}")
+    for name in sorted(set(py.ring_layouts) - set(cpp.headers)):
+        f("ITS-W005", wire_rel, py.ring_layout_line, name,
+          f"wire.RING_LAYOUTS entry {name} has no packed struct in "
+          f"{header_rel}")
 
     # Struct bodies: sequences must match for every struct defined in C++.
     for name, seq in sorted(cpp.structs.items()):

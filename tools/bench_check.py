@@ -258,6 +258,73 @@ CHECKS = [
             f"{m['trace_endpoint_events']:.0f} Chrome trace events"
         ),
     ),
+    # Descriptor-ring data plane (docs/descriptor_ring.md), three gates.
+    # The ROADMAP-2 target: the loopback batched leg (which rides the ring)
+    # must reach >= 0.75 of the SAME round's measured memcpy ceiling — the
+    # paired-round sampling in bench.py keeps numerator and denominator in
+    # one weather window, so this is transport quality, not weather.
+    Check(
+        "ring_ceiling_fraction",
+        ["ring_ceiling_fraction"],
+        lambda m: m["ring_ceiling_fraction"] >= 0.75,
+        lambda m: (
+            f"loopback batched leg reaches {m['ring_ceiling_fraction']:.3f} of "
+            "the paired memcpy ceiling (must be >= 0.75)"
+        ),
+    ),
+    # The A/B leg: the ring must never lose to the socket path it replaces.
+    # At the copy-dominated batched shape the honest effect is ~1.00-1.02x
+    # (the ring removes per-op syscalls + serialize, not the memcpys), and
+    # the paired estimator's residual scatter was measured 0.98-1.02
+    # run-to-run — 0.95 clears the noise floor while a real structural
+    # loss (e.g. ring ops serializing behind each other) reads 0.8 or
+    # worse.
+    Check(
+        "ring_vs_socket",
+        ["ring_vs_socket_speedup"],
+        lambda m: m["ring_vs_socket_speedup"] >= 0.95,
+        lambda m: (
+            f"descriptor ring runs {m['ring_vs_socket_speedup']:.3f}x the "
+            "socket path on the batched A/B leg (must be >= 0.95)"
+        ),
+    ),
+    # Mechanism receipts: every A/B-leg op actually rode the ring (zero
+    # backpressure/oversize fallbacks at this depth — a silent fallback
+    # would A/B the socket against itself) and the doorbell discipline
+    # coalesced (> 1 descriptor per doorbell frame; 1.0 means every post
+    # paid the syscall the ring exists to remove).
+    Check(
+        "ring_mechanism",
+        ["ring_posted", "ring_completions", "ring_full_fallbacks",
+         "ring_meta_fallbacks", "ring_doorbell_ratio"],
+        lambda m: (
+            m["ring_posted"] >= 1
+            and m["ring_completions"] == m["ring_posted"]
+            and m["ring_full_fallbacks"] == 0
+            and m["ring_meta_fallbacks"] == 0
+            and m["ring_doorbell_ratio"] > 1.0
+        ),
+        lambda m: (
+            f"{m['ring_posted']:.0f} descriptors posted, "
+            f"{m['ring_completions']:.0f} completed, "
+            f"{m['ring_full_fallbacks']:.0f}+{m['ring_meta_fallbacks']:.0f} "
+            f"fallbacks (must be 0), {m['ring_doorbell_ratio']:.2f} "
+            "descriptors/doorbell (must be > 1)"
+        ),
+    ),
+    # The PR 7 receipt attributed ~0.80 of traced batched-get wall time to
+    # first_slice->last_slice (the server's sliced copy loop); the ring's
+    # adaptive slice quantum must hold the fraction visibly below that.
+    Check(
+        "ring_stage_shift",
+        ["trace_frac_first_slice_to_last_slice", "ring_posted"],
+        lambda m: m["trace_frac_first_slice_to_last_slice"] <= 0.79,
+        lambda m: (
+            "first_slice->last_slice is "
+            f"{m['trace_frac_first_slice_to_last_slice']:.4f} of traced "
+            "batched-get wall time (must be <= 0.79; PR 7 receipt ~0.80)"
+        ),
+    ),
     # Fleet telemetry (docs/observability.md, fleet section). Binary gates:
     # the availability burn-rate alert must FIRE during the fault-injected
     # window and be SILENT in the clean run (a false positive teaches

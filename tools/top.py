@@ -152,6 +152,25 @@ def render(frame: dict, width: int = 100) -> list:
             bits.append(f"queued fg={fgq or 0:.0f} bg={bgq or 0:.0f}")
         if bits:
             lines.append("local " + "  ".join(bits))
+        # Descriptor-ring data plane (docs/descriptor_ring.md): live depth,
+        # lifetime descriptor volume, and the doorbell coalescing ratio
+        # (descriptors per rx doorbell — high is good: posts were pure
+        # shared memory while the server stayed awake).
+        rconns = fam.get("infinistore_ring_conns")
+        if rconns:
+            descs = fam.get("infinistore_ring_descriptors", 0)
+            db_rx = fam.get('infinistore_ring_doorbells{dir="rx"}', 0)
+            db_tx = fam.get('infinistore_ring_doorbells{dir="tx"}', 0)
+            bad = fam.get("infinistore_ring_bad_descriptors", 0)
+            torn = fam.get("infinistore_ring_torn_descriptors", 0)
+            coalesce = f"{descs / db_rx:.1f}" if db_rx else "-"
+            lines.append(
+                f"ring  conns={rconns:.0f}  "
+                f"sq_depth={fam.get('infinistore_ring_sq_depth', 0):.0f}  "
+                f"pending={fam.get('infinistore_ring_pending', 0):.0f}  "
+                f"descs={descs:.0f}  db rx={db_rx:.0f} tx={db_tx:.0f}  "
+                f"descs/db={coalesce}  bad={bad:.0f} torn={torn:.0f}"
+            )
 
     # Event journal tail.
     events = frame["events"].get("events", [])
