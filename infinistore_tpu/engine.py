@@ -14,8 +14,9 @@ of that story for JAX/TPU engines:
   flight against ONE shared paged cache (``BlockPool`` hands out physical
   blocks, exactly an engine's block-table manager), prefix-hit loads skipping
   recompute, suffix decode coalesced across live requests into lockstep
-  batched waves (``WaveDecoder`` -> one ``decode_step_batched`` call per
-  wave), byte-verified against the model's prefill oracle, and
+  batched RAGGED waves (``WaveDecoder`` -> one ``verify_step_ragged`` call
+  per wave, chunks concatenated, no padding to the wave's widest chunk),
+  byte-verified against the model's prefill oracle, and
   store writes of every computed prefix. Device-cache discipline mirrors a
   real engine scheduler: mutating phases (install scatters donate cache
   buffers; compute rewrites blocks) are exclusive; saves snapshot their
@@ -55,8 +56,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import tracing
-from .models.llama import prefill, prefill_continue, verify_step_batched
+from .models.llama import prefill, prefill_continue, verify_step_ragged
 from .tpu.paged import gather_blocks
+from .tpu.paged_attention import build_ragged_wave
 from .tpu.staging import StagingPoolExhausted
 from .wire import PRIORITY_BACKGROUND
 
@@ -172,27 +174,42 @@ class WaveDecoder:
     speculative-verification chunk — the committed token plus drafted
     continuations); the first arrival schedules a flush, the flush yields
     to the event loop so every ready request joins, then ONE
-    ``verify_step_batched`` call (under the device gate's exclusive phase —
+    ``verify_step_ragged`` call (under the device gate's exclusive phase —
     it mutates the shared cache) advances the whole MIXED wave: decoding
     requests ride as 1-token chunks beside verifying requests' K-token
     chunks, so speculation never leaves the lockstep batch.
 
-    Wave shapes vary with load, but the jitted batched step compiles once
-    per PADDED (B, K) bucket, not per shape seen: the batch pads to a
-    power-of-two B by repeating the last request's entry, and every chunk
-    pads to the wave's power-of-two K by repeating its own last
-    (token, position) row. A repeated row scatters the SAME K/V bytes to
-    the same (block, slot) as the row it copies — duplicate-index scatters
-    with identical payloads are value-deterministic, so padding cannot
-    corrupt the shared cache — and padded logits rows are simply never
-    awaited. ``bucket_sizes`` records the distinct (B, K) buckets (= jit
-    cache entries); the harness test pins the count.
+    Wave assembly is RAGGED (models/llama.py ``verify_step_ragged``): the
+    wave's chunks are CONCATENATED into one flat token list — a mixed wave
+    costs sum(len_i) rows, not the old rectangle's B x max(len_i) with
+    every shorter chunk padded by duplicated rows (a length-skewed wave
+    used to pay the widest chunk B times over). The flat list pads only at
+    the TAIL to a power-of-two row bucket by repeating the last
+    (token, position) row — a repeated row scatters the SAME K/V bytes to
+    the same (block, slot), so the byte-determinism guarantee is
+    unchanged, and a padded row that used to be a duplicated rectangle
+    column is now simply absent. Request tables pad to a power-of-two B
+    whose padded rows no flat token references (they neither scatter nor
+    attend). Attention page metadata (tpu/paged_attention.py
+    ``build_ragged_wave``) pads to a power-of-two page bucket the same
+    way; padded pages fold fully masked (a bitwise no-op).
+
+    ``bucket_sizes`` records the distinct (B, T, P) buckets — table rows,
+    flat token rows, flat attention pages — which ARE the jit cache
+    entries; the harness test pins the count. ``pad_rows``/
+    ``launched_rows`` feed the ``engine_wave_pad_fraction`` metric: the
+    share of launched wave rows that were padding (the rectangle's was
+    1 - sum(len_i) / (B_bucket * K_bucket); the ragged tail's is
+    1 - sum(len_i) / T_bucket).
     """
 
     def __init__(self, harness: "ContinuousBatchingHarness"):
         self.h = harness
         self._pending: List[tuple] = []
         self._flush_scheduled = False
+        # Wave-row padding ledger (engine_wave_pad_fraction).
+        self.pad_rows = 0
+        self.launched_rows = 0
         # Strong references: the event loop holds only weak refs to tasks,
         # so a fire-and-forget flush could be GC'd mid-flight and strand
         # every waiter with _flush_scheduled stuck True. A SET, not a slot:
@@ -238,40 +255,63 @@ class WaveDecoder:
             self._flush_scheduled = False
             if not batch:
                 return
-            # Pad to the power-of-two (B, K) bucket (see class docstring:
-            # duplicate rows re-write identical bytes, so padding is
-            # cache-safe); only real rows' futures resolve.
+            # Ragged assembly (class docstring): concatenate the chunks
+            # into one flat token list; pad only at the tail to the
+            # power-of-two row bucket by repeating the last flat row
+            # (same-bytes scatter, cache-safe); only real rows' futures
+            # resolve.
+            flat_toks: List[int] = []
+            flat_pos: List[int] = []
+            row_of: List[int] = []
+            for r, (toks, pos, _tbl, _fut) in enumerate(batch):
+                flat_toks.extend(toks)
+                flat_pos.extend(pos)
+                row_of.extend([r] * len(toks))
+            t_real = len(flat_toks)
+            t_bucket = 1 << (t_real - 1).bit_length()
+            flat_toks.extend([flat_toks[-1]] * (t_bucket - t_real))
+            flat_pos.extend([flat_pos[-1]] * (t_bucket - t_real))
+            row_of.extend([row_of[-1]] * (t_bucket - t_real))
+            # Table rows pad to a power-of-two B: no flat token references
+            # a padded row, so it neither scatters nor attends. Tables
+            # arrive host-resident (_padded_table) — converting a DEVICE
+            # array here would pay a blocking sync per request per wave.
             b_bucket = 1 << (len(batch) - 1).bit_length()
-            k_max = max(len(toks) for toks, _, _, _ in batch)
-            k_bucket = 1 << (k_max - 1).bit_length()
-            padded = batch + [batch[-1]] * (b_bucket - len(batch))
-            self.bucket_sizes.add((b_bucket, k_bucket))
-
-            def pad_chunk(vals):
-                return list(vals) + [vals[-1]] * (k_bucket - len(vals))
+            tables = [np.asarray(b[2], dtype=np.int32) for b in batch]
+            tables.extend([tables[-1]] * (b_bucket - len(batch)))
+            # The builder picks the page bucket (pad_to_pow2): the per-row
+            # page-count rule lives in build_ragged_wave alone.
+            meta = build_ragged_wave(
+                [tables[r] for r in row_of],
+                [p + 1 for p in flat_pos],
+                self.h.config.block_tokens,
+                pad_to_pow2=True,
+            )
+            self.bucket_sizes.add((b_bucket, t_bucket, meta.num_pages))
+            self.pad_rows += t_bucket - t_real
+            self.launched_rows += t_bucket
 
             async with self.h.gate.exclusive():
-                tokens = jnp.asarray(
-                    [pad_chunk(toks) for toks, _, _, _ in padded], jnp.int32
-                )
-                positions = jnp.asarray(
-                    [pad_chunk(pos) for _, pos, _, _ in padded], jnp.int32
-                )
-                tables = jnp.stack([b[2] for b in padded])
-                logits, self.h.caches = verify_step_batched(
+                logits, self.h.caches = verify_step_ragged(
                     self.h.params,
-                    tokens,
-                    positions,
+                    jnp.asarray(flat_toks, jnp.int32),
+                    jnp.asarray(flat_pos, jnp.int32),
+                    jnp.asarray(row_of, jnp.int32),
+                    jnp.asarray(meta.pages),
+                    jnp.asarray(meta.page_rows),
+                    jnp.asarray(meta.page_starts),
                     self.h.caches,
-                    tables,
+                    jnp.asarray(np.stack(tables)),
                     self.h.config,
                     self.h.max_req_blocks,
                 )
             self.waves += 1
             self.max_wave = max(self.max_wave, len(batch))
-            for i, (toks, _, _, fut) in enumerate(batch):
+            off = 0
+            for toks, _, _, fut in batch:
                 if not fut.done():
-                    fut.set_result(logits[i, : len(toks)])
+                    fut.set_result(logits[off : off + len(toks)])
+                off += len(toks)
         except BaseException as e:  # noqa: BLE001 - must fail the waiters
             # A dead flush (model error, or cancellation/GC at shutdown)
             # must strand NO waiter: fail the taken batch and anything still
@@ -494,7 +534,7 @@ class ContinuousBatchingHarness:
     ):
         """``drafter``: enables speculative decoding in the serving loop —
         each generation round verifies the drafted chunk in one wave row
-        (verify_step_batched), emitting every greedy-accepted token plus
+        (verify_step_ragged), emitting every greedy-accepted token plus
         the model's continuation, so tokens/round can exceed 1 with output
         identical to plain greedy decode."""
         self.adapter = adapter
@@ -537,10 +577,15 @@ class ContinuousBatchingHarness:
 
     # -- model compute -------------------------------------------------------
 
-    def _padded_table(self, table: np.ndarray) -> jax.Array:
+    def _padded_table(self, table: np.ndarray) -> np.ndarray:
+        """Host-resident padded table. Numpy ON PURPOSE: the WaveDecoder
+        re-reads it every flush to assemble ragged metadata, and a device
+        array there would cost a blocking device->host sync per request
+        per wave (jitted callees convert the small [max_blocks] int32 at
+        trace time either way)."""
         pad = np.zeros(self.max_req_blocks, dtype=np.int32)
         pad[: len(table)] = table
-        return jnp.asarray(pad)
+        return pad
 
     def _prefill_full(self, token_ids, table: np.ndarray):
         """Whole-prompt prefill into this request's blocks (cache-mutating:
@@ -959,7 +1004,30 @@ class ContinuousBatchingHarness:
         return self.metrics()
 
     def metrics(self) -> dict:
-        """Aggregate engine-side metrics over every completed request."""
+        """Aggregate engine-side metrics over every completed request.
+
+        Keys (the ``engine_*`` bench-receipt vocabulary, counters-checked
+        against this list): ``requests``, ``hit_rate``, ``loaded_blocks``,
+        ``computed_blocks``, ``raced_evictions``; admission latency
+        ``p50_admission_us`` / ``p99_admission_us`` decomposed into the
+        store's own cost (``p50_store_io_us``, ``p99_store_io_us``, split
+        by outcome as ``p50_store_io_hit_us`` / ``p50_store_io_miss_us``)
+        vs device-gate queueing (``p50_gate_stall_us``,
+        ``p99_gate_stall_us``); the two-phase admission overlap story
+        (``p50_gate_hold_us``, ``p99_gate_hold_us``, ``overlap_fraction``,
+        ``prefetch_waste``, ``prefetch_fallbacks``) and end-to-end prefix
+        residency (``p50_prefix_ready_hit_us``,
+        ``p50_prefix_ready_miss_us``); the recompute ledger
+        (``recompute_saved_s``, ``prefill_per_block_s``); concurrency
+        receipts (``max_live_requests``, ``max_concurrent_saves``); the
+        ragged wave-decode story (``decode_waves``, ``max_wave_size``,
+        ``wave_buckets`` — distinct padded (B, T, P) jit buckets — and
+        ``wave_pad_fraction``, the share of launched wave rows that were
+        padding); generation/speculation (``generated_tokens``,
+        ``spec_tokens_per_step``, ``spec_acceptance_rate``,
+        ``spec_drafted_tokens``, ``spec_accepted_tokens``);
+        ``all_verified``; and, over a self-healing pool, ``store_health``.
+        """
         total_blocks = sum(s.hit_blocks + s.computed_blocks for s in self.stats)
         loaded = sum(s.loaded_blocks for s in self.stats)
         lat = sorted(s.admission_us for s in self.stats)
@@ -1034,9 +1102,19 @@ class ContinuousBatchingHarness:
             "max_concurrent_saves": self.max_concurrent_saves,
             "decode_waves": self.wave.waves,
             "max_wave_size": self.wave.max_wave,
-            # Distinct PADDED (B, K) buckets == jit cache entries for the
-            # batched step (jit keys on shape): the compile-count story.
+            # Distinct PADDED (B, T, P) buckets == jit cache entries for
+            # the ragged wave step (jit keys on shape): the compile-count
+            # story.
             "wave_buckets": sorted(self.wave.bucket_sizes),
+            # Share of launched wave rows that were padding (ragged
+            # assembly pads only the flat tail; the old rectangle padded
+            # every short chunk to the widest one) — the attribution key
+            # for the ragged win.
+            "wave_pad_fraction": (
+                self.wave.pad_rows / self.wave.launched_rows
+                if self.wave.launched_rows
+                else 0.0
+            ),
             "generated_tokens": sum(
                 len(s.generated) for s in self.stats if s.generated
             ),

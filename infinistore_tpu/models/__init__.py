@@ -14,6 +14,7 @@ from .llama import (
     decode_step,
     decode_step_batched,
     verify_step_batched,
+    verify_step_ragged,
     init_params,
     loss_fn,
     prefill,
@@ -31,6 +32,7 @@ __all__ = [
     "decode_step",
     "decode_step_batched",
     "verify_step_batched",
+    "verify_step_ragged",
     "loss_fn",
     "train_step",
 ]

@@ -25,7 +25,10 @@ import numpy as np
 
 from ..tpu.flash_prefill import flash_prefill_attention
 from ..tpu.paged import PagedKVCacheSpec, scatter_blocks
-from ..tpu.paged_attention import paged_decode_attention_batched
+from ..tpu.paged_attention import (
+    paged_decode_attention_batched,
+    paged_decode_attention_rows,
+)
 
 Params = Dict[str, jax.Array]
 Caches = List[Tuple[jax.Array, jax.Array]]
@@ -367,6 +370,85 @@ def verify_step_batched(
     x = _rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
     return logits, new_caches
+
+
+@functools.partial(jax.jit, static_argnames=("config", "max_blocks"))
+def verify_step_ragged(
+    params: Params,
+    tokens: jax.Array,  # [T] int32, the wave's chunks CONCATENATED row-major
+    positions: jax.Array,  # [T] int32 absolute position of each flat token
+    row_of: jax.Array,  # [T] int32 owning request per flat token (sorted)
+    pages: jax.Array,  # [P] int32 flat attention page list (RaggedWaveMeta)
+    page_rows: jax.Array,  # [P + 1] int32 owning flat token per page
+    page_starts: jax.Array,  # [T] int32 first page per flat token
+    caches: Caches,  # SHARED paged cache across the wave
+    block_tables: jax.Array,  # [B, max_blocks] int32 (rows padded)
+    config: LlamaConfig,
+    max_blocks: int,
+) -> Tuple[jax.Array, Caches]:
+    """The RAGGED form of ``verify_step_batched``: a mixed wave where
+    request chunks keep their OWN lengths — the wave is one flat [T] token
+    list (T = sum of chunk lengths) with per-token request/page metadata,
+    instead of a [B, K] rectangle padded to the widest chunk.
+
+    Why it exists: the rectangular wave pays B x max(K_i) rows per launch
+    (a lone 8-token verification chunk makes every decoding request pad
+    7 duplicate rows), and its attention grid scans max_blocks table
+    entries per row. Here the launch covers exactly the real rows (plus
+    tail-bucket padding that repeats the LAST flat row — same-bytes
+    scatter, value-safe like the rectangular padding, but one row instead
+    of a rectangle), and on TPU the attention walks the flat page list
+    (tpu/paged_attention.py ragged kernel): sum(ceil((pos_t + 1) / bt))
+    block folds, no padding to the wave max.
+
+    Per-token semantics are IDENTICAL to ``verify_step_batched`` — each
+    flat token inserts its K/V at (table[pos // bt], pos % bt) and attends
+    its own prefix masked to pos + 1 — so a mixed ragged wave equals
+    per-request sequential decode byte-for-byte on the cache and the
+    logits (pinned by the engine tests). ``block_tables`` rows beyond the
+    real requests (bucket padding) are never referenced by any flat token:
+    a padded WAVE ROW no longer scatters or attends at all, it is simply
+    absent. Returns ([T, vocab] logits, updated caches)."""
+    t = tokens.shape[0]
+    if positions.shape != (t,) or row_of.shape != (t,):
+        raise ValueError(
+            f"positions/row_of must match tokens' [{t}], got "
+            f"{positions.shape}/{row_of.shape}"
+        )
+    if page_starts.shape != (t,):
+        raise ValueError(f"page_starts must be [{t}], got {page_starts.shape}")
+    if block_tables.ndim != 2 or block_tables.shape[1] != max_blocks:
+        raise ValueError(
+            f"block_tables must be [B, {max_blocks}], got {block_tables.shape}"
+        )
+    bt = config.block_tokens
+    x = jnp.take(params["embed"], tokens, axis=0)[None]  # [1, T, dim]
+    pos2d = positions[None]  # [1, T]
+
+    row_tables = jnp.take(block_tables, row_of, axis=0)  # [T, max_blocks]
+    block_idx = jnp.take_along_axis(
+        row_tables, (positions // bt)[:, None], axis=1
+    )[:, 0]
+    slots = positions % bt
+    seq_lens = positions + 1
+
+    new_caches: Caches = []
+    for layer, (k_cache, v_cache) in enumerate(caches):
+        k, v = _kv_proj(params, layer, x, pos2d, config)  # [1, T, KVH, D]
+        k_cache = k_cache.at[block_idx, slots].set(k[0].astype(k_cache.dtype))
+        v_cache = v_cache.at[block_idx, slots].set(v[0].astype(v_cache.dtype))
+        pre = f"l{layer}."
+        q = _q_proj(params, layer, x, pos2d, config)  # [1, T, H, D]
+        attn = paged_decode_attention_rows(
+            q[0], k_cache, v_cache, row_tables, seq_lens,
+            pages, page_rows, page_starts,
+        )[None]  # [1, T, H, D]
+        x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
+        x = _ffn(params, layer, x, config)
+        new_caches.append((k_cache, v_cache))
+    x = _rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits[0], new_caches
 
 
 def prefill_continue(

@@ -27,8 +27,13 @@ from .kv_quant import (
     quantize_kv,
 )
 from .paged_attention import (
+    RaggedWaveMeta,
+    build_ragged_wave,
+    build_ragged_wave_sharded,
     paged_decode_attention,
     paged_decode_attention_batched,
+    paged_decode_attention_ragged,
+    paged_decode_attention_ragged_sharded,
     paged_decode_attention_sharded,
     paged_decode_attention_xla,
 )
@@ -48,8 +53,13 @@ __all__ = [
     "quantize_kv",
     "dequantize_kv",
     "paged_decode_attention_quantized",
+    "RaggedWaveMeta",
+    "build_ragged_wave",
+    "build_ragged_wave_sharded",
     "paged_decode_attention",
     "paged_decode_attention_batched",
+    "paged_decode_attention_ragged",
+    "paged_decode_attention_ragged_sharded",
     "paged_decode_attention_sharded",
     "paged_decode_attention_xla",
     "HostStagingPool",

@@ -44,22 +44,38 @@ _NEG_INF = -1e30
 
 
 def _attn_block_update(b, i, seqlen_ref, q, k, v, m_scr, l_scr, acc_scr):
-    """One grid step of the online softmax: fold cache block ``i`` of request
-    ``b`` into the running (max, denominator, accumulator) scratch. Shared by
-    the normalizing kernel, the partial-stats kernel (sharded decode), and
-    the int8 kernel (kv_quant.py, which dequantizes in VMEM first).
+    """One grid step of the online softmax on the RECTANGULAR (B, n) grid:
+    fold cache block ``i`` of request ``b`` into the running scratch. Thin
+    wrapper over :func:`_attn_block_fold` kept for the callers whose grid
+    coordinates ARE the (request, block-in-request) pair — the dense-wave
+    kernels here and the int8 kernel (kv_quant.py, which dequantizes in
+    VMEM first)."""
+    _attn_block_fold(i == 0, i, seqlen_ref[b], q, k, v, m_scr, l_scr, acc_scr)
+
+
+def _attn_block_fold(first, j, seq_len, q, k, v, m_scr, l_scr, acc_scr):
+    """Fold ONE cache block into the running (max, denominator, accumulator)
+    scratch — the single copy of the online-softmax numeric contract every
+    decode kernel shares (dense-wave, ragged, stats, int8).
+
+    ``first``: traced bool — this is the request's first block, reset the
+    accumulators. ``j``: block index WITHIN the request (the ragged grid is
+    flat, so the grid step is not the block index). ``seq_len``: traced
+    scalar count of the request's valid context tokens.
 
     q: [H, D] f32; k/v: [bt, KVH, D] f32 (already loaded from refs — all
     dots request f32 accumulation at HIGHEST precision: XLA's DEFAULT runs
-    f32 matmuls in bf16 passes, which would quantize the statistics)."""
+    f32 matmuls in bf16 passes, which would quantize the statistics).
+
+    A fully-masked block is a BITWISE no-op on the scratch (alpha = exp(0)
+    = 1, every p zeroed, l and acc multiplied by 1.0 and incremented by
+    0.0), which is what lets the ragged layout pad its flat page list and
+    the dense layout pad its tables without changing a single output bit."""
     h, d = q.shape
     bt, kvh = k.shape[0], k.shape[1]
     groups = h // kvh
 
-    # Grid order is row-major (request b outer, block i inner), so the
-    # accumulators reset at each request's first block and the output is
-    # finalized before the grid moves to request b+1.
-    @pl.when(i == 0)
+    @pl.when(first)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
@@ -84,8 +100,8 @@ def _attn_block_update(b, i, seqlen_ref, q, k, v, m_scr, l_scr, acc_scr):
         * scale
     )
 
-    pos = i * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
-    valid = pos < seqlen_ref[b]
+    pos = j * bt + jax.lax.broadcasted_iota(jnp.int32, (h, bt), 1)
+    valid = pos < seq_len
     logits = jnp.where(valid, logits, _NEG_INF)
 
     m_prev = m_scr[...]  # [H, 128] (all lanes equal)
@@ -335,6 +351,349 @@ def paged_decode_attention_xla_batched(q, k_cache, v_cache, block_tables, seq_le
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Ragged decode attention: one flat grid over the wave's CONCATENATED page
+# lists — a length-skewed wave costs sum(ceil(len_i / bt)) block folds
+# instead of the rectangular layout's B * max_blocks (Ragged Paged
+# Attention, PAPERS.md). The kernel never materializes gathered KV: the
+# scalar-prefetched flat page list drives the K/V BlockSpec index maps
+# exactly like the rectangular kernel, and the per-page row map decides
+# when the online-softmax scratch resets and when a row's output is
+# finalized.
+# ---------------------------------------------------------------------------
+
+
+class RaggedWaveMeta:
+    """Host-assembled metadata for one ragged decode wave of R rows.
+
+    Layout contract (all int32 numpy arrays, built by
+    :func:`build_ragged_wave`):
+
+    - ``pages`` [P]: the wave's page lists concatenated in row order; row
+      r's pages are ``pages[page_starts[r] : page_starts[r] + nb_r]`` with
+      ``nb_r = max(1, ceil(seq_lens[r] / block_tokens))`` (a zero-length
+      row carries ONE fully-masked page so its output block is still
+      written — as zeros, the framework-wide empty-row contract). The tail
+      may be padded with copies of the last page to a static bucket; padded
+      entries belong to the last row and fold as fully-masked blocks, a
+      bitwise no-op (see _attn_block_fold).
+    - ``page_rows`` [P + 1]: owning row of each flat page, non-decreasing,
+      with sentinel ``page_rows[P] == R`` so ``page_rows[i + 1] != row``
+      detects a row's last page without branching.
+    - ``page_starts`` [R]: index of each row's first page in ``pages``.
+    - ``seq_lens`` [R]: valid context tokens per row.
+    - ``pad_pages``: how many tail entries are padding (the pad-fraction
+      accounting the engine exports as ``engine_wave_pad_fraction``).
+    """
+
+    __slots__ = ("pages", "page_rows", "page_starts", "seq_lens", "pad_pages")
+
+    def __init__(self, pages, page_rows, page_starts, seq_lens, pad_pages):
+        self.pages = pages
+        self.page_rows = page_rows
+        self.page_starts = page_starts
+        self.seq_lens = seq_lens
+        self.pad_pages = pad_pages
+
+    @property
+    def num_pages(self) -> int:
+        return int(self.pages.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.seq_lens.shape[0])
+
+
+def build_ragged_wave(
+    tables, seq_lens, block_tokens: int, pad_to: int = 0,
+    pad_to_pow2: bool = False,
+):
+    """Assemble :class:`RaggedWaveMeta` from per-row page tables.
+
+    ``tables``: sequence of R 1-D int arrays/lists — row r's block table
+    (entries past its sequence are ignored; the table must cover
+    ``ceil(seq_lens[r] / block_tokens)`` entries). ``pad_to``: pad the flat
+    page list to this static length (0 = exact). ``pad_to_pow2``: let the
+    BUILDER pick the power-of-two bucket from its own page count — the
+    form jit-bucketing callers (engine, bench legs) should use, so the
+    per-row page-count rule lives in exactly one place."""
+    seq_lens = np.asarray(seq_lens, dtype=np.int32)
+    r = len(tables)
+    if r == 0 or seq_lens.shape != (r,):
+        raise ValueError(f"need >= 1 rows with one seq_len each, got {r} "
+                         f"tables / seq_lens {seq_lens.shape}")
+    chunks, starts, total = [], [], 0
+    for row, table in enumerate(tables):
+        table = np.asarray(table, dtype=np.int32).reshape(-1)
+        nb = max(1, -(-int(seq_lens[row]) // block_tokens))
+        if table.shape[0] < nb:
+            raise ValueError(
+                f"row {row}: table has {table.shape[0]} pages, needs {nb} "
+                f"for seq_len {int(seq_lens[row])}"
+            )
+        chunks.append(table[:nb])
+        starts.append(total)
+        total += nb
+    if pad_to and pad_to < total:
+        raise ValueError(f"pad_to={pad_to} < {total} real pages")
+    if pad_to_pow2 and not pad_to:
+        pad_to = 1 << (total - 1).bit_length()
+    p = pad_to or total
+    pages = np.empty(p, dtype=np.int32)
+    pages[:total] = np.concatenate(chunks)
+    pages[total:] = pages[total - 1]  # valid id; folds fully masked
+    page_rows = np.empty(p + 1, dtype=np.int32)
+    for row, start in enumerate(starts):
+        end = starts[row + 1] if row + 1 < r else total
+        page_rows[start:end] = row
+    page_rows[total:p] = r - 1  # padding rides the last row, masked
+    page_rows[p] = r  # sentinel: no real row, terminates the last row
+    return RaggedWaveMeta(
+        pages=pages,
+        page_rows=page_rows,
+        page_starts=np.asarray(starts, dtype=np.int32),
+        seq_lens=seq_lens,
+        pad_pages=p - total,
+    )
+
+
+def _ragged_fold(rows_ref, starts_ref, seqlen_ref, q_ref, k_ref, v_ref,
+                 m_scr, l_scr, acc_scr):
+    """Shared body of the ragged kernels: fold flat page ``i`` into its
+    row's scratch; returns (row, is_last_page_of_row)."""
+    i = pl.program_id(0)
+    b = rows_ref[i]
+    # First page of a row: flat index 0, or the row changed. The i == 0 arm
+    # keeps the clamped rows_ref[-1] read from aliasing row 0's own id.
+    first = jnp.logical_or(i == 0, rows_ref[jnp.maximum(i - 1, 0)] != b)
+    _attn_block_fold(
+        first,
+        i - starts_ref[b],
+        seqlen_ref[b],
+        q_ref[0].astype(jnp.float32),
+        k_ref[0].astype(jnp.float32),
+        v_ref[0].astype(jnp.float32),
+        m_scr,
+        l_scr,
+        acc_scr,
+    )
+    # rows_ref is [P + 1] with sentinel R, so i + 1 never reads past the end
+    # and the wave's very last page (padding included) finalizes its row.
+    return b, rows_ref[i + 1] != b
+
+
+def _ragged_decode_attn_kernel(
+    rows_ref,  # scalar-prefetch: [P + 1] int32 owning row per page
+    pages_ref,  # scalar-prefetch: [P] int32 flat page list (drives DMA)
+    starts_ref,  # scalar-prefetch: [R] int32 first flat index per row
+    seqlen_ref,  # scalar-prefetch: [R] int32 valid context lengths
+    q_ref,  # [1, H, D] this row's query
+    k_ref,  # [1, bt, KVH, D] one cache page
+    v_ref,  # [1, bt, KVH, D]
+    out_ref,  # [1, H, D]
+    m_scr,  # VMEM [H, 128] f32
+    l_scr,  # VMEM [H, 128] f32
+    acc_scr,  # VMEM [H, D] f32
+):
+    del pages_ref
+    _, last = _ragged_fold(
+        rows_ref, starts_ref, seqlen_ref, q_ref, k_ref, v_ref,
+        m_scr, l_scr, acc_scr,
+    )
+
+    @pl.when(last)
+    def _finish():
+        out_ref[0] = (
+            acc_scr[...] / jnp.maximum(l_scr[:, :1], 1e-30)
+        ).astype(out_ref.dtype)
+
+
+def _ragged_decode_attn_stats_kernel(
+    rows_ref, pages_ref, starts_ref, seqlen_ref,
+    q_ref, k_ref, v_ref,
+    acc_ref,  # [1, H, D] f32 unnormalized numerator
+    m_ref,  # [1, H, 128] f32
+    l_ref,  # [1, H, 128] f32
+    m_scr, l_scr, acc_scr,
+):
+    """Ragged online softmax emitting raw (acc, m, l) — the shard-local
+    half of ragged sharded decode (combined with pmax/psum exactly like the
+    rectangular stats kernel's output)."""
+    del pages_ref
+    _, last = _ragged_fold(
+        rows_ref, starts_ref, seqlen_ref, q_ref, k_ref, v_ref,
+        m_scr, l_scr, acc_scr,
+    )
+
+    @pl.when(last)
+    def _finish():
+        acc_ref[0] = acc_scr[...]
+        m_ref[0] = m_scr[...]
+        l_ref[0] = l_scr[...]
+
+
+def _ragged_grid_spec(h, d, bt, kvh, p, out_specs):
+    block = (1, bt, kvh, d)
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(p,),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda i, rows, pages, st, sl: (rows[i], 0, 0)),
+            pl.BlockSpec(block, lambda i, rows, pages, st, sl: (pages[i], 0, 0, 0)),
+            pl.BlockSpec(block, lambda i, rows, pages, st, sl: (pages[i], 0, 0, 0)),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_pallas_ragged(
+    q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens, *, interpret
+):
+    """q: [R, H, D]; flat metadata per RaggedWaveMeta's layout contract."""
+    r, h, d = q.shape
+    _, bt, kvh, _ = k_cache.shape
+    p = pages.shape[0]
+    grid_spec = _ragged_grid_spec(
+        h, d, bt, kvh, p,
+        pl.BlockSpec((1, h, d), lambda i, rows, pages, st, sl: (rows[i], 0, 0)),
+    )
+    return pl.pallas_call(
+        _ragged_decode_attn_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, h, d), q.dtype),
+        interpret=interpret,
+    )(page_rows, pages, page_starts, seq_lens, q, k_cache, v_cache)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_decode_attention_pallas_ragged_stats(
+    q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens, *, interpret
+):
+    """Raw ragged (acc, m, l): acc [R,H,D] f32, m/l [R,H,1] f32."""
+    r, h, d = q.shape
+    _, bt, kvh, _ = k_cache.shape
+    p = pages.shape[0]
+    out = lambda i, rows, pages, st, sl: (rows[i], 0, 0)
+    grid_spec = _ragged_grid_spec(
+        h, d, bt, kvh, p,
+        [
+            pl.BlockSpec((1, h, d), out),
+            pl.BlockSpec((1, h, 128), out),
+            pl.BlockSpec((1, h, 128), out),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        _ragged_decode_attn_stats_kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((r, h, d), jnp.float32),
+            jax.ShapeDtypeStruct((r, h, 128), jnp.float32),
+            jax.ShapeDtypeStruct((r, h, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(page_rows, pages, page_starts, seq_lens, q, k_cache, v_cache)
+    return acc, m[:, :, :1], l[:, :, :1]
+
+
+def _ragged_row_tables(pages, page_starts, table_width: int):
+    """Reconstruct [R, table_width] per-row tables from the flat page list
+    for the XLA fallback (which gathers per row). Entries past a row's real
+    pages alias LATER pages in the flat list (clamped in range) — valid ids
+    whose contents are masked by seq_len, the padded-table contract the
+    rectangular fallback already honors (tested:
+    test_padded_table_entries_are_ignored)."""
+    idx = page_starts[:, None] + jnp.arange(table_width, dtype=jnp.int32)[None, :]
+    return jnp.take(pages, jnp.minimum(idx, pages.shape[0] - 1), axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("table_width",))
+def _paged_decode_attention_ragged_xla(
+    q, k_cache, v_cache, pages, page_starts, seq_lens, *, table_width
+):
+    """XLA fallback for the ragged entry, jitted as ONE unit so the table
+    reconstruction fuses with the gather instead of dispatching eagerly
+    (measured ~20% per-call overhead unfused on the CPU backend)."""
+    tables = _ragged_row_tables(pages, page_starts, table_width)
+    return paged_decode_attention_xla_batched(
+        q, k_cache, v_cache, tables, seq_lens
+    )
+
+
+def paged_decode_attention_ragged(
+    q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens,
+    *, table_width: int
+):
+    """Decode attention for a RAGGED wave: R rows over one shared paged
+    cache with per-row context lengths, no padding to the wave max.
+
+    q: [R, n_heads, head_dim]; the flat metadata follows
+    :class:`RaggedWaveMeta` (use :func:`build_ragged_wave`). ``table_width``
+    (static): max pages any row spans — only the XLA fallback uses it, to
+    reconstruct rectangular tables for its gather. On TPU one fused kernel
+    walks the flat page list: sum(ceil(len_i / bt)) block folds total, so
+    an 8:1 length-skewed wave costs ~the mean length, not B x max. Rows
+    with seq_len 0 return zeros on every backend."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas_ragged(
+            q, k_cache, v_cache,
+            jnp.asarray(pages, jnp.int32),
+            jnp.asarray(page_rows, jnp.int32),
+            jnp.asarray(page_starts, jnp.int32),
+            jnp.asarray(seq_lens, jnp.int32),
+            interpret=False,
+        )
+    return _paged_decode_attention_ragged_xla(
+        q, k_cache, v_cache,
+        jnp.asarray(pages, jnp.int32),
+        jnp.asarray(page_starts, jnp.int32),
+        jnp.asarray(seq_lens, jnp.int32),
+        table_width=table_width,
+    )
+
+
+def paged_decode_attention_rows(
+    q, k_cache, v_cache, row_tables, seq_lens, pages, page_rows, page_starts
+):
+    """Per-row decode attention with BOTH layouts in hand — the model's
+    ragged wave body (models/llama.py verify_step_ragged) calls this with
+    one row per flat wave token. Same semantics as
+    :func:`paged_decode_attention_batched` over ``row_tables``; on TPU the
+    flat ragged metadata routes to the ragged kernel (sum of per-row page
+    counts, no B x max_blocks grid), while the XLA fallback keeps the
+    rectangular gather — whose per-row computation is shape-identical to a
+    B=1 launch, the property the engine's wave-vs-sequential byte-identity
+    test pins."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas_ragged(
+            q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens,
+            interpret=False,
+        )
+    return paged_decode_attention_xla_batched(
+        q, k_cache, v_cache, row_tables, seq_lens
+    )
+
+
+def _decode_attention_stats_ragged(
+    q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens,
+    table_width: int,
+):
+    """Raw ragged (acc, m, l) dispatcher (Pallas on TPU, XLA off) — the
+    shard-local half of ragged sharded decode."""
+    if _use_pallas():
+        return _paged_decode_attention_pallas_ragged_stats(
+            q, k_cache, v_cache, pages, page_rows, page_starts, seq_lens,
+            interpret=False,
+        )
+    tables = _ragged_row_tables(pages, page_starts, table_width)
+    return _decode_attention_stats_xla(q, k_cache, v_cache, tables, seq_lens)
+
+
 def _use_pallas() -> bool:
     return pltpu is not None and jax.default_backend() == "tpu"
 
@@ -382,11 +741,26 @@ def paged_decode_attention_sharded(
     )
 
 
+def _shard_map():
+    """``jax.shard_map`` where the jax is new enough, else the experimental
+    namespace it graduated from (this box's 0.4.x) — same signature either
+    way. Function-local on purpose: the module-level ``from jax import
+    shard_map`` in ici.py/models/* is a KNOWN env failure this repo leaves
+    alone (ROADMAP note), and a global compat shim would make those
+    modules' tests collect and fail on deeper new-jax APIs."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover - depends on host jax version
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
 @functools.lru_cache(maxsize=None)
 def _sharded_decode_fn(mesh, axis: str):
     """Build (once per mesh/axis) the shard_map'd local-stats + combine."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    shard_map = _shard_map()
 
     def local_fn(q_rep, kc, vc, tbl, sl):
         acc, m, l = _decode_attention_stats(q_rep[None], kc, vc, tbl, sl)
@@ -408,6 +782,124 @@ def _sharded_decode_fn(mesh, axis: str):
             mesh=mesh,
             in_specs=(P(None, None), cache_spec, cache_spec, P(axis, None), P(axis)),
             out_specs=P(None, None),
+        )
+    )
+    return fn, cache_spec
+
+
+def build_ragged_wave_sharded(local_tables, local_lens, block_tokens: int):
+    """Per-shard :func:`build_ragged_wave` metadata for a ragged wave whose
+    KV pages are SHARDED over a mesh axis, stacked into the [P, ...]
+    leading-axis arrays ``shard_map`` splits.
+
+    ``local_tables``: P sequences of R per-row SHARD-LOCAL page tables
+    (each row indexes within its shard's cache rows); ``local_lens``:
+    [P, R] valid token counts per (shard, row) — 0 is fine: the row gets
+    one fully-masked page on that shard, whose (acc=0, m=-inf, l=0) stats
+    carry zero combine weight. Every shard's flat list pads to the fleet
+    max so the stacked arrays are rectangular.
+
+    Returns (pages [P, maxP], page_rows [P, maxP+1], page_starts [P, R],
+    seq_lens [P, R], table_width) — table_width sized for the XLA
+    fallback's per-row reconstruction."""
+    local_lens = np.asarray(local_lens, dtype=np.int32)
+    p = len(local_tables)
+    if p == 0 or local_lens.shape[0] != p:
+        raise ValueError("need one table list + len row per shard")
+    # Per-(shard, row) page counts — same rule as build_ragged_wave's loop
+    # (a zero-length row still carries one masked page) — give the fleet
+    # max without building each shard's metadata twice.
+    counts = np.maximum(1, -(-local_lens // block_tokens))
+    max_p = int(counts.sum(axis=1).max())
+    padded = [
+        build_ragged_wave(tables, lens, block_tokens, pad_to=max_p)
+        for tables, lens in zip(local_tables, local_lens)
+    ]
+    width = int(counts.max())
+    return (
+        np.stack([m.pages for m in padded]),
+        np.stack([m.page_rows for m in padded]),
+        np.stack([m.page_starts for m in padded]),
+        local_lens,
+        width,
+    )
+
+
+def paged_decode_attention_ragged_sharded(
+    q, k_cache, v_cache, local_pages, local_rows, local_starts, local_lens,
+    *, mesh, axis: str = "sp", table_width: int,
+):
+    """Ragged decode attention for a WAVE of R rows whose paged KV is
+    sharded over ``mesh``'s ``axis`` — the multi-chip serving shape where
+    one engine step advances every live request and the wave's contexts
+    together exceed a single device's HBM.
+
+    Layout contract: ``k_cache``/``v_cache`` are [P * blocks_per_shard, bt,
+    KVH, D] sharded over ``axis`` on the block dimension. The per-shard
+    ragged metadata comes from :func:`build_ragged_wave_sharded`:
+    ``local_pages`` [P, maxP] flat SHARD-LOCAL page lists, ``local_rows``
+    [P, maxP + 1] owning-row maps, ``local_starts`` [P, R], ``local_lens``
+    [P, R] valid tokens per (shard, row). ``q`` is [R, H, D], replicated.
+
+    Each shard folds its local pages with the RAGGED stats kernel (flat
+    grid, no padding to the wave max) and the per-row (acc, m, l) combine
+    with the same one-pmax-two-psum rule as the single-request sharded
+    path — softmax statistics merge identically whether the rows were
+    rectangular or ragged, so the ragged layout composes with context
+    sharding for free. Cached bytes never cross the interconnect; only
+    [R, H, D]-sized statistics do. Returns [R, H, D], replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn, cache_spec = _sharded_ragged_decode_fn(mesh, axis, int(table_width))
+    put = lambda x, spec: jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, spec)
+    )
+    meta_put = lambda x: jax.device_put(
+        jnp.asarray(x, jnp.int32), NamedSharding(mesh, P(axis, None))
+    )
+    return fn(
+        put(q, P(None, None, None)),
+        put(k_cache, cache_spec),
+        put(v_cache, cache_spec),
+        meta_put(local_pages),
+        meta_put(local_rows),
+        meta_put(local_starts),
+        meta_put(local_lens),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_ragged_decode_fn(mesh, axis: str, table_width: int):
+    """Build (once per mesh/axis/width) the shard_map'd ragged local-stats
+    + per-row combine. lru_cached for the same reason as the single-request
+    builder: this is a per-decode-token entry point."""
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = _shard_map()
+
+    def local_fn(q_rep, kc, vc, pages, rows, starts, lens):
+        acc, m, l = _decode_attention_stats_ragged(
+            q_rep, kc, vc, pages[0], rows[0], starts[0], lens[0], table_width
+        )  # [R, H, D], [R, H, 1], [R, H, 1]
+        m_g = jax.lax.pmax(m, axis)
+        w = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * w, axis)
+        acc_g = jax.lax.psum(acc * w, axis)
+        # max(l, tiny): only the "row empty on EVERY shard" case (seq_len
+        # 0), which must read as zeros, not 0/0 NaN.
+        return (acc_g / jnp.maximum(l_g, 1e-30)).astype(q_rep.dtype)
+
+    cache_spec = P(axis, None, None, None)
+    meta_spec = P(axis, None)
+    fn = jax.jit(
+        shard_map(
+            local_fn,
+            mesh=mesh,
+            in_specs=(
+                P(None, None, None), cache_spec, cache_spec,
+                meta_spec, meta_spec, meta_spec, meta_spec,
+            ),
+            out_specs=P(None, None, None),
         )
     )
     return fn, cache_spec

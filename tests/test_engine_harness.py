@@ -233,21 +233,24 @@ def test_multi_turn_conversation_hits_generated_blocks(conn, params):
 
 
 def test_wave_sizes_bucket_to_powers_of_two(conn, params, monkeypatch):
-    """Varied wave shapes must reach the jitted batched step only at
-    power-of-two PADDED (B, K) buckets (jit keys its cache on shape, so
-    distinct shapes == compiles): a run whose natural wave sizes wander
-    over 1..5 compiles at most the 1/2/4/8 batch buckets, and padding rows
-    must not perturb any request's output (all verified)."""
+    """Varied wave shapes must reach the jitted ragged step only at
+    power-of-two PADDED (B, T, P) buckets — table rows, flat token rows,
+    flat attention pages (jit keys its cache on shape, so distinct shapes
+    == compiles): a run whose natural wave sizes wander over 1..5 buckets
+    to the power-of-two ladder, and the tail padding rows must not perturb
+    any request's output (all verified)."""
     import infinistore_tpu.engine as engine_mod
 
     shapes_seen = set()
-    real = engine_mod.verify_step_batched
+    real = engine_mod.verify_step_ragged
 
-    def recording(params_, tokens, *a, **kw):
-        shapes_seen.add((int(tokens.shape[0]), int(tokens.shape[1])))
-        return real(params_, tokens, *a, **kw)
+    def recording(params_, tokens, positions, row_of, pages, *a, **kw):
+        shapes_seen.add(
+            (int(a[3].shape[0]), int(tokens.shape[0]), int(pages.shape[0]))
+        )
+        return real(params_, tokens, positions, row_of, pages, *a, **kw)
 
-    monkeypatch.setattr(engine_mod, "verify_step_batched", recording)
+    monkeypatch.setattr(engine_mod, "verify_step_ragged", recording)
 
     async def drive():
         h = _harness(conn, params, "engine-buckets")
@@ -261,13 +264,22 @@ def test_wave_sizes_bucket_to_powers_of_two(conn, params, monkeypatch):
     assert m["all_verified"], "padding rows corrupted a request's blocks"
     assert m["generated_tokens"] == 5 * 6
     assert shapes_seen, "no waves decoded"
-    for b, k in shapes_seen:
-        assert b & (b - 1) == 0, f"non-power-of-two wave batch {b}"
-        assert k & (k - 1) == 0, f"non-power-of-two chunk width {k}"
+    for b, t, p in shapes_seen:
+        assert b & (b - 1) == 0, f"non-power-of-two table-row bucket {b}"
+        assert t & (t - 1) == 0, f"non-power-of-two flat-row bucket {t}"
+        assert p & (p - 1) == 0, f"non-power-of-two page bucket {p}"
     # Compile count is bounded by the bucket ladder, not by how many
-    # distinct natural sizes occurred.
+    # distinct natural sizes occurred. The (B, T, P) ladder is wider than
+    # the old (B, K) one (P steps through pow2s as contexts lengthen), but
+    # it must stay a LADDER — a change that buckets exactly instead of to
+    # powers of two would proliferate shapes (= whole-model recompiles)
+    # far past this cap.
     assert shapes_seen == set(m["wave_buckets"])
-    assert len(shapes_seen) <= 4
+    assert len(shapes_seen) <= 8, sorted(shapes_seen)
+    # Pure-decode waves: every chunk is one token, so ragged assembly pads
+    # at most T_bucket - B rows per wave — strictly no more than the old
+    # rectangle's (B_bucket - B) duplicated rows at K = 1.
+    assert 0.0 <= m["wave_pad_fraction"] < 0.5, m["wave_pad_fraction"]
 
 
 def test_ngram_drafter_proposes_recurring_continuations():
@@ -328,7 +340,8 @@ def test_speculative_generation_matches_greedy_exactly(conn, params):
 
 def test_mixed_spec_and_decode_requests_share_waves(conn, params):
     """A drafting request and a plain-decode request coalesce into the SAME
-    wave (chunks of different lengths pad to one (B, K) launch) and both
+    wave (chunks of different lengths CONCATENATE into one ragged launch —
+    the decode rows no longer pad to the draft chunk's width) and both
     verify against the oracle."""
     from infinistore_tpu.engine import NGramDrafter
 
@@ -348,8 +361,85 @@ def test_mixed_spec_and_decode_requests_share_waves(conn, params):
     assert m["all_verified"]
     assert m["generated_tokens"] == 3 * CFG.block_tokens
     assert m["max_wave_size"] >= 2, "requests never shared a wave"
-    # At least one wave carried a chunk wider than 1 (the drafting row).
-    assert any(k > 1 for _, k in m["wave_buckets"]), m["wave_buckets"]
+    # At least one wave carried a chunk wider than 1 (the drafting row):
+    # its flat-row bucket exceeds its table-row bucket.
+    assert any(t > b for b, t, _ in m["wave_buckets"]), m["wave_buckets"]
+
+
+def test_ragged_wave_byte_identical_to_sequential_decode(params):
+    """THE ragged-assembly determinism pin: a MIXED wave (two 1-token
+    decode rows beside a 3-token verification chunk, concatenated ragged —
+    no row duplication) must produce logits AND cache bytes IDENTICAL to
+    advancing each request alone, one wave of one request at a time. This
+    is the guarantee that lets the scheduler coalesce whatever happens to
+    be ready without ever changing a request's output."""
+    from infinistore_tpu.engine import ContinuousBatchingHarness, WaveDecoder
+    from infinistore_tpu.models import prefill
+
+    rng = np.random.default_rng(61)
+    tables = np.array(
+        [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]], np.int32
+    )
+    prompts = [
+        rng.integers(0, CFG.vocab, size=16).tolist() for _ in range(3)
+    ]
+    base = CFG.kv_spec(NUM_BLOCKS).make_caches()
+    for p, tab in zip(prompts, tables):
+        _, base = prefill(
+            params, jnp.asarray(p, jnp.int32), base, jnp.asarray(tab[:2]), CFG
+        )
+
+    def mk():
+        h = ContinuousBatchingHarness.__new__(ContinuousBatchingHarness)
+        h.params = params
+        h.config = CFG
+        h.caches = base
+        h.max_req_blocks = MAX_REQ_BLOCKS
+        h.gate = DeviceGate()
+        return h
+
+    # Request 1 verifies a 3-token chunk; 0 and 2 decode one token each.
+    chunks = [([5], [16]), ([9, 11, 12], [16, 17, 18]), ([13], [16])]
+
+    async def wave_run():
+        h = mk()
+        wave = WaveDecoder(h)
+        outs = await asyncio.gather(*(
+            wave.step_chunk(toks, pos, jnp.asarray(tables[b]))
+            for b, (toks, pos) in enumerate(chunks)
+        ))
+        return [np.asarray(o) for o in outs], h.caches, wave
+
+    async def seq_run():
+        h = mk()
+        outs = []
+        for b, (toks, pos) in enumerate(chunks):
+            wave = WaveDecoder(h)  # fresh decoder: every wave is solo
+            outs.append(
+                np.asarray(
+                    await wave.step_chunk(toks, pos, jnp.asarray(tables[b]))
+                )
+            )
+        return outs, h.caches
+
+    wave_outs, wave_caches, wave = asyncio.run(wave_run())
+    seq_outs, seq_caches = asyncio.run(seq_run())
+    assert wave.max_wave == 3, "requests did not coalesce into one wave"
+    for b in range(3):
+        np.testing.assert_array_equal(
+            wave_outs[b], seq_outs[b],
+            err_msg=f"request {b} logits diverged in the mixed wave",
+        )
+    for layer in range(CFG.n_layers):
+        for kind in (0, 1):
+            np.testing.assert_array_equal(
+                np.asarray(wave_caches[layer][kind]),
+                np.asarray(seq_caches[layer][kind]),
+                err_msg=f"cache bytes diverged (layer {layer})",
+            )
+    # Ragged pad accounting: 5 real flat rows bucket to 8 (3 pad rows) —
+    # the rectangle would have launched 4 requests x 4-token chunks = 16.
+    assert (wave.launched_rows, wave.pad_rows) == (8, 3)
 
 
 def test_wave_decoder_failure_fails_all_waiters(params):

@@ -207,6 +207,262 @@ def test_zero_length_row_returns_zeros_both_backends():
         assert np.abs(np.asarray(out[1], np.float64)).max() > 0
 
 
+def _ragged_meta(tables, seq_lens, bt, pad_to=0):
+    from infinistore_tpu.tpu.paged_attention import build_ragged_wave
+
+    m = build_ragged_wave(tables, seq_lens, bt, pad_to=pad_to)
+    return (
+        jnp.asarray(m.pages), jnp.asarray(m.page_rows),
+        jnp.asarray(m.page_starts), jnp.asarray(m.seq_lens),
+    )
+
+
+@pytest.mark.parametrize("case", CASES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ragged_kernel_matches_oracle(case, dtype):
+    """The ragged kernel (flat page list, interpret mode) against the numpy
+    oracle across GQA shapes, dtypes, and wave sizes 1/3/8 with skewed
+    seq_lens — including a seq_len=1 row next to a near-max one (the 8:1
+    length-skew shape the rectangular layout padded B * max(K_i) for)."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas_ragged,
+        paged_decode_attention_ragged,
+    )
+
+    n, bt, kvh, d, h, ntbl = case
+    rng = np.random.default_rng(hash(("ragged", case)) % 2**32)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), dtype)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), dtype)
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    full = ntbl * bt
+    waves = {
+        1: [full],
+        3: [1, full, full // 2 + 1],  # seq_len=1 beside a near-max row
+        8: [1, full, 3, full - 1, bt, bt - 1, full // 2, 2],
+    }
+    for bsz, lens in waves.items():
+        q = jnp.asarray(rng.standard_normal((bsz, h, d)), dtype)
+        tables = [rng.permutation(n)[:ntbl] for _ in range(bsz)]
+        meta = _ragged_meta(tables, lens, bt)
+        got = _paged_decode_attention_pallas_ragged(
+            q, k_cache, v_cache, *meta, interpret=True
+        )
+        for b in range(bsz):
+            want = _numpy_oracle(q[b], k_cache, v_cache, tables[b], lens[b])
+            np.testing.assert_allclose(
+                np.asarray(got[b], np.float64), want, rtol=tol, atol=tol,
+                err_msg=f"wave={bsz} row={b} len={lens[b]}",
+            )
+        # The public dispatcher (XLA fallback on this backend) agrees.
+        got_disp = paged_decode_attention_ragged(
+            q, k_cache, v_cache, *meta, table_width=ntbl
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_disp, np.float64), np.asarray(got, np.float64),
+            rtol=tol, atol=tol,
+        )
+
+
+def test_ragged_single_request_degenerates_to_batched():
+    """A single-request wave through the ragged kernel is BITWISE the
+    rectangular kernel's output (same fold sequence, so today's B=1 decode
+    path is a strict special case of the ragged one)."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas_batched,
+        _paged_decode_attention_pallas_ragged,
+    )
+
+    n, bt, kvh, d, h, ntbl = 16, 8, 2, 16, 4, 6
+    rng = np.random.default_rng(41)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((1, h, d)), jnp.float32)
+    table = rng.permutation(n)[:ntbl]
+    for sl in (1, bt, ntbl * bt):
+        meta = _ragged_meta([table], [sl], bt)
+        rect = _paged_decode_attention_pallas_batched(
+            q, k_cache, v_cache, jnp.asarray(table[None], jnp.int32),
+            jnp.asarray([sl], jnp.int32), interpret=True,
+        )
+        rag = _paged_decode_attention_pallas_ragged(
+            q, k_cache, v_cache, *meta, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(rect), np.asarray(rag))
+
+
+def test_ragged_padding_pages_are_bitwise_noops():
+    """Bucket-padding the flat page list (what the engine does to bound jit
+    compiles) must not change one output bit: padded pages fold fully
+    masked — alpha = 1, p = 0 (see _attn_block_fold)."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas_ragged,
+    )
+
+    n, bt, kvh, d, h = 16, 8, 2, 16, 4
+    rng = np.random.default_rng(43)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((3, h, d)), jnp.float32)
+    tables = [rng.permutation(n)[:4] for _ in range(3)]
+    lens = [9, 30, 17]
+    exact = _paged_decode_attention_pallas_ragged(
+        q, k_cache, v_cache, *_ragged_meta(tables, lens, bt), interpret=True
+    )
+    padded = _paged_decode_attention_pallas_ragged(
+        q, k_cache, v_cache, *_ragged_meta(tables, lens, bt, pad_to=16),
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(exact), np.asarray(padded))
+
+
+def test_ragged_zero_length_row_returns_zeros():
+    """A zero-length row carries one fully-masked page and must read as
+    zeros on both backends — same contract as the rectangular layout."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _paged_decode_attention_pallas_ragged,
+        paged_decode_attention_ragged,
+    )
+
+    n, bt, kvh, d, h = 8, 8, 2, 16, 4
+    rng = np.random.default_rng(47)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((2, h, d)), jnp.float32)
+    meta = _ragged_meta([[0, 1], [2, 3]], [0, 5], bt)
+    for out in (
+        _paged_decode_attention_pallas_ragged(
+            q, k_cache, v_cache, *meta, interpret=True
+        ),
+        paged_decode_attention_ragged(
+            q, k_cache, v_cache, *meta, table_width=2
+        ),
+    ):
+        row0 = np.asarray(out[0], np.float64)
+        assert np.array_equal(row0, np.zeros_like(row0))
+        assert np.isfinite(np.asarray(out, np.float64)).all()
+        assert np.abs(np.asarray(out[1], np.float64)).max() > 0
+
+
+def test_ragged_stats_kernel_matches_xla_stats():
+    """The ragged stats kernel (interpret mode) and the reconstructed-table
+    XLA stats normalize identically — the combinability contract ragged
+    sharded decode rides."""
+    from infinistore_tpu.tpu.paged_attention import (
+        _decode_attention_stats_xla,
+        _paged_decode_attention_pallas_ragged_stats,
+        _ragged_row_tables,
+    )
+
+    n, bt, kvh, d, h = 16, 8, 2, 16, 4
+    rng = np.random.default_rng(53)
+    k_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((n, bt, kvh, d)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((3, h, d)), jnp.float32)
+    tables = [rng.permutation(n)[:4] for _ in range(3)]
+    lens = [1, 4 * bt, 0]
+    pages, rows, starts, sls = _ragged_meta(tables, lens, bt)
+    a1, m1, l1 = _paged_decode_attention_pallas_ragged_stats(
+        q, k_cache, v_cache, pages, rows, starts, sls, interpret=True
+    )
+    rect = _ragged_row_tables(pages, starts, 4)
+    a2, m2, l2 = _decode_attention_stats_xla(q, k_cache, v_cache, rect, sls)
+    for b in range(3):
+        if float(l2[b].max()) == 0.0:
+            assert float(l1[b].max()) == 0.0
+            assert float(jnp.abs(a1[b]).max()) == 0.0
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a1[b] / l1[b]), np.asarray(a2[b] / l2[b]),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+def test_ragged_sharded_wave_matches_dense_oracle():
+    """A ragged WAVE with contexts sharded over the 8-way 'sp' mesh: per-
+    shard ragged stats combined with pmax/psum must equal dense attention
+    over each row's concatenated context — including rows absent from some
+    shards entirely (local_len 0)."""
+    from jax.sharding import Mesh
+
+    from infinistore_tpu.tpu.paged_attention import (
+        build_ragged_wave_sharded,
+        paged_decode_attention_ragged_sharded,
+    )
+
+    P_, nb_local, bt, kvh, d, h, R = 8, 4, 4, 2, 16, 4, 3
+    rng = np.random.default_rng(59)
+    k_cache = jnp.asarray(
+        rng.standard_normal((P_ * nb_local, bt, kvh, d)), jnp.float32
+    )
+    v_cache = jnp.asarray(
+        rng.standard_normal((P_ * nb_local, bt, kvh, d)), jnp.float32
+    )
+    q = jnp.asarray(rng.standard_normal((R, h, d)), jnp.float32)
+    local_tables = [
+        [rng.permutation(nb_local)[:3] for _ in range(R)] for _ in range(P_)
+    ]
+    local_lens = rng.integers(0, 3 * bt + 1, size=(P_, R)).astype(np.int32)
+    local_lens[0, 0] = max(local_lens[0, 0], 1)
+    local_lens[:, 2] = 0
+    local_lens[4, 2] = 7  # row 2 lives on exactly one shard
+
+    pages, rows, starts, lens, width = build_ragged_wave_sharded(
+        local_tables, local_lens, bt
+    )
+    devices = jax.devices()
+    assert len(devices) == 8
+    mesh = Mesh(np.array(devices), ("sp",))
+    got = paged_decode_attention_ragged_sharded(
+        q, k_cache, v_cache, pages, rows, starts, lens,
+        mesh=mesh, table_width=width,
+    )
+    groups = h // kvh
+    for r in range(R):
+        ks, vs = [], []
+        for p in range(P_):
+            rowsg = p * nb_local + np.asarray(local_tables[p][r])
+            ks.append(
+                np.asarray(k_cache)[rowsg].reshape(-1, kvh, d)[: local_lens[p][r]]
+            )
+            vs.append(
+                np.asarray(v_cache)[rowsg].reshape(-1, kvh, d)[: local_lens[p][r]]
+            )
+        k_all = np.concatenate(ks)
+        v_all = np.concatenate(vs)
+        k_rep = np.repeat(k_all, groups, axis=1).astype(np.float64)
+        v_rep = np.repeat(v_all, groups, axis=1).astype(np.float64)
+        logits = np.einsum(
+            "hd,thd->ht", np.asarray(q[r], np.float64), k_rep
+        ) / np.sqrt(d)
+        p_ = np.exp(logits - logits.max(axis=1, keepdims=True))
+        p_ /= p_.sum(axis=1, keepdims=True)
+        want = np.einsum("ht,thd->hd", p_, v_rep)
+        np.testing.assert_allclose(
+            np.asarray(got[r], np.float64), want, rtol=1e-5, atol=1e-5,
+            err_msg=f"row {r}",
+        )
+
+
+def test_build_ragged_wave_validates():
+    """The metadata builder rejects short tables, undersized pad_to, and
+    empty waves; pads belong to the last row with the sentinel terminating
+    the map."""
+    from infinistore_tpu.tpu.paged_attention import build_ragged_wave
+
+    with pytest.raises(ValueError):
+        build_ragged_wave([], [], 8)
+    with pytest.raises(ValueError):
+        build_ragged_wave([[0]], [9], 8)  # needs 2 pages for len 9
+    with pytest.raises(ValueError):
+        build_ragged_wave([[0, 1], [2]], [16, 3], 8, pad_to=2)
+    m = build_ragged_wave([[0, 1], [2]], [16, 3], 8, pad_to=8)
+    assert m.num_pages == 8 and m.pad_pages == 5
+    assert list(m.page_rows[:3]) == [0, 0, 1]
+    assert all(r == 1 for r in m.page_rows[3:8])  # padding rides row 1
+    assert m.page_rows[8] == 2  # sentinel
+    assert list(m.page_starts) == [0, 2]
+
+
 def test_sharded_decode_matches_dense_oracle():
     """Context sharded over an 8-way 'sp' mesh: shard-local online-softmax
     stats combined with pmax/psum must equal dense attention over the

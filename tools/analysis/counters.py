@@ -65,6 +65,7 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/lib.py", "StripedConnection.completion_stats"),
     ("infinistore_tpu/cluster.py", "_MemberHealth.as_dict"),
     ("infinistore_tpu/cluster.py", "ClusterKVConnector.health"),
+    ("infinistore_tpu/engine.py", "ContinuousBatchingHarness.metrics"),
     ("infinistore_tpu/membership.py", "Membership.status"),
     ("infinistore_tpu/membership.py", "Resharder.progress"),
 ]
