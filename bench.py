@@ -2177,6 +2177,283 @@ def _membership_churn_metrics(its, np) -> dict:
             s.stop()
 
 
+def _recovery_metrics(its, np) -> dict:
+    """Crash-safe fleet coordination receipt (the ROADMAP-3 gate,
+    docs/membership.md): durable catalog + reshard journal, gossip epoch
+    exchange, cold-client bootstrap — over REAL subprocesses.
+
+    Flow (tools/fleet.py harness; every member is its own process):
+
+    1. 3 store servers + 1 joiner store; client A (owns the roots +
+       durable journal, gossip-peered with B), client B (no catalog,
+       gossip-peered with A). A saves 24 deterministic seeded roots.
+    2. POST /membership add(joiner) to **A only** — the reshard starts,
+       and A ``kill -9``s ITSELF after exactly 3 migrated roots land
+       (``faults.crash_process`` via the fleet client's
+       crash-after-moved hook): a deterministic mid-reshard crash.
+    3. A restarts WITH THE SAME ARGV: the journal replay recovers the
+       catalog (24 roots, holder levels intact) and the open reshard
+       plan; the resharder RESUMES from the journaled debt — gated:
+       settles with 0 debt, and crash_moved + resumed_moved equals the
+       independently computed rendezvous delta (resume, not re-copy).
+    4. B converges to the settled epoch + 4-member view via GOSSIP ALONE
+       (nothing was ever POSTed to B); propagation and settle times are
+       reported (wall-clock color, not gated — the binary convergence
+       flag is the gate).
+    5. A COLD client C bootstraps from A's ``GET /bootstrap`` (seed list
+       only), then sweep-reads every root and byte-compares against the
+       regenerated contents — gated 0 wrong / 0 misses.
+    6. Journal write-path overhead, in-process: save sweeps with the
+       durable journal on vs off, order-alternating PAIRED rounds,
+       min(median-of-ratios, ratio-of-sums) — the weather rule — gated
+       <= 10%.
+    """
+    import asyncio
+    import shutil
+    import tempfile
+
+    from tools import fleet
+    from infinistore_tpu.cluster import rendezvous_ranked
+    from infinistore_tpu.connector import token_chain_hashes
+    from infinistore_tpu import fleet_client as fc
+
+    spec = fc._spec()
+    n_roots, crash_after = 24, 3
+    seed = 23
+    out = {}
+    tmp = tempfile.mkdtemp(prefix="its-recovery-")
+    stores = fleet.spawn_fleet_servers(3)
+    joiner = fleet.spawn_fleet_servers(1)[0]
+    store_addrs = [f"127.0.0.1:{m['service_port']}" for m in stores]
+    pa, pb = fleet.free_port(), fleet.free_port()
+    A = fleet.spawn_fleet_client(
+        manage_port=pa, stores=store_addrs, journal=f"{tmp}/a.journal",
+        peers=[f"127.0.0.1:{pb}"], seed=seed, roots=n_roots,
+        crash_after_moved=crash_after, gossip_interval_s=0.1,
+        wait_ready=False,
+    )
+    B = fleet.spawn_fleet_client(
+        manage_port=pb, stores=store_addrs, journal=f"{tmp}/b.journal",
+        peers=[f"127.0.0.1:{pa}"], seed=seed, roots=0,
+        gossip_interval_s=0.1, wait_ready=False,
+    )
+    clients = [A, B]  # every spawned client, incl. the late verify one
+    try:
+        fleet.wait_manage(
+            pa, "/membership", 120, proc=A["proc"],
+            predicate=lambda d: d.get("reshard_catalog_roots", 0) >= n_roots,
+        )
+        fleet.wait_manage(pb, "/membership", 60, proc=B["proc"])
+        eb0 = fleet.manage_json(pb, "/membership")["membership_epoch"]
+
+        # The independently computed rendezvous delta: roots whose top-R
+        # set over the new placement gains the joiner (same seeded
+        # prompts the fleet client generates).
+        joiner_id = f"127.0.0.1:{joiner['service_port']}"
+        place = store_addrs + [joiner_id]
+        delta_roots = 0
+        for p in fc._prompts(spec, seed, n_roots):
+            root = token_chain_hashes(p, spec.block_tokens)[0]
+            top = [place[k] for k in rendezvous_ranked(place, root)[:2]]
+            delta_roots += joiner_id in top
+
+        # Background watcher: when does B first SEE the epoch move, and
+        # when does it settle on the final 4-member view — via gossip
+        # alone (nothing is ever POSTed to B).
+        import threading as _threading
+        b_times = {"propagate": -1.0, "settle": -1.0}
+        t_add_box = {}
+
+        def watch_b():
+            while "t" not in t_add_box:
+                time.sleep(0.005)
+            t_add = t_add_box["t"]
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                try:
+                    d = fleet.manage_json(pb, "/membership", timeout_s=1.0)
+                except (OSError, ValueError):
+                    time.sleep(0.025)
+                    continue
+                now = time.time()
+                if b_times["propagate"] < 0 and d.get("membership_epoch", 0) > eb0:
+                    b_times["propagate"] = now - t_add
+                if (
+                    d.get("membership_epoch", 0) > eb0
+                    and d.get("membership_settled") == 1
+                    and d.get("membership_members", 0) == len(place)
+                ):
+                    b_times["settle"] = now - t_add
+                    return
+                time.sleep(0.025)
+
+        watcher = _threading.Thread(target=watch_b, daemon=True)
+        watcher.start()
+        t_add_box["t"] = time.time()
+        resp = fleet.manage_post_json(pa, "/membership", {
+            "action": "add", "host": "127.0.0.1",
+            "service_port": joiner["service_port"],
+        })
+        if resp.get("status") != "ok":
+            raise RuntimeError(f"add failed: {resp}")
+
+        # The scripted kill -9 lands after exactly `crash_after` migrated
+        # roots; then restart with the SAME argv.
+        crash_rc = fleet.wait_member_exit(A, timeout_s=90)
+        fleet.restart_member(A, timeout_s=120)
+        doc = fleet.wait_manage(
+            pa, "/membership", 120, proc=A["proc"],
+            predicate=lambda d: (
+                d.get("membership_settled") == 1
+                and d.get("reshard_debt_roots") == 0
+                and d.get("reshard_active") == 0
+            ),
+        )
+        events = fleet.manage_json(pa, "/events")["events"]
+        restart_ev = next(
+            (e for e in events if e["kind"] == "client_restart"), None
+        )
+        watcher.join(timeout=120)
+
+        # Cold bootstrap + byte-verify sweep (a fresh process, seed list
+        # only — the verify report is its stdout JSON line).
+        C = fleet.spawn_fleet_client(
+            peers=[f"127.0.0.1:{pa}"], seed=seed, roots=n_roots,
+            bootstrap=True, verify=True, wait_ready=False, capture=True,
+        )
+        clients.append(C)
+        report_raw, _ = C["proc"].communicate(timeout=240)
+        report = json.loads(report_raw.decode().strip().splitlines()[-1])
+
+        resumed = int(doc["reshard_moved_roots"])
+        out.update({
+            "recovery_roots": n_roots,
+            "recovery_crash_rc": crash_rc,
+            "recovery_crash_moved_roots": crash_after,
+            "recovery_resumed_moved_roots": resumed,
+            "recovery_moved_total": crash_after + resumed,
+            "recovery_delta_roots": delta_roots,
+            "recovery_debt": int(doc["reshard_debt_roots"]),
+            "recovery_epoch": int(doc["membership_epoch"]),
+            "recovery_converged": int(
+                doc["membership_settled"] == 1
+                and doc["reshard_debt_roots"] == 0
+            ),
+            "recovery_replayed_roots": (
+                int(restart_ev["attrs"]["recovered_roots"])
+                if restart_ev else 0
+            ),
+            "recovery_replay_torn": (
+                int(restart_ev["attrs"]["replay_torn"]) if restart_ev else -1
+            ),
+            "recovery_resume_flag": (
+                int(bool(restart_ev["attrs"]["resume_reshard"]))
+                if restart_ev else 0
+            ),
+            "recovery_gossip_converged": int(b_times["settle"] > 0),
+            "recovery_gossip_propagate_s": round(b_times["propagate"], 3),
+            "recovery_gossip_settle_s": round(b_times["settle"], 3),
+            "recovery_reads": int(report["reads"]),
+            "recovery_wrong_reads": int(report["wrong"]),
+            "recovery_misses": int(report["misses"]),
+            "recovery_bootstrap_members": int(report["members"]),
+            "recovery_bootstrap_catalog_roots": int(report["catalog_roots"]),
+        })
+    finally:
+        fleet.stop_members(clients + stores + [joiner])
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- part 6: journal write-path overhead (paired, weather rule) --------
+    import jax
+
+    jnp = jax.numpy
+    srv = its.start_local_server(prealloc_bytes=64 << 20, block_bytes=16 << 10)
+
+    def connect():
+        c = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=srv.port, log_level="error",
+            connect_timeout_ms=500, op_timeout_ms=2000,
+        ))
+        c.connect()
+        return c
+
+    from infinistore_tpu.cluster import ClusterKVConnector
+
+    tmp2 = tempfile.mkdtemp(prefix="its-journal-ovh-")
+    conns = [connect(), connect()]
+    clusters = {
+        True: ClusterKVConnector(
+            [conns[0]], spec, "jovh", max_blocks=8,
+            member_ids=[f"127.0.0.1:{srv.port}"],
+            journal_path=f"{tmp2}/ovh.journal",
+        ),
+        False: ClusterKVConnector(
+            [conns[1]], spec, "jovh-off", max_blocks=8,
+            member_ids=[f"127.0.0.1:{srv.port}"],
+        ),
+    }
+    try:
+        prompts = fc._prompts(spec, 7, 16)
+        caches = [fc._mk_caches(spec, i) for i in range(16)]
+        src = np.array([3, 9], np.int32)
+
+        def sweep(journaled: bool) -> float:
+            cl = clusters[journaled]
+
+            async def go() -> float:
+                t0 = time.perf_counter()
+                for i, p in enumerate(prompts):
+                    await cl.save(p, caches[i], src)
+                return time.perf_counter() - t0
+
+            return asyncio.run(go())
+
+        for j in (True, False):
+            sweep(j)  # warm both paths (pools, key caches, journal file)
+        sums = {True: 0.0, False: 0.0}
+        ratios = []
+        flip = [0]
+
+        def pair():
+            flip[0] ^= 1
+            sample = {}
+            for j in ((True, False) if flip[0] else (False, True)):
+                sample[j] = sweep(j)
+            for j in (True, False):
+                sums[j] += sample[j]
+            ratios.append(sample[True] / sample[False])
+
+        def estimate() -> float:
+            med = sorted(ratios)[len(ratios) // 2]
+            return max(0.0, min(med, sums[True] / sums[False]) - 1.0)
+
+        # Measured floor: ~0.5% (16 appends ~1.5us each + ~1 bounded fsync
+        # ~0.1ms per ~50ms sweep); readings above that are host weather,
+        # so the noise guard keeps pairing until the estimate drops under
+        # 4% or the budget runs out (gate at 10% in bench_check).
+        for _ in range(8):
+            pair()
+        for _ in range(10):  # bounded noise guard
+            if estimate() <= 0.04:
+                break
+            pair()
+        out["recovery_journal_overhead_cost"] = round(estimate(), 4)
+        out["recovery_journal_bytes"] = clusters[True].membership_status()[
+            "journal_bytes"
+        ]
+    finally:
+        for cl in clusters.values():
+            cl.close()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        srv.stop()
+        shutil.rmtree(tmp2, ignore_errors=True)
+    return out
+
+
 def _run_check(files) -> int:
     """`bench.py --check RECEIPT.json [...]`: run the data-plane regression
     gate (tools/bench_check.py) over existing receipts instead of measuring.
@@ -2240,6 +2517,7 @@ def main(argv=None) -> int:
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
     churn = _membership_churn_metrics(its, np)
+    recovery = _recovery_metrics(its, np)
     try:
         tpu = _tpu_connector_gbps(its, np, conn)
         import jax
@@ -2442,6 +2720,15 @@ def main(argv=None) -> int:
         "churn_bg_moved_bytes": churn["churn_bg_moved_bytes"],
         "churn_pruned_keys": churn["churn_pruned_keys"],
         "churn_lost_roots": churn["churn_lost_roots"],
+        # Crash-safe fleet coordination (ROADMAP-3, docs/membership.md):
+        # a client subprocess kill -9'd mid-reshard resumes from its
+        # durable journal and converges (0 debt, moved == rendezvous
+        # delta), the epoch propagates to a second process via gossip
+        # alone (convergence time reported), and a cold bootstrap client
+        # byte-verifies every root (0 wrong / 0 misses). The journal's
+        # save-path overhead is paired-interleaved gated <= 10%. All in
+        # tools/bench_check.py.
+        **recovery,
         "tpu_backend": backend,
     }
     if tpu is not None:

@@ -71,8 +71,9 @@ from .lib import (
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
     InfiniStoreResourcePressure,
+    Logger,
 )
-from .membership import MemberState, Membership, Resharder, _RootTask
+from .membership import DurableLog, MemberState, Membership, Resharder, _RootTask
 from .tpu.layerwise import PartialReadError
 from .tpu.paged import PagedKVCacheSpec
 
@@ -282,6 +283,74 @@ class _RootRecord:
     holders: Dict[str, int] = field(default_factory=dict)
 
 
+class _DeadConn:
+    """Inert connection placeholder for a member whose id the dial
+    factory cannot resolve (or that appeared between a gossip merge's
+    plan and apply, where dialing is not allowed): every touch raises the
+    typed transport error, so ops feed the breaker and the member reads
+    as down — the state it is in."""
+
+    is_connected = False
+
+    def __init__(self, member_id: str):
+        self.member_id = member_id
+
+    def reconnect(self):
+        raise InfiniStoreException(
+            f"member {self.member_id}: no dialable connection"
+        )
+
+    def close(self):
+        pass
+
+
+class _LazyMember:
+    """Member connector built on FIRST USE over a connection the cluster
+    dialed itself (journal-replay restore, gossip merge, cold bootstrap).
+
+    A restored/gossiped member's store may be down at dial time; eagerly
+    running ``member_factory`` would fail the whole recovery on the one
+    member the breaker machinery exists to tolerate. Instead the wrapper
+    holds (conn, factory) and materializes lazily: an op against a
+    still-unconnected member raises a typed transport error — which feeds
+    that member's breaker exactly like a dead node — and the breaker's
+    half-open probe heals the connection (``_probe_heal``), after which
+    the next op materializes the real connector. Terminal (DEAD/REMOVED)
+    tombstone entries never route ops, so their wrapper never
+    materializes at all."""
+
+    def __init__(self, member_id: str, conn, factory):
+        self.member_id = member_id
+        self.conn = conn
+        self._factory = factory
+        self._m = None
+
+    @property
+    def QOS_AWARE(self):
+        """Answer from the REAL member once built; before that, False —
+        the router then drops the priority tag for that one op instead of
+        guessing True and TypeError-ing a pre-QoS member factory's
+        connector (the gate's contract: 'drops the tag, never
+        TypeErrors'). This check must never raise or block."""
+        m = self._m
+        return getattr(m, "QOS_AWARE", False) if m is not None else False
+
+    def _materialize(self):
+        m = self._m
+        if m is None:
+            if not getattr(self.conn, "is_connected", True):
+                # Typed transport error, no blocking reconnect here — the
+                # breaker's probe path owns the (blocking, off-loop) heal.
+                raise InfiniStoreException(
+                    f"member {self.member_id} not connected yet (lazy)"
+                )
+            m = self._m = self._factory(self.conn)
+        return m
+
+    def __getattr__(self, name):
+        return getattr(self._materialize(), name)
+
+
 class ClusterKVConnector:
     """``KVConnector`` surface over N servers with prefix-affine routing,
     per-member circuit breakers, optional R-way rendezvous replication,
@@ -326,6 +395,9 @@ class ClusterKVConnector:
         member_factory=None,
         replicas: int = 1,
         breaker_factory=None,
+        journal_path: Optional[str] = None,
+        dial_factory=None,
+        fsync_interval_s: float = 0.05,
     ):
         """``member_factory(conn) -> KVConnector-shaped``: what each member
         runs over its connection — defaults to a plain ``KVConnector``; pass
@@ -341,7 +413,27 @@ class ClusterKVConnector:
         ``breaker_factory(member_index) -> CircuitBreaker``: per-member
         breaker construction (tunables, injected clocks in tests). The
         default seeds each member's jitter differently so probes
-        decorrelate."""
+        decorrelate.
+
+        ``journal_path``: enable the CRASH-SAFE durable catalog + reshard
+        journal (docs/membership.md, durability section). The root
+        catalog, membership view and reshard plan/progress are journaled
+        to a write-ahead ``DurableLog`` at this path; on construction an
+        existing journal is REPLAYED — the restarted client recovers its
+        catalog (holder block-levels intact), the epoch-stamped view
+        (tombstones intact), and any in-flight reshard, which it resumes
+        from the journaled debt instead of replanning from zero. Members
+        recorded in the journal but absent from ``conns`` are re-dialed
+        via ``dial_factory``.
+
+        ``dial_factory(member_id, connect=True) -> connection``: how the
+        cluster dials a member it learned about from the journal, a
+        gossip merge, or a bootstrap snapshot. The default parses
+        ``host:port`` from the member id and builds an auto-reconnecting
+        ``InfinityConnection`` (connect is best-effort — a down member
+        materializes later through its breaker's probe heal).
+
+        ``fsync_interval_s``: the journal's bounded-fsync interval."""
         if not conns:
             raise ValueError("cluster needs at least one connection")
         if member_ids is None:
@@ -400,6 +492,20 @@ class ClusterKVConnector:
         # on one native connection). Held only for the O(1) state update —
         # never across a heal/reconnect.
         self._breaker_lock = threading.Lock()
+        # Crash-safe coordination plane (docs/membership.md): the durable
+        # catalog + reshard journal, connections this cluster dialed itself
+        # (journal restore / gossip merge / bootstrap — closed with us),
+        # and the replay summary (None when no journal or a fresh one).
+        self._dial_factory = dial_factory or self._default_dial
+        self._owned_dials: List = []
+        self._journal_log: Optional[DurableLog] = None
+        self.recovered: Optional[dict] = None
+        self.membership.on_change = self._on_view_change
+        if journal_path:
+            self._journal_log = DurableLog(
+                journal_path, fsync_interval_s=fsync_interval_s
+            )
+            self._replay_journal()
 
     # -- routing -------------------------------------------------------------
 
@@ -578,9 +684,442 @@ class ClusterKVConnector:
         return view
 
     def close(self):
-        """Stop the background resharder (member connections stay the
-        caller's to close)."""
+        """Stop the background resharder, close the durable journal, and
+        close the connections this cluster dialed ITSELF (journal restore
+        / gossip merge / bootstrap); caller-provided connections stay the
+        caller's to close."""
         self.resharder.stop()
+        if self._journal_log is not None:
+            self._journal_log.close()
+        for conn in self._owned_dials:
+            try:
+                conn.close()
+            except Exception:
+                pass
+        self._owned_dials = []
+
+    # -- durable journal (crash-safe catalog + reshard state) ------------------
+
+    @staticmethod
+    def _default_dial(member_id: str, connect: bool = True):
+        """Dial a member by its ``host:port`` id (the id convention the
+        constructor defaults to). Connect is best-effort: a member that is
+        down right now still gets a connection OBJECT — its breaker opens
+        on first use and the half-open probe's ``reconnect()`` heals it
+        when the store returns."""
+        from .config import ClientConfig
+        from .lib import InfinityConnection
+
+        host, _, port = member_id.rpartition(":")
+        conn = InfinityConnection(ClientConfig(
+            host_addr=host or "127.0.0.1", service_port=int(port),
+            log_level="error", auto_reconnect=True,
+            connect_timeout_ms=1000, op_timeout_ms=5000,
+        ))
+        if connect:
+            try:
+                conn.connect()
+            # Audited: best-effort dial of a journaled/gossiped member —
+            # the member enters service behind its OPEN breaker and the
+            # probe heal (_probe_heal -> reconnect) owns recovery; nothing
+            # is swallowed policy-wise (every op outcome still routes
+            # through _done).
+            except InfiniStoreException:  # its: allow[ITS-P001]
+                pass
+        return conn
+
+    def _dial_member(self, member_id: str, state: str):
+        """A ``_LazyMember`` over a self-dialed connection (readable states
+        get a connect attempt; tombstones just get the object)."""
+        conn = self._dial_factory(member_id, state in MemberState.READABLE)
+        self._owned_dials.append(conn)
+        return _LazyMember(member_id, conn, self._member_factory)
+
+    def _journal_append(self, record: dict, fsync: bool = False):
+        log = self._journal_log
+        if log is not None:
+            log.append(record, fsync=fsync)
+
+    def _on_view_change(self, view):
+        """Membership ``on_change`` hook: journal every epoch change (the
+        view record carries states, since-epochs, the fallback placement
+        and transition ownership — everything ``restore`` needs). Replay
+        keeps the record with the HIGHEST epoch, so two transitions
+        journaling out of order can never roll the view back."""
+        m = self.membership
+        self._journal_append({
+            "k": "view",
+            "epoch": view.epoch,
+            "members": [
+                [mid, st, int(se)] for mid, st, se in zip(
+                    view.member_ids, view.states,
+                    view.since or (0,) * len(view.member_ids),
+                )
+            ],
+            "prev": list(m.prev_placement) if m.prev_placement else None,
+            "owner": m.owns_transition,
+        }, fsync=True)
+
+    def journal_reshard_event(self, kind: str, epoch: int, n_roots: int):
+        """Resharder hook: journal a reshard ``plan`` (pass start; an open
+        plan with no matching ``fin`` means a reshard was in flight at the
+        crash) or ``fin`` (this process's copy debt drained)."""
+        self._journal_append(
+            {"k": kind, "epoch": int(epoch), "n": int(n_roots)}, fsync=True
+        )
+
+    def _journal_root(self, root: str, rec: "_RootRecord"):
+        """Journal one catalog record (full upsert — replay is last-wins,
+        so holder/level churn folds to the final state)."""
+        if self._journal_log is None:
+            return  # keep the journal-off save path free of the tolist()
+        self._journal_append({
+            "k": "root", "root": root, "tokens": rec.tokens.tolist(),
+            "blocks": int(rec.blocks), "holders": dict(rec.holders),
+        })
+
+    def _snapshot_records(self) -> List[dict]:
+        """The compaction snapshot: the current view + every catalog root
+        (holder block-levels and membership tombstones intact)."""
+        view = self.membership.view()
+        out: List[dict] = []
+        m = self.membership
+        out.append({
+            "k": "view", "epoch": view.epoch,
+            "members": [
+                [mid, st, int(se)] for mid, st, se in zip(
+                    view.member_ids, view.states,
+                    view.since or (0,) * len(view.member_ids),
+                )
+            ],
+            "prev": list(m.prev_placement) if m.prev_placement else None,
+            "owner": m.owns_transition,
+        })
+        with self._cat_lock:
+            items = [
+                (root, rec.tokens.tolist(), int(rec.blocks), dict(rec.holders))
+                for root, rec in self._catalog.items()
+            ]
+        for root, tokens, blocks, holders in items:
+            out.append({
+                "k": "root", "root": root, "tokens": tokens,
+                "blocks": blocks, "holders": holders,
+            })
+        return out
+
+    def compact_journal(self):
+        """Rewrite the journal as a snapshot (resharder finalize path and
+        replay hygiene); errors are logged, never raised — a full disk
+        must not wedge the reconciler."""
+        log = self._journal_log
+        if log is None:
+            return
+        try:
+            # The snapshot runs under the LOG lock (callable form): an
+            # append racing the compaction either lands before the
+            # snapshot (and is reflected in it) or after the replace (and
+            # survives in the new file) — never in a destroyed window.
+            log.compact(self._snapshot_records)
+        except OSError as e:
+            Logger.error(f"journal compaction failed: {e!r}")
+
+    def catalog_restore(self, records: Sequence[dict], journal: bool = False):
+        """Install catalog root records (journal replay / bootstrap):
+        each is ``{"root", "tokens", "blocks", "holders"}``. Holder levels
+        install verbatim; the normal CATALOG_MAX_ROOTS bound applies.
+        ``journal=True`` re-journals them (the bootstrap path — a cold
+        client's journal must cover the snapshot it started from)."""
+        for r in records:
+            root = r["root"]
+            tokens = np.asarray(r.get("tokens", ()), dtype=np.int64)
+            blocks = int(r.get("blocks", 0))
+            holders = {
+                str(m): int(lv) for m, lv in (r.get("holders") or {}).items()
+            }
+            if not root or blocks <= 0:
+                continue
+            with self._cat_lock:
+                while len(self._catalog) >= self.CATALOG_MAX_ROOTS:
+                    self._catalog.pop(next(iter(self._catalog)))
+                rec = self._catalog[root] = _RootRecord(
+                    tokens=tokens, blocks=blocks, holders=holders
+                )
+            if journal:
+                self._journal_root(root, rec)
+
+    def _replay_journal(self):
+        """Construction-time crash recovery: fold the journal's records
+        (last-wins per key; ``drop`` tombstones keep dropped roots
+        dropped), rebuild the member arrays in the journaled entry order
+        (re-dialing members the constructor did not pass), install the
+        view + catalog, rewrite the log compacted, and — when the crash
+        interrupted a reshard (open plan record or unsettled view) — kick
+        the resharder so migration RESUMES from the journaled debt."""
+        log = self._journal_log
+        records = log.replay()
+        if not records:
+            # Fresh journal: seed it with the initial view so even a
+            # client that crashes before its first transition replays a
+            # well-formed state.
+            self._on_view_change(self.membership.view())
+            return
+        view_rec: Optional[dict] = None
+        catalog: Dict[str, dict] = {}
+        open_plan: Optional[dict] = None
+        for r in records:
+            k = r.get("k")
+            if k == "view":
+                if view_rec is None or r.get("epoch", 0) >= view_rec.get("epoch", 0):
+                    view_rec = r
+            elif k == "root":
+                catalog[r["root"]] = r
+            elif k == "hadd":
+                rec = catalog.get(r.get("root"))
+                if rec is not None:
+                    h = rec.setdefault("holders", {})
+                    h[r["m"]] = max(int(h.get(r["m"], 0)), int(r.get("lv", 0)))
+            elif k == "hdem":
+                rec = catalog.get(r.get("root"))
+                if rec is not None and r.get("m") in rec.get("holders", {}):
+                    rec["holders"][r["m"]] = 0
+            elif k == "hdel":
+                rec = catalog.get(r.get("root"))
+                if rec is not None:
+                    rec.get("holders", {}).pop(r.get("m"), None)
+            elif k == "drop":
+                catalog.pop(r.get("root"), None)
+            elif k == "plan":
+                open_plan = {"epoch": int(r.get("epoch", 0)),
+                             "roots": int(r.get("n", 0))}
+            elif k == "fin":
+                if open_plan is not None and int(r.get("epoch", 0)) >= open_plan["epoch"]:
+                    open_plan = None
+        if view_rec is not None:
+            self._restore_view(view_rec)
+        self.catalog_restore(list(catalog.values()))
+        # Hygiene: restart from a compacted file (also folds away any torn
+        # tail / bad-checksum frames the replay skipped).
+        self.compact_journal()
+        view = self.membership.view()
+        resume = (not self.membership.settled) or open_plan is not None
+        self.recovered = {
+            "epoch": view.epoch,
+            "roots": len(catalog),
+            "resume_reshard": bool(resume),
+            "replay_records": log.replay_records,
+            "replay_torn": log.replay_torn,
+            "replay_bad_checksum": log.replay_bad_checksum,
+        }
+        telemetry.emit(
+            "client_restart", epoch=view.epoch,
+            recovered_roots=len(catalog), resume_reshard=bool(resume),
+            replay_torn=log.replay_torn,
+            replay_bad_checksum=log.replay_bad_checksum,
+        )
+        if resume:
+            self.resharder.kick()
+
+    def _restore_view(self, view_rec: dict):
+        """Rebuild the member arrays in the JOURNALED entry order (indices
+        are the identity the health/breaker arrays key on): constructor-
+        provided connections slot in at their id's latest incarnation,
+        journal-only members are re-dialed lazily, tombstones get inert
+        placeholders, and constructor members unknown to the journal are
+        appended ACTIVE (an operator growing the seed list across a
+        restart)."""
+        entries = [
+            (str(mid), str(st), int(se))
+            for mid, st, se in view_rec.get("members", [])
+        ]
+        if not entries:
+            return
+        given = {}  # member_id -> already-built member connector
+        for mid, member in zip(self.member_ids, self.members):
+            given[mid] = member
+        latest = {}
+        for j, (mid, _, _) in enumerate(entries):
+            latest[mid] = j
+        members, ids, health = [], [], []
+        for j, (mid, state, since) in enumerate(entries):
+            if mid in given and latest[mid] == j:
+                member = given.pop(mid)
+            else:
+                member = self._dial_member(mid, state)
+            members.append(member)
+            ids.append(mid)
+            health.append(_MemberHealth(breaker=self._breaker_factory(len(ids) - 1)))
+        for mid, member in given.items():
+            # Constructor conns the journal never saw: admit as ACTIVE.
+            entries.append((mid, MemberState.ACTIVE, int(view_rec.get("epoch", 1))))
+            members.append(member)
+            ids.append(mid)
+            health.append(_MemberHealth(breaker=self._breaker_factory(len(ids) - 1)))
+        self.members = members
+        self.member_ids = ids
+        self._health = health
+        self.membership.restore(
+            entries, int(view_rec.get("epoch", 1)),
+            prev_placement=view_rec.get("prev"),
+            owner=bool(view_rec.get("owner", False)),
+        )
+
+    # -- gossip exchange (docs/membership.md, gossip section) ------------------
+
+    def gossip_payload(self) -> dict:
+        """The anti-entropy exchange body: the epoch-stamped view (every
+        entry with its ``since_epoch`` incarnation stamp) plus the
+        fallback placement, so a peer adopting an in-flight transition
+        can serve epoch-aware read failover for roots it never saw."""
+        view = self.membership.view()
+        prev = self.membership.prev_placement
+        return {
+            "epoch": view.epoch,
+            "members": view.as_dict()["members"],
+            "prev_placement": list(prev) if prev else None,
+            "settled": self.membership.settled,
+        }
+
+    def merge_remote_view(self, payload: dict) -> bool:
+        """Merge a peer's gossiped view into ours (the tombstone-aware
+        lattice — ``Membership.merge_apply``): per member id the newest
+        incarnation wins, within one incarnation the more advanced state
+        wins, and the epoch becomes ``max(local, remote)``. Member ids we
+        have never seen are DIALED (``dial_factory``) and appended —
+        array-aligned with their new entries — before the merged view
+        publishes, so a read can route to a gossip-learned member the
+        moment the epoch lands. Returns True when anything changed
+        (journaled + resharder kicked). Runs off any event loop (the
+        manage plane calls it via ``to_thread``) and serializes with
+        every other membership transition under the admin lock."""
+        remote_members = payload.get("members") or []
+        remote_epoch = int(payload.get("epoch", 0))
+        if not remote_members:
+            raise ValueError("gossip payload has no members")
+        for m in remote_members:
+            if "member_id" not in m or "state" not in m:
+                raise ValueError("malformed gossip member entry")
+        with self._admin_lock:
+            # Phase 1 (dry run, blocking I/O allowed): learn which ids are
+            # brand new and dial them. Phase 2 appends the member/health
+            # array slots INSIDE merge_apply's on_new callback, under the
+            # membership lock — so even if a concurrent finalize (the
+            # resharder thread takes no admin lock) changes the delta
+            # between the two phases, entries and arrays stay aligned:
+            # an entry that became new late gets an undialed placeholder
+            # (healed later by its breaker probe), and a dialed conn whose
+            # entry became in-place just stays in _owned_dials unused.
+            planned = self.membership.merge_plan(remote_members)
+            dialed = {}
+            for mid, state, _since in planned:
+                if mid not in dialed:
+                    conn = self._dial_factory(
+                        mid, state in MemberState.READABLE
+                    )
+                    self._owned_dials.append(conn)
+                    dialed[mid] = conn
+
+            def on_new(mid, state, _since):
+                conn = dialed.pop(mid, None)
+                if conn is None:
+                    # Construction only (connect=False): no I/O under the
+                    # membership lock; the breaker's probe heal performs
+                    # the real reconnect later.
+                    try:
+                        conn = self._dial_factory(mid, False)
+                    except Exception:
+                        conn = _DeadConn(mid)
+                    self._owned_dials.append(conn)
+                self.members.append(
+                    _LazyMember(mid, conn, self._member_factory)
+                )
+                self.member_ids.append(mid)
+                self._health.append(_MemberHealth(
+                    breaker=self._breaker_factory(len(self.member_ids) - 1)
+                ))
+
+            changed, _view = self.membership.merge_apply(
+                remote_members, remote_epoch,
+                prev_placement=payload.get("prev_placement"),
+                on_new=on_new,
+            )
+        if changed:
+            self.resharder.kick()
+        return changed
+
+    # -- cold bootstrap (docs/membership.md, bootstrap section) ----------------
+
+    def bootstrap_payload(self, limit: int = 4096) -> dict:
+        """What a cold client needs from any live member: the gossip view
+        payload plus a bounded catalog snapshot (root records with holder
+        block-levels). Runs off-loop (the /bootstrap route wraps it in
+        ``to_thread`` — the catalog walk is O(n_roots))."""
+        with self._cat_lock:
+            items = list(self._catalog.items())
+        catalog = [
+            {
+                "root": root, "tokens": rec.tokens.tolist(),
+                "blocks": int(rec.blocks), "holders": dict(rec.holders),
+            }
+            for root, rec in items[:max(0, limit)]
+        ]
+        return {
+            **self.gossip_payload(),
+            "catalog": catalog,
+            "catalog_total": len(items),
+        }
+
+    @classmethod
+    def bootstrap(
+        cls, payload: dict, spec: PagedKVCacheSpec, model_id: str,
+        max_blocks: int, dial_factory=None, **cluster_kw,
+    ) -> "ClusterKVConnector":
+        """Reconstruct a cluster client from a ``bootstrap_payload``
+        snapshot (a fresh process with only a seed list: fetch
+        ``GET /bootstrap`` from any live peer's manage plane — e.g. via
+        ``tools.fleet.manage_json`` — and hand the body here). Dials every
+        READABLE member of the snapshot view, installs the epoch-stamped
+        view (tombstones intact) through the same merge lattice gossip
+        uses, and imports the catalog so reads fail over and reshards
+        plan exactly as they would have in the process that wrote it.
+        Raises ``InfiniStoreException`` when no member of the snapshot
+        can be dialed."""
+        members = payload.get("members") or []
+        if not members:
+            raise ValueError("bootstrap payload has no members")
+        dial = dial_factory or cls._default_dial
+        conns, ids = [], []
+        for m in members:
+            if m.get("state") not in MemberState.READABLE:
+                continue
+            mid = m["member_id"]
+            if mid in ids:
+                continue
+            conn = dial(mid, True)
+            if getattr(conn, "is_connected", True):
+                conns.append(conn)
+                ids.append(mid)
+            else:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+        if not conns:
+            raise InfiniStoreException(
+                "bootstrap: no readable member of the snapshot is reachable"
+            )
+        cluster = cls(
+            conns, spec, model_id, max_blocks, member_ids=ids,
+            dial_factory=dial_factory, **cluster_kw,
+        )
+        cluster._owned_dials.extend(conns)
+        cluster.merge_remote_view(payload)
+        cluster.catalog_restore(
+            payload.get("catalog") or [],
+            journal=cluster._journal_log is not None,
+        )
+        if not cluster.membership.settled:
+            cluster.resharder.kick()
+        return cluster
 
     # -- catalog (the resharder's metadata plane) ------------------------------
 
@@ -629,6 +1168,12 @@ class ClusterKVConnector:
             if top > rec.blocks:
                 rec.tokens = chains_tokens
                 rec.blocks = top
+            snap = _RootRecord(
+                tokens=rec.tokens, blocks=rec.blocks, holders=dict(rec.holders)
+            )
+        # Journal the upserted record OUTSIDE the catalog lock (bounded
+        # buffered append; fsync stays interval-bounded off this path).
+        self._journal_root(root, snap)
 
     def catalog_add_holder(
         self, root: str, member_id: str, blocks: int = 0
@@ -643,7 +1188,12 @@ class ClusterKVConnector:
             if rec is None:
                 return False
             rec.holders[member_id] = max(rec.holders.get(member_id, 0), blocks)
-            return True
+        # Holder records double as journaled reshard PROGRESS: a replayed
+        # plan only re-copies the roots whose targets still lack a copy.
+        self._journal_append(
+            {"k": "hadd", "root": root, "m": member_id, "lv": int(blocks)}
+        )
+        return True
 
     def catalog_remove_holder(self, root: str, member_id: str):
         """Resharder callback: ``member_id``'s copy of ``root`` was pruned."""
@@ -651,6 +1201,7 @@ class ClusterKVConnector:
             rec = self._catalog.get(root)
             if rec is not None:
                 rec.holders.pop(member_id, None)
+        self._journal_append({"k": "hdel", "root": root, "m": member_id})
 
     def catalog_demote_holder(self, root: str, member_id: str):
         """Resharder callback: ``member_id``'s copy of ``root`` proved
@@ -663,6 +1214,7 @@ class ClusterKVConnector:
             rec = self._catalog.get(root)
             if rec is not None and member_id in rec.holders:
                 rec.holders[member_id] = 0
+        self._journal_append({"k": "hdem", "root": root, "m": member_id})
 
     def reshard_plan(self) -> List[_RootTask]:
         """The rendezvous delta at the CURRENT epoch: one task per catalog
@@ -695,12 +1247,15 @@ class ClusterKVConnector:
             }
             if stale:
                 # Lazy scrub (mark_dead stays O(1)): a terminal member's
-                # copies are gone with it.
+                # copies are gone with it. Journaled (hdel) so a replay
+                # reproduces the scrubbed holder sets instead of
+                # resurrecting dead members' entries.
                 with self._cat_lock:
                     for m in stale:
                         rec.holders.pop(m, None)
                 for m in stale:
                     levels.pop(m, None)
+                    self._journal_append({"k": "hdel", "root": root, "m": m})
             live = {m: lv for m, lv in levels.items() if m in readable_set}
             if not live:
                 lost.append(root)
@@ -744,11 +1299,20 @@ class ClusterKVConnector:
         return tasks
 
     def membership_status(self) -> dict:
-        """Flat membership + reshard counter snapshot (the ``/membership``
-        manage endpoint and ``/metrics`` membership gauges serve this —
-        keys enumerated in ``Membership.status`` and
-        ``Resharder.progress``)."""
-        return {**self.membership.status(), **self.resharder.progress()}
+        """Flat membership + reshard + journal counter snapshot (the
+        ``/membership`` manage endpoint and ``/metrics`` membership gauges
+        serve this — keys enumerated in ``Membership.status``,
+        ``Resharder.progress`` and ``DurableLog.status``; the journal_*
+        keys read 0 when no durable journal is configured)."""
+        log = self._journal_log
+        journal = log.status() if log is not None else {
+            "journal_records": 0, "journal_bytes": 0, "journal_fsyncs": 0,
+            "journal_compactions": 0, "journal_replay_records": 0,
+            "journal_replay_torn": 0, "journal_replay_bad_checksum": 0,
+        }
+        return {
+            **self.membership.status(), **self.resharder.progress(), **journal,
+        }
 
     # -- failure-domain plumbing ---------------------------------------------
 
@@ -1262,6 +1826,9 @@ class ClusterKVConnector:
         with self._cat_lock:
             rec = self._catalog.pop(root, None)
         if rec is not None:
+            # The durable tombstone: replay must keep a dropped root
+            # dropped (resurrecting it would serve a deleted prompt).
+            self._journal_append({"k": "drop", "root": root}, fsync=True)
             view = self.membership.view()
             for mid in sorted(rec.holders):
                 if view.state_of(mid) not in MemberState.READABLE:
@@ -1351,8 +1918,11 @@ class ClusterKVConnector:
                 # Members expose get_stats() themselves (KVConnector and the
                 # quantized connector both do) — the cluster stays blind to
                 # member internals; a member without it just reports its id.
-                getter = getattr(m, "get_stats", None)
+                # The attribute fetch sits INSIDE the try: a _LazyMember
+                # over a still-unconnected dial raises the typed transport
+                # error from __getattr__ itself.
                 try:
+                    getter = getattr(m, "get_stats", None)
                     s = dict(getter()) if getter is not None else {}
                     self._done(i, None)
                 except InfiniStoreException as e:

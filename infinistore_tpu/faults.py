@@ -24,8 +24,10 @@ action, keys) so tests assert exactly which faults a run took.
 """
 
 import asyncio
+import os
 import random
 import re
+import signal
 import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Union
@@ -33,7 +35,27 @@ from typing import List, Optional, Sequence, Union
 from ._native import lib
 from .lib import InfiniStoreException, Logger
 
-__all__ = ["FaultRule", "FaultyConnection", "kill_transport"]
+__all__ = [
+    "FaultRule", "FaultyConnection", "kill_transport", "crash_process",
+]
+
+
+def crash_process() -> None:
+    """Hard-kill THIS process (``SIGKILL`` to self): the process-level
+    crash capability — a ``kill -9`` as the process experiences it, at a
+    point the caller controls. No atexit handlers, no flushes, no
+    destructors run; whatever the durable journal had not written is
+    lost, which is exactly what crash-recovery tests must survive
+    (docs/membership.md, durability section).
+
+    Used by the ``"crash"`` :class:`FaultRule` action and by the fleet
+    harness's crash-after-N-migrated-roots watcher
+    (``infinistore_tpu.fleet_client``); the kill/restart-with-same-argv
+    counterparts live in ``tools/fleet.py``. Never call this from a test
+    process itself — spawn a subprocess and crash THAT.
+    """
+    Logger.warn("faults: crash_process() — SIGKILL to self")
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def kill_transport(conn) -> bool:
@@ -105,6 +127,11 @@ class FaultRule:
     - ``"short_read"``: ``tcp_read_cache`` returns only the first
       ``truncate_to`` bytes of the real payload; on every other op it
       raises (a batched op cannot deliver partial bytes without lying).
+    - ``"crash"``: hard-kill the WHOLE process (:func:`crash_process`,
+      SIGKILL to self) at this exact op — a deterministic ``kill -9``
+      mid-operation for crash-recovery tests. Only meaningful inside a
+      subprocess the test harness spawned (tools/fleet.py restarts it
+      with the same argv).
     """
 
     op: Optional[Union[str, Sequence[str]]] = None
@@ -122,7 +149,7 @@ class FaultRule:
     # Matching ops seen (drives ``every``; mutated by the wrapper).
     matches: int = field(default=0, repr=False)
 
-    _ACTIONS = ("error", "timeout", "delay", "reset", "short_read")
+    _ACTIONS = ("error", "timeout", "delay", "reset", "short_read", "crash")
 
     def __post_init__(self):
         if self.action not in self._ACTIONS:
@@ -217,6 +244,8 @@ class FaultyConnection:
         return None
 
     def _raise(self, rule: FaultRule, op: str):
+        if rule.action == "crash":
+            crash_process()  # SIGKILL: nothing below this line runs
         if rule.action == "reset":
             kill_transport(self.inner)
             raise InfiniStoreException(f"injected connection reset ({op})")

@@ -45,10 +45,14 @@ collapses back to a single placement.
 See docs/membership.md for the protocol walk-through.
 """
 
+import json
+import os
+import struct
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,7 +65,210 @@ from .lib import (
 from . import telemetry
 from .wire import PRIORITY_BACKGROUND
 
-__all__ = ["MemberState", "MembershipView", "Membership", "Resharder"]
+__all__ = [
+    "MemberState", "MembershipView", "Membership", "Resharder", "DurableLog",
+]
+
+
+# ---------------------------------------------------------------------------
+# Durable write-ahead log (crash-safe catalog + reshard journal).
+# ---------------------------------------------------------------------------
+
+# On-disk record framing: little-endian u32 payload length + u32 CRC32 of
+# the payload, then the JSON payload bytes. The header carries no magic —
+# the file IS the stream, and replay validates every record by checksum.
+_REC_HDR = struct.Struct("<II")
+
+
+class DurableLog:
+    """Append-only, length-prefixed, checksummed, fsync-bounded record log
+    — the durability substrate for the cluster's root catalog and reshard
+    journal (docs/membership.md, durability section).
+
+    Write path: each :meth:`append` frames one JSON record as
+    ``[u32 length][u32 crc32(payload)][payload]``, writes it through the
+    buffered file and flushes to the OS (a ``kill -9`` therefore loses
+    nothing already appended); ``fsync`` is **bounded**, not per-record —
+    at most one fsync per ``fsync_interval_s`` unless the caller forces it
+    (membership transitions and reshard plan records do; per-save catalog
+    records do not), so journaling stays off the save path's latency.
+
+    Replay policy (:meth:`replay`):
+
+    - a **torn tail** (truncated header or payload — the record being
+      written when the process died) is discarded cleanly and counted
+      (``journal_replay_torn``), never parsed;
+    - a record whose **checksum mismatches** is skipped and counted
+      (``journal_replay_bad_checksum``); replay continues at the next
+      frame (the length prefix still delimits it). A corrupted *length*
+      field cannot be resynced past — the remainder is treated as a torn
+      tail;
+    - everything else replays in append order (last record wins per key,
+      so a ``drop`` tombstone after a ``root`` record keeps the root
+      dropped — replay can never resurrect it).
+
+    :meth:`compact` atomically rewrites the log as a snapshot (tmp file +
+    fsync + ``os.replace``), preserving holder block-levels and membership
+    tombstones while discarding the superseded incremental records — run
+    on reshard finalize and at replay time.
+
+    Thread-safe: one internal lock serializes appends/compaction (event
+    loop, resharder worker and operator threads all write).
+
+    ``status()`` keys (exported as ``infinistore_journal_*`` on /metrics,
+    ITS-C005): ``journal_records``, ``journal_bytes``, ``journal_fsyncs``,
+    ``journal_compactions``, ``journal_replay_records``,
+    ``journal_replay_torn``, ``journal_replay_bad_checksum``.
+    """
+
+    MAX_RECORD = 16 << 20  # length-field plausibility bound for replay
+
+    def __init__(self, path: str, fsync_interval_s: float = 0.05,
+                 clock=time.monotonic):
+        self.path = path
+        self.fsync_interval_s = fsync_interval_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+        self._last_fsync = clock()
+        self.records = 0
+        self.fsyncs = 0
+        self.compactions = 0
+        self.replay_records = 0
+        self.replay_torn = 0
+        self.replay_bad_checksum = 0
+
+    @staticmethod
+    def _frame(record: dict) -> bytes:
+        payload = json.dumps(record, separators=(",", ":")).encode()
+        return _REC_HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+    def append(self, record: dict, fsync: bool = False):
+        """Append one record (write + flush to the OS always; fsync when
+        forced or the bounded interval elapsed). No-op after close()."""
+        buf = self._frame(record)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(buf)
+            # Audited: a bounded buffered write + flush to the page cache
+            # (microseconds; the journal lives on tmpfs in every harness).
+            # The fsync below is interval-bounded and forced only from
+            # non-loop paths (transitions, reshard plans).
+            self._f.flush()
+            self.records += 1
+            now = self._clock()
+            if fsync or now - self._last_fsync >= self.fsync_interval_s:
+                os.fsync(self._f.fileno())
+                self._last_fsync = now
+                self.fsyncs += 1
+
+    def replay(self) -> List[dict]:
+        """Parse every intact record from disk, applying the torn-tail /
+        bad-checksum policy above; updates the replay counters."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return []
+        out: List[dict] = []
+        torn = bad = 0
+        i = 0
+        n = len(data)
+        while i < n:
+            if i + _REC_HDR.size > n:
+                torn += 1  # partial header: the frame being written
+                break
+            ln, crc = _REC_HDR.unpack_from(data, i)
+            if ln <= 0 or ln > self.MAX_RECORD:
+                # Implausible length = corrupt frame boundary; nothing
+                # after it can be delimited — discard as a torn tail.
+                torn += 1
+                break
+            if i + _REC_HDR.size + ln > n:
+                torn += 1  # partial payload
+                break
+            payload = data[i + _REC_HDR.size: i + _REC_HDR.size + ln]
+            i += _REC_HDR.size + ln
+            if zlib.crc32(payload) != crc:
+                bad += 1  # skipped, counted; next frame still delimited
+                continue
+            try:
+                out.append(json.loads(payload))
+            except ValueError:
+                bad += 1
+        self.replay_records = len(out)
+        self.replay_torn = torn
+        self.replay_bad_checksum = bad
+        return out
+
+    def compact(self, records):
+        """Atomically replace the log's contents with ``records`` — either
+        a sequence of record dicts or a CALLABLE returning one: tmp file,
+        fsync, ``os.replace``, append order preserved.
+
+        Pass a callable when the snapshot derives from live state the
+        appenders also mutate (the cluster's catalog): it runs UNDER the
+        log lock, so no append can land between the snapshot read and the
+        file replace — otherwise a record written in that window (e.g. a
+        ``drop`` tombstone racing a finalize-time compaction) would be
+        silently destroyed with the old file, and a later replay would
+        resurrect state the appender had already retired. Appenders must
+        therefore never call :meth:`append` while holding a lock the
+        snapshot function takes (the cluster appends outside its catalog
+        lock, always)."""
+        with self._lock:
+            if self._f is None:
+                return
+            if callable(records):
+                records = records()
+            tmp = self.path + ".compact.tmp"
+            with open(tmp, "wb") as f:
+                for r in records:
+                    f.write(self._frame(r))
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+            self._last_fsync = self._clock()
+            self.compactions += 1
+            self.fsyncs += 1
+
+    def size_bytes(self) -> int:
+        try:
+            return os.stat(self.path).st_size
+        except OSError:
+            return 0
+
+    def status(self) -> dict:
+        """Flat ``journal_*`` counter snapshot for /membership + /metrics.
+
+        Keys: ``journal_records`` (appends, lifetime), ``journal_bytes``
+        (current log size), ``journal_fsyncs``, ``journal_compactions``,
+        ``journal_replay_records`` / ``journal_replay_torn`` /
+        ``journal_replay_bad_checksum`` (what the startup replay saw)."""
+        return {
+            "journal_records": self.records,
+            "journal_bytes": self.size_bytes(),
+            "journal_fsyncs": self.fsyncs,
+            "journal_compactions": self.compactions,
+            "journal_replay_records": self.replay_records,
+            "journal_replay_torn": self.replay_torn,
+            "journal_replay_bad_checksum": self.replay_bad_checksum,
+        }
+
+    def close(self):
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+            self._f.close()
+            self._f = None
 
 
 class MemberState:
@@ -106,6 +313,13 @@ class MembershipView:
     epoch: int
     member_ids: Tuple[str, ...]
     states: Tuple[str, ...]
+    # Per-entry incarnation stamp: the epoch at which the entry reached its
+    # current state. Gossip merges compare (since_epoch, state rank) so a
+    # DEAD tombstone at epoch 5 beats stale ACTIVE knowledge from epoch 3
+    # while a legitimate re-add at epoch 7 beats the tombstone
+    # (docs/membership.md, gossip section). Empty for views built by old
+    # callers; zip() below tolerates it.
+    since: Tuple[int, ...] = ()
 
     def placement_ids(self) -> List[str]:
         """Member ids new writes rendezvous over (JOINING + ACTIVE)."""
@@ -130,12 +344,15 @@ class MembershipView:
         return None
 
     def as_dict(self) -> dict:
-        """JSON-shaped view for health()/the manage plane."""
+        """JSON-shaped view for health()/the manage plane (and the gossip
+        exchange payload — ``since_epoch`` is what makes the merge
+        tombstone-aware)."""
+        since = self.since or (0,) * len(self.member_ids)
         return {
             "epoch": self.epoch,
             "members": [
-                {"member_id": m, "state": s}
-                for m, s in zip(self.member_ids, self.states)
+                {"member_id": m, "state": s, "since_epoch": int(se)}
+                for m, s, se in zip(self.member_ids, self.states, since)
             ],
         }
 
@@ -183,6 +400,14 @@ class Membership:
         # Placement ids as of the last SETTLED view; the read-failover
         # fallback set while a transition is in flight. None when settled.
         self._prev_placement: Optional[Tuple[str, ...]] = None
+        # True while THIS process originated the pending transition: only
+        # the originator finalizes (a gossip adopter with an empty catalog
+        # must not rubber-stamp a transition whose migration it cannot
+        # see — it settles when the originator's finalized view arrives).
+        self._owner = False
+        # Post-publish hook (cluster journaling): called with the new view
+        # after every epoch change, OUTSIDE the membership lock.
+        self.on_change: Optional[Callable[[MembershipView], None]] = None
         self._view = self._snapshot()
 
     # -- snapshots -----------------------------------------------------------
@@ -192,7 +417,16 @@ class Membership:
             epoch=self.epoch,
             member_ids=tuple(e.member_id for e in self._entries),
             states=tuple(e.state for e in self._entries),
+            since=tuple(e.since_epoch for e in self._entries),
         )
+
+    def _notify(self, view: MembershipView):
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb(view)
+            except Exception as e:  # journaling must never fail a transition
+                Logger.error(f"membership on_change hook failed: {e!r}")
 
     def view(self) -> MembershipView:
         """The current immutable view (cheap: prebuilt per transition)."""
@@ -232,6 +466,7 @@ class Membership:
             fn()
             self.epoch += 1
             self.epoch_changes += 1
+            self._owner = True  # this process originated the transition
             self._view = view = self._snapshot()
         # Journal the epoch bump OUTSIDE the membership lock (the journal
         # has its own): which transition, on whom, to which epoch — the
@@ -241,6 +476,7 @@ class Membership:
             "membership_epoch", member=member_id, epoch=view.epoch,
             action=action,
         )
+        self._notify(view)
         return view
 
     def add_member(self, member_id: str) -> MembershipView:
@@ -325,13 +561,174 @@ class Membership:
                     e.since_epoch = self.epoch + 1
             self._prev_placement = None
             if not changed:
+                self._owner = False
                 return None
             self.epoch += 1
             self.epoch_changes += 1
+            self._owner = False
             self._view = view = self._snapshot()
         telemetry.emit(
             "membership_epoch", epoch=view.epoch, action="finalize",
         )
+        self._notify(view)
+        return view
+
+    # -- gossip merge + restore (docs/membership.md) -------------------------
+
+    # Per-entry precedence within one incarnation (equal since_epoch): a
+    # more advanced state wins, and terminal states dominate liveness — a
+    # lattice join, so concurrent merges commute and every process
+    # converges on identical states without coordination.
+    _STATE_RANK = {
+        MemberState.JOINING: 1,
+        MemberState.ACTIVE: 2,
+        MemberState.LEAVING: 3,
+        MemberState.DEAD: 4,
+        MemberState.REMOVED: 5,
+    }
+
+    @property
+    def owns_transition(self) -> bool:
+        """True while the pending transition was originated by THIS process
+        (only the originator's resharder finalizes it; gossip adopters
+        settle when the finalized view arrives)."""
+        return self._owner
+
+    @classmethod
+    def _beats(cls, a_state: str, a_since: int, b_state: str,
+               b_since: int) -> bool:
+        """Does (b_state @ b_since) supersede (a_state @ a_since)? Newer
+        incarnation wins outright (a re-add after DEAD is legitimate);
+        within one incarnation the state lattice decides (tombstones
+        dominate — stale liveness never resurrects a written-off member)."""
+        if b_since != a_since:
+            return b_since > a_since
+        return cls._STATE_RANK.get(b_state, 0) > cls._STATE_RANK.get(a_state, 0)
+
+    @staticmethod
+    def _latest_remote(remote_members: Sequence[dict]) -> Dict[str, Tuple[str, int]]:
+        latest: Dict[str, Tuple[str, int]] = {}
+        for m in remote_members:
+            mid = m["member_id"]
+            state = m["state"]
+            since = int(m.get("since_epoch", 0))
+            cur = latest.get(mid)
+            if cur is None or Membership._beats(cur[0], cur[1], state, since):
+                latest[mid] = (state, since)
+        return latest
+
+    def _merge_delta(self, remote_members: Sequence[dict]):
+        """(in-place state changes, brand-new entries) the lattice join of
+        the current entries with a remote view would apply. Caller holds
+        ``self._lock``. New entries come back in a deterministic order
+        (sorted by (since_epoch, member_id)) so the cluster can append its
+        member arrays in the same order it later re-derives here."""
+        local_latest: Dict[str, int] = {}  # mid -> latest entry index
+        for i, e in enumerate(self._entries):
+            local_latest[e.member_id] = i
+        changes: List[Tuple[int, str, int]] = []  # (entry idx, state, since)
+        new: List[Tuple[str, str, int]] = []  # (mid, state, since)
+        for mid, (rstate, rsince) in self._latest_remote(remote_members).items():
+            idx = local_latest.get(mid)
+            if idx is None:
+                new.append((mid, rstate, rsince))
+                continue
+            e = self._entries[idx]
+            if not self._beats(e.state, e.since_epoch, rstate, rsince):
+                continue
+            if e.state in MemberState.TERMINAL and rsince > e.since_epoch:
+                # A newer incarnation of a tombstoned id: a NEW entry (the
+                # dead incarnation's index stays stable forever).
+                new.append((mid, rstate, rsince))
+            else:
+                changes.append((idx, rstate, rsince))
+        new.sort(key=lambda t: (t[2], t[0]))
+        return changes, new
+
+    def merge_plan(self, remote_members: Sequence[dict]) -> List[Tuple[str, str, int]]:
+        """Dry run of a gossip merge: the brand-new entries (in apply
+        order) a :meth:`merge_apply` of this payload would append — the
+        cluster dials connections for the readable ones first, then
+        applies (docs/membership.md, gossip section)."""
+        with self._lock:
+            _, new = self._merge_delta(remote_members)
+        return new
+
+    def merge_apply(
+        self, remote_members: Sequence[dict], remote_epoch: int,
+        prev_placement: Optional[Sequence[str]] = None,
+        on_new=None,
+    ) -> Tuple[bool, MembershipView]:
+        """Apply the tombstone-aware lattice merge of a remote view
+        (docs/membership.md: per member id, the newest incarnation wins;
+        within one incarnation the more advanced state wins, so terminal
+        knowledge dominates). The epoch becomes ``max(local, remote)`` —
+        the merge itself is commutative and idempotent, so two processes
+        exchanging in either order converge on identical (epoch, states).
+        Returns ``(changed, view)``. Does NOT take transition ownership:
+        an adopted transition is finalized by its originator, and this
+        process settles when the finalized view gossips back.
+
+        ``on_new(member_id, state, since)``: called UNDER the membership
+        lock immediately before each brand-new entry appends — the
+        cluster appends its member/health array slots there, so entry
+        indices and member arrays cannot diverge even when a concurrent
+        finalize (the resharder thread holds no admin lock) changed the
+        delta between the caller's ``merge_plan`` and this apply. Must be
+        O(1) and non-blocking (no I/O, no other locks)."""
+        with self._lock:
+            changes, new = self._merge_delta(remote_members)
+            epoch_moved = int(remote_epoch) > self.epoch
+            if not changes and not new and not epoch_moved:
+                return False, self._view
+            was_placement = tuple(self._view.placement_ids())
+            for idx, state, since in changes:
+                self._entries[idx].state = state
+                self._entries[idx].since_epoch = since
+            for mid, state, since in new:
+                if on_new is not None:
+                    on_new(mid, state, since)
+                self._entries.append(_Entry(mid, state, since))
+            self.epoch = max(self.epoch, int(remote_epoch))
+            self.epoch_changes += 1
+            self._view = view = self._snapshot()
+            settled = not any(
+                s in (MemberState.JOINING, MemberState.LEAVING)
+                for s in view.states
+            )
+            if settled:
+                self._prev_placement = None
+            elif self._prev_placement is None:
+                # The fallback set reads span mid-transition: the sender's
+                # pre-transition placement when it shared one, else our own
+                # placement as of just before this merge.
+                self._prev_placement = (
+                    tuple(prev_placement) if prev_placement else was_placement
+                )
+        telemetry.emit(
+            "membership_epoch", epoch=view.epoch, action="gossip_merge",
+        )
+        self._notify(view)
+        return True, view
+
+    def restore(
+        self, entries: Sequence[Tuple[str, str, int]], epoch: int,
+        prev_placement: Optional[Sequence[str]] = None, owner: bool = False,
+    ) -> MembershipView:
+        """Install a journaled view wholesale (crash-recovery replay;
+        construction-time only — no epoch bump, no events, no hooks). The
+        caller has already rebuilt its member arrays in ``entries``
+        order."""
+        with self._lock:
+            self._entries = [
+                _Entry(mid, state, int(since)) for mid, state, since in entries
+            ]
+            self.epoch = int(epoch)
+            self._prev_placement = (
+                tuple(prev_placement) if prev_placement else None
+            )
+            self._owner = bool(owner)
+            self._view = view = self._snapshot()
         return view
 
     # -- observability -------------------------------------------------------
@@ -518,9 +915,12 @@ class Resharder:
         debt the bench gates at 0), ``reshard_prune_debt`` (stale copies
         whose delete could not land yet — space, not correctness; retried
         on later passes without blocking convergence),
-        ``reshard_last_pass_ms``."""
+        ``reshard_last_pass_ms``, and ``reshard_catalog_roots`` (live root
+        records in the cluster's catalog — the knowledge a crash-restart
+        recovers from the durable journal, docs/membership.md)."""
         out = dict(self._c)
         out["reshard_active"] = 1 if self._active else 0
+        out["reshard_catalog_roots"] = len(getattr(self.cluster, "_catalog", ()))
         return out
 
     # -- worker --------------------------------------------------------------
@@ -564,6 +964,15 @@ class Resharder:
         self._c["reshard_passes"] += 1
         self._c["reshard_planned_roots"] += len(tasks)
         self._c["reshard_debt_roots"] = len(tasks)
+        # Journal the pass (docs/membership.md, durability): a restarted
+        # client sees an OPEN plan record (no matching "fin") and resumes
+        # the migration from the journaled catalog instead of waiting for
+        # the next transition. Holder updates per copied root double as
+        # the progress records — a replayed plan only contains the roots
+        # still missing copies.
+        journal = getattr(self.cluster, "journal_reshard_event", None)
+        if tasks and journal is not None:
+            journal("plan", epoch, len(tasks))
         debt = 0
         prune_debt = 0
         for k, task in enumerate(tasks):
@@ -591,6 +1000,18 @@ class Resharder:
         self._c["reshard_debt_roots"] = debt
         self._c["reshard_prune_debt"] = prune_debt
         if debt == 0:
+            if tasks and journal is not None:
+                # Close the journaled plan: this pass's copy debt drained
+                # (fin is about THIS process's migration work — the view
+                # may still be pending another process's finalize).
+                journal("fin", epoch, 0)
+            # Only the process that ORIGINATED the pending transition
+            # finalizes it: a gossip adopter's empty/partial catalog
+            # draining proves nothing about the originator's migration,
+            # and its view settles when the finalized epoch gossips back
+            # (docs/membership.md, gossip section).
+            if not membership.owns_transition and not membership.settled:
+                return debt
             # Guarded: only the epoch this pass PLANNED at may finalize —
             # a transition that landed after the plan (even against an
             # empty task list) must be re-planned, never rubber-stamped.
@@ -600,6 +1021,14 @@ class Resharder:
                     with self._cv:
                         self._dirty = True
                     return debt
+            # A drained pass supersedes its incremental records whether or
+            # not a finalize was pending (a mark_dead re-replication drains
+            # with the view ALREADY settled): compact so restarts replay a
+            # bounded snapshot with the final holder sets.
+            if tasks:
+                compact = getattr(self.cluster, "compact_journal", None)
+                if compact is not None:
+                    compact()
             # Finalizing bumps the epoch but creates no new delta (JOINING
             # and ACTIVE place identically; LEAVING was already out) — the
             # catalog may still have grown, so one more plan() confirms.

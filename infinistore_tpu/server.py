@@ -93,6 +93,7 @@ def _http_response(status: int, payload: dict) -> bytes:
 
 def _prometheus_text(stats: dict, membership_status: dict = None,
                      slo_status: dict = None, event_counts: dict = None,
+                     gossip_status: dict = None,
                      exemplars: bool = False) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
     reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
@@ -272,6 +273,8 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
         lines.append(f'infinistore_op_p99_latency_us{{op="{op}"}} {s["p99_us"]}')
     if membership_status is not None:
         lines += _membership_prometheus_lines(membership_status)
+    if gossip_status is not None:
+        lines += _gossip_prometheus_lines(gossip_status)
     if slo_status is not None:
         lines += _slo_prometheus_lines(slo_status)
     if event_counts is not None:
@@ -361,6 +364,51 @@ def _membership_prometheus_lines(ms: dict) -> list:
         f"infinistore_reshard_prune_debt {ms['reshard_prune_debt']}",
         "# TYPE infinistore_reshard_last_pass_ms gauge",
         f"infinistore_reshard_last_pass_ms {ms['reshard_last_pass_ms']}",
+        "# TYPE infinistore_reshard_catalog_roots gauge",
+        f"infinistore_reshard_catalog_roots {ms.get('reshard_catalog_roots', 0)}",
+        # Durable catalog + reshard journal (docs/membership.md, durability
+        # section): append/fsync/compaction volume plus what the last
+        # startup replay saw (torn tails discarded, checksum-bad records
+        # skipped). Zeros when the cluster runs without a journal.
+        "# TYPE infinistore_journal_records counter",
+        f"infinistore_journal_records {ms.get('journal_records', 0)}",
+        "# TYPE infinistore_journal_bytes gauge",
+        f"infinistore_journal_bytes {ms.get('journal_bytes', 0)}",
+        "# TYPE infinistore_journal_fsyncs counter",
+        f"infinistore_journal_fsyncs {ms.get('journal_fsyncs', 0)}",
+        "# TYPE infinistore_journal_compactions counter",
+        f"infinistore_journal_compactions {ms.get('journal_compactions', 0)}",
+        "# TYPE infinistore_journal_replay_records gauge",
+        f"infinistore_journal_replay_records {ms.get('journal_replay_records', 0)}",
+        "# TYPE infinistore_journal_replay_torn gauge",
+        f"infinistore_journal_replay_torn {ms.get('journal_replay_torn', 0)}",
+        "# TYPE infinistore_journal_replay_bad_checksum gauge",
+        f"infinistore_journal_replay_bad_checksum "
+        f"{ms.get('journal_replay_bad_checksum', 0)}",
+    ]
+
+
+def _gossip_prometheus_lines(gs: dict) -> list:
+    """Gossip anti-entropy gauge families for /metrics, from the flat
+    ``telemetry.GossipAgent.status`` snapshot. The counters checker
+    (ITS-C006) holds this exporter to the ``gossip_*`` status vocabulary
+    both ways (docs/membership.md, gossip section)."""
+    return [
+        "# TYPE infinistore_gossip_peers gauge",
+        f"infinistore_gossip_peers {gs['gossip_peers']}",
+        "# TYPE infinistore_gossip_rounds counter",
+        f"infinistore_gossip_rounds {gs['gossip_rounds']}",
+        "# TYPE infinistore_gossip_exchanges counter",
+        f"infinistore_gossip_exchanges {gs['gossip_exchanges']}",
+        "# TYPE infinistore_gossip_exchange_failures counter",
+        f"infinistore_gossip_exchange_failures {gs['gossip_exchange_failures']}",
+        "# TYPE infinistore_gossip_merges counter",
+        f'infinistore_gossip_merges{{dir="in"}} {gs["gossip_merges_in"]}',
+        f'infinistore_gossip_merges{{dir="out"}} {gs["gossip_merges_out"]}',
+        "# TYPE infinistore_gossip_last_epoch_seen gauge",
+        f"infinistore_gossip_last_epoch_seen {gs['gossip_last_epoch_seen']}",
+        "# TYPE infinistore_gossip_last_round_ms gauge",
+        f"infinistore_gossip_last_round_ms {gs['gossip_last_round_ms']}",
     ]
 
 
@@ -497,7 +545,8 @@ class ManageServer:
     they are closed on the next control-plane request — HTTP-driven
     join/leave churn never accumulates native connections."""
 
-    def __init__(self, config: ServerConfig, cluster=None, scraper=None):
+    def __init__(self, config: ServerConfig, cluster=None, scraper=None,
+                 gossip=None):
         self.config = config
         self.cluster = cluster
         # Fleet telemetry (docs/observability.md): an attached
@@ -506,6 +555,12 @@ class ManageServer:
         # ``/slo`` and ``/events`` themselves serve the process-wide SLO
         # engine and event journal and need no scraper.
         self.scraper = scraper
+        # Crash-safe coordination (docs/membership.md): an attached
+        # ``telemetry.GossipAgent`` adds its ``infinistore_gossip_*``
+        # families to /metrics. The ``POST /gossip`` + ``GET /bootstrap``
+        # routes need only the cluster — a peer can exchange views with a
+        # process that runs no agent of its own.
+        self.gossip = gossip
         self._server = None
         # member_id -> InfinityConnection this manage plane connected
         # (POST add); swept once the member goes terminal.
@@ -570,6 +625,7 @@ class ManageServer:
                     self.cluster.membership_status()
                     if self.cluster is not None else None
                 )
+                gs = self.gossip.status() if self.gossip is not None else None
                 params = urllib.parse.parse_qs(query)
                 slo = telemetry.slo_engine().status()
                 counts = telemetry.get_journal().counts()
@@ -584,6 +640,7 @@ class ManageServer:
                         raise
                     lines = (
                         _membership_prometheus_lines(ms)
+                        + (_gossip_prometheus_lines(gs) if gs is not None else [])
                         + _slo_prometheus_lines(slo)
                         + _events_prometheus_lines(counts)
                     )
@@ -596,7 +653,7 @@ class ManageServer:
                     ).encode() + body
                 return _prometheus_text(
                     stats, membership_status=ms, slo_status=slo,
-                    event_counts=counts,
+                    event_counts=counts, gossip_status=gs,
                     exemplars=params.get("exemplars") == ["1"],
                 )
             if path == "/health" and method == "GET":
@@ -662,9 +719,13 @@ class ManageServer:
                 return self._membership_get()
             if path == "/membership" and method == "POST":
                 return await self._membership_post(body)
+            if path == "/gossip" and method == "POST":
+                return await self._gossip_post(body)
+            if path == "/bootstrap" and method == "GET":
+                return await self._bootstrap_get(query)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
                         "/selftest", "/health", "/trace", "/membership",
-                        "/slo", "/events"):
+                        "/slo", "/events", "/gossip", "/bootstrap"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request
@@ -708,6 +769,21 @@ class ManageServer:
             **self.cluster.membership_status(),
         })
 
+    def _structured_error(self, status: int, reason: str,
+                          detail: str = "") -> bytes:
+        """Structured JSON error body for the membership/gossip/bootstrap
+        control surface: machine-readable ``reason`` plus the CURRENT
+        epoch, so a stale gossiping peer (or a retrying operator script)
+        can self-correct from the response instead of parsing prose
+        (docs/membership.md)."""
+        epoch = (
+            self.cluster.membership.view().epoch
+            if self.cluster is not None else 0
+        )
+        return _http_response(status, {
+            "error": detail or reason, "reason": reason, "epoch": epoch,
+        })
+
     async def _membership_post(self, body: bytes) -> bytes:
         """POST /membership: apply one membership transition.
 
@@ -716,35 +792,103 @@ class ManageServer:
         (connect runs in a worker thread — the control plane must not block
         on a TCP connect, ITS-L001); ``{"action": "remove"|"mark_dead",
         "member_id": ...}`` drains / writes off an existing member. Returns
-        the new epoch + status; transition errors are 400s."""
+        the new epoch + status; errors are 400s with a structured body
+        (``reason`` + current ``epoch``)."""
         if self.cluster is None:
-            return _http_response(400, {"error": "no cluster attached"})
+            return self._structured_error(400, "no_cluster",
+                                          "no cluster attached")
         try:
             req = json.loads(body.decode() or "{}")
-            action = req.get("action")
+        except ValueError as e:
+            return self._structured_error(400, "bad_json", repr(e))
+        action = req.get("action")
+        try:
             if action == "add":
                 view = await asyncio.to_thread(
                     self._add_member_blocking, req
                 )
             elif action in ("remove", "mark_dead"):
-                member_id = req["member_id"]
+                if "member_id" not in req:
+                    return self._structured_error(
+                        400, "missing_field", "member_id required"
+                    )
                 fn = (
                     self.cluster.remove_member if action == "remove"
                     else self.cluster.mark_dead
                 )
-                view = fn(member_id)
+                view = fn(req["member_id"])
             else:
-                return _http_response(
-                    400, {"error": f"unknown action {action!r}"}
+                return self._structured_error(
+                    400, "unknown_action", f"unknown action {action!r}"
                 )
-        except (KeyError, ValueError, TypeError) as e:
-            return _http_response(400, {"error": repr(e)})
+        except KeyError as e:
+            # "add" without host/service_port, or a transition against a
+            # member id the view does not know.
+            reason = "missing_field" if action == "add" else "invalid_transition"
+            return self._structured_error(400, reason, repr(e))
+        except ValueError as e:
+            # Rejected transitions (duplicate live id, bad state, last
+            # placement member): the epoch in the body tells the caller
+            # what view the rejection was judged against.
+            return self._structured_error(400, "invalid_transition", repr(e))
+        except TypeError as e:
+            return self._structured_error(400, "bad_payload", repr(e))
         self._sweep_owned_conns()
         return _http_response(200, {
             "status": "ok",
             "epoch": view.epoch,
             **self.cluster.membership_status(),
         })
+
+    async def _gossip_post(self, body: bytes) -> bytes:
+        """POST /gossip: one half of an anti-entropy exchange
+        (docs/membership.md, gossip section). The sender's epoch-stamped
+        view merges into ours through the tombstone-aware lattice (off
+        the event loop — a merge may dial a newly learned member); the
+        response carries OUR post-merge view, which the sender merges
+        back — so a single exchange converges both processes in either
+        direction, and a stale sender self-corrects from the body.
+        Errors are structured (``reason`` + current ``epoch``)."""
+        if self.cluster is None:
+            return self._structured_error(400, "no_cluster",
+                                          "no cluster attached")
+        try:
+            req = json.loads(body.decode() or "{}")
+        except ValueError as e:
+            return self._structured_error(400, "bad_json", repr(e))
+        try:
+            merged = await asyncio.to_thread(
+                self.cluster.merge_remote_view, req
+            )
+        except (KeyError, ValueError, TypeError) as e:
+            return self._structured_error(400, "bad_payload", repr(e))
+        self._sweep_owned_conns()
+        return _http_response(200, {
+            "status": "ok",
+            "merged": bool(merged),
+            **self.cluster.gossip_payload(),
+        })
+
+    async def _bootstrap_get(self, query: str) -> bytes:
+        """GET /bootstrap: the cold-client snapshot — the epoch-stamped
+        view plus a bounded catalog dump (root records with holder
+        block-levels), enough for a fresh process with only a seed list
+        to reconstruct placement from any live member
+        (``ClusterKVConnector.bootstrap``). ``?limit=N`` bounds the
+        catalog rows (default 4096; ``catalog_total`` reports the full
+        size). Runs off-loop — the catalog walk is O(n_roots)."""
+        if self.cluster is None:
+            return self._structured_error(400, "no_cluster",
+                                          "no cluster attached")
+        params = urllib.parse.parse_qs(query)
+        try:
+            limit = int(params.get("limit", ["4096"])[0])
+        except ValueError:
+            return self._structured_error(400, "bad_limit", "bad limit")
+        payload = await asyncio.to_thread(
+            self.cluster.bootstrap_payload, limit
+        )
+        return _http_response(200, {"enabled": True, **payload})
 
     def _add_member_blocking(self, req: dict):
         """Connect + admit a new member (worker-thread half of POST add)."""

@@ -66,6 +66,8 @@ EVENT_KINDS = (
     "slow_op",            # watchdog captured an over-threshold span tree
     "qos_aging_storm",    # bg aging escapes crossed the storm threshold
     "slo_alert",          # burn-rate alert fired or cleared (edge)
+    "gossip_round",       # one anti-entropy peer-exchange round completed
+    "client_restart",     # a crashed client replayed its durable journal
 )
 
 _DEFAULT_JOURNAL_CAPACITY = 512
@@ -776,6 +778,196 @@ class FleetScraper:
             "scrapes_total": self.scrapes_total,
             "scrape_failures_total": self.scrape_failures_total,
             "members": members,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Gossip agent: anti-entropy membership exchange over the manage plane.
+# ---------------------------------------------------------------------------
+
+class GossipAgent:
+    """Anti-entropy membership exchange between cluster-client processes
+    (docs/membership.md, gossip section).
+
+    Each client process that owns a ``ClusterKVConnector`` runs one agent.
+    A round POSTs the cluster's ``gossip_payload()`` (epoch-stamped view
+    with per-entry incarnation stamps) to each admitted peer's manage
+    plane (``POST /gossip``); the peer merges it through the tombstone-
+    aware lattice and answers with ITS post-merge view, which this agent
+    merges back — one exchange is **push-pull**, so an epoch bump on
+    either side converges in a single round in either direction, with no
+    operator POSTing ``/membership`` to every process.
+
+    Peer discipline is the :class:`FleetScraper`'s, reusing
+    :class:`_TargetState`: a peer that keeps failing is skipped until its
+    backoff elapses (one probe per window — a dead peer costs one timeout
+    per window, not one per round). Rounds are journaled as
+    ``gossip_round`` events (with the active trace id where one exists)
+    and counted in the ``gossip_*`` vocabulary :meth:`status` returns —
+    exported as ``infinistore_gossip_*`` on /metrics and held in lockstep
+    by ITS-C006.
+    """
+
+    def __init__(self, cluster, peers: Sequence[Tuple[str, str, int]] = (),
+                 interval_s: float = 1.0, timeout_s: float = 2.0,
+                 fail_threshold: int = 3, backoff_s: float = 10.0,
+                 journal: Optional[EventJournal] = None,
+                 clock=time.monotonic):
+        """``peers``: ``(peer_id, host, manage_port)`` triples — the seed
+        list of OTHER client processes' manage planes (not store service
+        ports)."""
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.fail_threshold = fail_threshold
+        self.backoff_s = backoff_s
+        self.journal = journal if journal is not None else get_journal()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._targets: List[_TargetState] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.rounds = 0
+        self.exchanges = 0
+        self.exchange_failures = 0
+        self.merges_in = 0   # this process adopted a peer's knowledge
+        self.merges_out = 0  # a peer adopted ours (its response said so)
+        self.last_epoch_seen = 0
+        self.last_round_ms = 0.0
+        for p in peers:
+            self.add_peer(*p)
+
+    def add_peer(self, peer_id: str, host: str, manage_port: int):
+        with self._lock:
+            self._targets.append(_TargetState(peer_id, host, manage_port))
+
+    def _post_gossip(self, st: _TargetState, payload: dict) -> dict:
+        url = f"http://{st.host}:{st.manage_port}/gossip"
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read(4 << 20))
+
+    def exchange_once(self) -> dict:
+        """One gossip round over every admitted peer (blocking HTTP —
+        callers keep this off the event loop; the background thread and
+        tests drive it). Returns ``{"ok", "failed", "skipped",
+        "adopted"}`` and journals one ``gossip_round`` event."""
+        t0 = self._clock()
+        payload = self.cluster.gossip_payload()
+        ok = failed = skipped = 0
+        adopted = 0
+        with self._lock:
+            targets = list(self._targets)
+        for st in targets:
+            now = self._clock()
+            if (
+                st.consecutive_failures >= self.fail_threshold
+                and now < st.skip_until
+            ):
+                skipped += 1
+                continue
+            try:
+                doc = self._post_gossip(st, payload)
+                self.exchanges += 1
+                if doc.get("merged"):
+                    self.merges_out += 1
+                self.last_epoch_seen = max(
+                    self.last_epoch_seen, int(doc.get("epoch", 0))
+                )
+                # The pull half: merge the peer's (post-merge) view. A
+                # stale view of OURS comes back corrected here — the
+                # structured response body is the self-correction channel.
+                if doc.get("members") and self.cluster.merge_remote_view(doc):
+                    adopted += 1
+                    self.merges_in += 1
+                    payload = self.cluster.gossip_payload()
+                with self._lock:
+                    st.consecutive_failures = 0
+                    st.last_ok_at = now
+                    st.scrapes += 1
+                ok += 1
+            # Broad like the scraper: a peer answering with an unexpected
+            # shape (or a structured 4xx error body) must count against
+            # THAT peer's breaker, not abort the round.
+            except Exception as e:
+                failed += 1
+                self.exchange_failures += 1
+                with self._lock:
+                    st.failures += 1
+                    st.consecutive_failures += 1
+                    st.last_error = repr(e)
+                    if st.consecutive_failures >= self.fail_threshold:
+                        st.skip_until = self._clock() + self.backoff_s
+        self.rounds += 1
+        self.last_round_ms = round((self._clock() - t0) * 1e3, 3)
+        epoch = int(self.cluster.membership.view().epoch)
+        self.last_epoch_seen = max(self.last_epoch_seen, epoch)
+        self.journal.emit(
+            "gossip_round", epoch=epoch, peers_ok=ok, peers_failed=failed,
+            peers_skipped=skipped, adopted=adopted,
+        )
+        return {"ok": ok, "failed": failed, "skipped": skipped,
+                "adopted": adopted}
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self):
+        """Exchange every ``interval_s`` on a daemon thread, starting
+        immediately (a cold process converges on the fleet epoch within
+        its first round, not after a full interval)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="its-gossip", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                self.exchange_once()
+            except Exception:
+                # One malformed local payload must not kill anti-entropy;
+                # per-peer failures are already counted in exchange_once.
+                self.exchange_failures += 1
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # -- read side -----------------------------------------------------------
+
+    def status(self) -> dict:
+        """Flat ``gossip_*`` snapshot for /membership-adjacent dashboards
+        and the ``infinistore_gossip_*`` /metrics families (ITS-C006).
+
+        Keys: ``gossip_peers`` (admitted targets), ``gossip_rounds``,
+        ``gossip_exchanges`` (successful peer POSTs),
+        ``gossip_exchange_failures``, ``gossip_merges_in`` (rounds where
+        this process adopted peer knowledge), ``gossip_merges_out``
+        (peers that adopted ours), ``gossip_last_epoch_seen``,
+        ``gossip_last_round_ms``."""
+        with self._lock:
+            peers = len(self._targets)
+        return {
+            "gossip_peers": peers,
+            "gossip_rounds": self.rounds,
+            "gossip_exchanges": self.exchanges,
+            "gossip_exchange_failures": self.exchange_failures,
+            "gossip_merges_in": self.merges_in,
+            "gossip_merges_out": self.merges_out,
+            "gossip_last_epoch_seen": self.last_epoch_seen,
+            "gossip_last_round_ms": self.last_round_ms,
         }
 
 

@@ -760,6 +760,11 @@ class Resharder:
         out = dict(self._c)
         out["reshard_active"] = 0
         return out
+
+
+class DurableLog:
+    def status(self):
+        return {"journal_records": 0, "journal_replay_torn": 0}
 '''
 
 C005_MANAGE_OK = '''\
@@ -770,9 +775,12 @@ def _membership_prometheus_lines(ms):
         f"c {ms['reshard_moved_roots']}",
         f"d {ms['reshard_debt_roots']}",
         f"e {ms['reshard_active']}",
+        f"f {ms.get('journal_records', 0)}",
+        f"g {ms.get('journal_replay_torn', 0)}",
     ]
 
-route = "/membership"  # served from membership_status
+route = "/membership"   # served from membership_status
+route2 = "/bootstrap"   # served from bootstrap_payload
 '''
 
 
@@ -808,6 +816,20 @@ class TestCountersMembership:
         found = self.scan(tmp_path, manage)
         assert any(f.key.endswith("membership-route") for f in found)
 
+    def test_unexported_journal_key_fires(self, tmp_path):
+        manage = C005_MANAGE_OK.replace(
+            "        f\"g {ms.get('journal_replay_torn', 0)}\",\n", "")
+        found = self.scan(tmp_path, manage)
+        assert any(
+            f.rule == "ITS-C005" and f.key.endswith("journal_replay_torn")
+            for f in found
+        )
+
+    def test_missing_bootstrap_route_fires(self, tmp_path):
+        manage = C005_MANAGE_OK.replace("bootstrap_payload", "nothing")
+        found = self.scan(tmp_path, manage)
+        assert any(f.key.endswith("bootstrap-route") for f in found)
+
     def test_real_membership_counters_are_clean(self):
         ctx = core.Context(str(REPO))
         found = [f for f in counters.scan(ctx) if f.rule == "ITS-C005"]
@@ -834,6 +856,11 @@ class SloEngine:
         }
 
 
+class GossipAgent:
+    def status(self):
+        return {"gossip_rounds": 0, "gossip_merges_in": 0}
+
+
 def emit(kind, **attrs):
     pass
 
@@ -854,12 +881,23 @@ def _slo_prometheus_lines(slo):
         f"b {slo['slo_burn_rate_max']}",
     ]
 
+
+def _gossip_prometheus_lines(gs):
+    return [
+        f"a {gs['gossip_rounds']}",
+        f"b {gs['gossip_merges_in']}",
+    ]
+
 route_a = "/slo"      # served from telemetry.slo_engine
 route_b = "/events"   # served from telemetry.get_journal
-served = (slo_engine, get_journal)
+route_c = "/gossip"   # served through cluster.merge_remote_view
+served = (slo_engine, get_journal, merge_remote_view)
 '''
 
-C006_DOCS = "table: breaker_open membership_epoch slo_availability slo_burn_rate_max\n"
+C006_DOCS = (
+    "table: breaker_open membership_epoch slo_availability "
+    "slo_burn_rate_max gossip_rounds gossip_merges_in\n"
+)
 
 
 class TestCountersTelemetry:
@@ -927,6 +965,33 @@ class TestCountersTelemetry:
         manage = C006_MANAGE_OK.replace("get_journal", "no_journal")
         found = self.scan(tmp_path, manage_src=manage)
         assert any(f.key.endswith("events-route") for f in found)
+
+    def test_unexported_gossip_key_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace(
+            "        f\"b {gs['gossip_merges_in']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.rule == "ITS-C006" and f.key.endswith("gossip:gossip_merges_in")
+            for f in found
+        )
+
+    def test_stale_gossip_exporter_key_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace("gossip_merges_in", "gossip_gone")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("gossip-stale:gossip_gone") for k in keys)
+        assert any(k.endswith("gossip:gossip_merges_in") for k in keys)
+
+    def test_undocumented_gossip_key_fires(self, tmp_path):
+        docs = C006_DOCS.replace("gossip_rounds", "")
+        found = self.scan(tmp_path, docs=docs)
+        assert any(
+            f.key.endswith("undocumented:gossip_rounds") for f in found
+        )
+
+    def test_missing_gossip_route_fires(self, tmp_path):
+        manage = C006_MANAGE_OK.replace("merge_remote_view", "nothing")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("gossip-route") for f in found)
 
     def test_real_telemetry_vocabulary_is_clean(self):
         ctx = core.Context(str(REPO))

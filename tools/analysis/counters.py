@@ -68,6 +68,8 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/engine.py", "ContinuousBatchingHarness.metrics"),
     ("infinistore_tpu/membership.py", "Membership.status"),
     ("infinistore_tpu/membership.py", "Resharder.progress"),
+    ("infinistore_tpu/membership.py", "DurableLog.status"),
+    ("infinistore_tpu/telemetry.py", "GossipAgent.status"),
 ]
 
 # The elastic-membership status snapshot (ITS-C005): the dict-literal
@@ -78,6 +80,7 @@ MEMBERSHIP_LEDGERS: List[str] = [
     "Membership.status",
     "Resharder.__init__",  # the reshard_* counter dict literal
     "Resharder.progress",
+    "DurableLog.status",   # the journal_* durability counters
 ]
 MEMBERSHIP_EXPORT_FN = "_membership_prometheus_lines"
 
@@ -89,6 +92,11 @@ MEMBERSHIP_EXPORT_FN = "_membership_prometheus_lines"
 TELEMETRY_REL = "infinistore_tpu/telemetry.py"
 TELEMETRY_SLO_LEDGER = "SloEngine.status"
 SLO_EXPORT_FN = "_slo_prometheus_lines"
+# The gossip anti-entropy agent (docs/membership.md, gossip section): its
+# gossip_* status vocabulary must reach the /metrics gossip exporter both
+# ways, same discipline as the SLO keys.
+TELEMETRY_GOSSIP_LEDGER = "GossipAgent.status"
+GOSSIP_EXPORT_FN = "_gossip_prometheus_lines"
 TELEMETRY_DOCS_REL = "docs/observability.md"
 TELEMETRY_PACKAGE_REL = "infinistore_tpu"
 
@@ -508,6 +516,46 @@ def _scan_telemetry(
                 key=f"ITS-C006:{telemetry_rel}:undocumented:{key}",
             ))
 
+    # -- gossip_* status keys vs the exporter + docs ------------------------
+    gossip_keys, gossip_line = ledger_keys(
+        ctx, telemetry_rel, TELEMETRY_GOSSIP_LEDGER
+    )
+    gossip_keys = {k.rsplit(".", 1)[-1] for k in gossip_keys}
+    gossip_keys = {k for k in gossip_keys if k.startswith("gossip_")}
+    gossip_consumed = {
+        k for k in metrics_consumed_keys(
+            ctx, manage_rel, fn_name=GOSSIP_EXPORT_FN
+        )
+        if k.startswith("gossip_")
+    }
+    if gossip_keys or gossip_consumed:
+        for key in sorted(gossip_keys - gossip_consumed):
+            findings.append(Finding(
+                rule="ITS-C006", file=manage_rel, line=1,
+                message=f"gossip status key {key!r} is not exported by the "
+                        f"/metrics gossip exporter ({GOSSIP_EXPORT_FN}) — "
+                        "anti-entropy health dashboards cannot see is "
+                        "observability drift (docs/membership.md)",
+                key=f"ITS-C006:{manage_rel}:gossip:{key}",
+            ))
+        for key in sorted(gossip_consumed - gossip_keys):
+            findings.append(Finding(
+                rule="ITS-C006", file=manage_rel, line=1,
+                message=f"/metrics gossip exporter consumes key {key!r} "
+                        f"which {TELEMETRY_GOSSIP_LEDGER} no longer emits "
+                        "(KeyError at scrape time)",
+                key=f"ITS-C006:{manage_rel}:gossip-stale:{key}",
+            ))
+        for key in sorted(gossip_keys):
+            if key not in doc_words:
+                findings.append(Finding(
+                    rule="ITS-C006", file=telemetry_rel, line=gossip_line,
+                    message=f"gossip status key {key!r} is undocumented in "
+                            f"{docs_rel} — the gossip vocabulary must "
+                            "enumerate it",
+                    key=f"ITS-C006:{telemetry_rel}:undocumented:{key}",
+                ))
+
     # -- event kinds vs producers + docs ------------------------------------
     kinds = _event_kinds(ctx, telemetry_rel)
     produced: Dict[str, List[Tuple[str, int]]] = {}
@@ -562,6 +610,17 @@ def _scan_telemetry(
                     "docs/observability.md)",
             key=f"ITS-C006:{manage_rel}:events-route",
         ))
+    if (
+        not re.search(r'[\'"]/gossip[\'"]', manage_src)
+        or "merge_remote_view" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C006", file=manage_rel, line=1,
+            message="manage plane must serve POST /gossip through the "
+                    "cluster's merge_remote_view (the anti-entropy epoch "
+                    "exchange, docs/membership.md)",
+            key=f"ITS-C006:{manage_rel}:gossip-route",
+        ))
     return findings
 
 
@@ -580,6 +639,7 @@ def _scan_membership(
     status_keys = {
         k for k in status_keys
         if k.startswith("membership_") or k.startswith("reshard_")
+        or k.startswith("journal_")
     }
     consumed = metrics_consumed_keys(
         ctx, manage_rel, fn_name=MEMBERSHIP_EXPORT_FN
@@ -613,6 +673,17 @@ def _scan_membership(
                     "elastic-membership control surface "
                     "(docs/membership.md)",
             key=f"ITS-C005:{manage_rel}:membership-route",
+        ))
+    if (
+        not re.search(r'[\'"]/bootstrap[\'"]', manage_src)
+        or "bootstrap_payload" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C005", file=manage_rel, line=1,
+            message="manage plane must serve GET /bootstrap from the "
+                    "cluster's bootstrap_payload — the cold-client "
+                    "placement snapshot (docs/membership.md)",
+            key=f"ITS-C005:{manage_rel}:bootstrap-route",
         ))
     return findings
 

@@ -408,6 +408,96 @@ CHECKS = [
             "wave (must be > 1.0, paired-interleaved)"
         ),
     ),
+    # Crash-safe fleet coordination (ROADMAP-3, docs/membership.md), four
+    # gates over the recovery leg's REAL-subprocess flow. Convergence is
+    # binary: the client that kill -9'd itself mid-reshard (rc must be
+    # SIGKILL's -9) restarts, resumes, and settles with zero debt; the
+    # cold bootstrap client's sweep returns correct bytes for EVERY root
+    # (with R=2 and a completed reshard a miss is never legitimate).
+    Check(
+        "recovery_convergence",
+        ["recovery_converged", "recovery_debt", "recovery_crash_rc",
+         "recovery_wrong_reads", "recovery_misses"],
+        lambda m: (
+            m["recovery_converged"] == 1
+            and m["recovery_debt"] == 0
+            and m["recovery_crash_rc"] == -9
+            and m["recovery_wrong_reads"] == 0
+            and m["recovery_misses"] == 0
+        ),
+        lambda m: (
+            f"kill -9 (rc={m['recovery_crash_rc']:.0f}) mid-reshard -> "
+            f"restart converged={m['recovery_converged']:.0f} with "
+            f"debt={m['recovery_debt']:.0f}; bootstrap sweep "
+            f"wrong={m['recovery_wrong_reads']:.0f} "
+            f"misses={m['recovery_misses']:.0f} (must be 1/0/0/0)"
+        ),
+    ),
+    # The RESUME property: the journal replay recovered every saved root,
+    # flagged the in-flight reshard, and the restarted process moved only
+    # the REMAINING debt — crash_moved + resumed equals the independently
+    # computed rendezvous delta (+-1 for a root legitimately in flight at
+    # the crash edge). A restart that re-copied everything (moved_total ~=
+    # crash + delta) or replanned from zero knowledge (replayed_roots 0)
+    # fails.
+    Check(
+        "recovery_journal_resume",
+        ["recovery_replayed_roots", "recovery_roots", "recovery_resume_flag",
+         "recovery_resumed_moved_roots", "recovery_moved_total",
+         "recovery_delta_roots"],
+        lambda m: (
+            m["recovery_replayed_roots"] == m["recovery_roots"]
+            and m["recovery_resume_flag"] == 1
+            and m["recovery_resumed_moved_roots"] >= 1
+            and abs(m["recovery_moved_total"] - m["recovery_delta_roots"]) <= 1
+        ),
+        lambda m: (
+            f"replayed {m['recovery_replayed_roots']:.0f}/"
+            f"{m['recovery_roots']:.0f} roots, resume_flag="
+            f"{m['recovery_resume_flag']:.0f}, moved "
+            f"{m['recovery_moved_total']:.0f} total vs rendezvous delta "
+            f"{m['recovery_delta_roots']:.0f} (resumed "
+            f"{m['recovery_resumed_moved_roots']:.0f} post-restart — must "
+            "resume the remainder, not re-copy from zero)"
+        ),
+    ),
+    # Gossip anti-entropy: the epoch bump must reach the second client
+    # process with NO manage-plane POST to it, and that process must
+    # settle on the final view. Times are reported (the describe line is
+    # the receipt) but not threshold-gated — wall-clock on this host is
+    # weather; the binary convergence flag is the invariant.
+    Check(
+        "recovery_gossip",
+        ["recovery_gossip_converged", "recovery_gossip_propagate_s",
+         "recovery_gossip_settle_s", "recovery_bootstrap_members"],
+        lambda m: (
+            m["recovery_gossip_converged"] == 1
+            and m["recovery_gossip_propagate_s"] > 0
+            and m["recovery_bootstrap_members"] >= 4
+        ),
+        lambda m: (
+            f"epoch reached peer via gossip alone in "
+            f"{m['recovery_gossip_propagate_s']:.3f}s, settled 4-member "
+            f"view in {m['recovery_gossip_settle_s']:.3f}s; cold bootstrap "
+            f"saw {m['recovery_bootstrap_members']:.0f} members (must "
+            "converge with zero manage-plane help)"
+        ),
+    ),
+    # Journal write-path overhead, paired-interleaved per the weather rule
+    # (min(median-of-ratios, ratio-of-sums) over order-alternating save
+    # sweeps): the durable catalog must cost <= 10% of save throughput —
+    # an fsync-per-record regression or an O(catalog) append would blow
+    # far past this.
+    Check(
+        "recovery_journal_overhead",
+        ["recovery_journal_overhead_cost"],
+        lambda m: m["recovery_journal_overhead_cost"] <= 0.10,
+        lambda m: (
+            f"durable journal costs "
+            f"{100 * m['recovery_journal_overhead_cost']:.2f}% of save "
+            "throughput (paired-interleaved; must be <= 10%)"
+        ),
+    ),
     Check(
         "async_bridge_overhead",
         ["p50_fetch_4k_us", "sync_p50_fetch_4k_us"],

@@ -47,9 +47,10 @@ SURFACE = [
     ]),
     ("infinistore_tpu.membership", [
         "MemberState", "MembershipView", "Membership", "Resharder",
+        "DurableLog",
     ]),
     ("infinistore_tpu.faults", [
-        "FaultRule", "FaultyConnection", "kill_transport",
+        "FaultRule", "FaultyConnection", "kill_transport", "crash_process",
     ]),
     ("infinistore_tpu.tracing", [
         "configure", "enabled", "recorder", "Span", "FlightRecorder",
@@ -58,6 +59,7 @@ SURFACE = [
     ]),
     ("infinistore_tpu.telemetry", [
         "EventJournal", "SloObjective", "SloEngine", "FleetScraper",
+        "GossipAgent",
         "default_objectives", "cluster_spans", "cluster_chrome_events",
         "get_journal", "emit", "slo_engine", "configure_slo",
         "note_qos_aged",
