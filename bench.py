@@ -2177,6 +2177,265 @@ def _membership_churn_metrics(its, np) -> dict:
             s.stop()
 
 
+def _tiering_metrics(its, np) -> dict:
+    """Tiered capacity plane receipt (ROADMAP-4, docs/tiering.md): a Zipf
+    working set 4x the serving-RAM budget over a 2-serving + 1-cold pool,
+    against an all-RAM reference pool of the same shape.
+
+    Figures of merit (gated in tools/bench_check.py):
+
+    - ``tiering_hot_p99_ratio``: hot-set load p99 on the TIERED pool /
+      the ALL-RAM pool — the temperature plane must leave the hot path
+      alone. Sampled per the weather rule: order-alternating paired
+      rounds over the two LIVE pools, min(median-of-ratios,
+      ratio-of-sums) estimator (this single-core host swings ~2x between
+      seconds; unpaired sampling would gate weather, not tiering).
+    - ``tiering_cold_vs_spill_floor``: pooled-cold read throughput vs
+      the SAME roots read moments earlier from the serving members'
+      local spill — the cold tier must land above the spill floor (a
+      per-key fallback storm or a broken batched path reads far below).
+    - ``tiering_demotions`` / ``tiering_promotions`` nonzero BOTH
+      directions, ``tiering_wrong_reads`` == 0 and ``tiering_misses``
+      == 0: every byte served from whatever tier, correctly.
+
+    The temperature clock is injected (sketch time advances by script,
+    not sleeps), so the leg is deterministic and fast; data-plane time is
+    real.
+    """
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from infinistore_tpu.cluster import ClusterKVConnector
+    from infinistore_tpu.tiering import TierPolicy, TierPolicyConfig
+    from infinistore_tpu.tpu import PagedKVCacheSpec, gather_blocks
+
+    spec = PagedKVCacheSpec(
+        num_layers=2, num_blocks=16, block_tokens=8, num_kv_heads=2,
+        head_dim=32, dtype=jnp.bfloat16,
+    )
+
+    def connect(port):
+        conn = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=port, log_level="error",
+        ))
+        conn.connect()
+        return conn
+
+    servers, conns = [], []
+    tiered = allram = None
+    try:
+        # Tiered pool: 2 serving members whose combined RAM (4MB) holds
+        # 1/4 of the working set (local spill takes the overflow), plus
+        # one RAM-roomy cold member OUTSIDE placement.
+        for _ in range(2):
+            srv = its.start_local_server(
+                prealloc_bytes=2 << 20, block_bytes=16 << 10,
+                spill_dir="/tmp", spill_bytes=64 << 20,
+            )
+            servers.append(srv)
+            conns.append(connect(srv.port))
+        cold_srv = its.start_local_server(
+            prealloc_bytes=64 << 20, block_bytes=16 << 10
+        )
+        servers.append(cold_srv)
+        conns.append(connect(cold_srv.port))
+        # All-RAM reference pool: same shape, everything fits in RAM.
+        for _ in range(2):
+            srv = its.start_local_server(
+                prealloc_bytes=64 << 20, block_bytes=16 << 10
+            )
+            servers.append(srv)
+            conns.append(connect(srv.port))
+
+        t_clock = [0.0]
+        policy = TierPolicy(
+            TierPolicyConfig(demote_idle_s=5.0, admit_min_streak=2,
+                             reuse_window_s=3.0, sketch_capacity=1024),
+            clock=lambda: t_clock[0],
+        )
+        tiered = ClusterKVConnector(
+            conns[:2], spec, "tier-bench", max_blocks=8,
+            cold_members=[conns[2]], tier_policy=policy,
+            tiering_interval_s=0,  # passes driven by the script
+        )
+        allram = ClusterKVConnector(
+            conns[3:5], spec, "tier-bench", max_blocks=8
+        )
+
+        # Working set: 128 roots x 8 server blocks (16KB each) = 16MB =
+        # 4x the tiered pool's 4MB serving RAM.
+        n_roots = 128
+        rng = np.random.default_rng(29)
+        prompts = [
+            rng.integers(0, 1000, size=2 * spec.block_tokens).tolist()
+            for _ in range(n_roots)
+        ]
+
+        def mk_caches(seed):
+            out = []
+            for layer in range(spec.num_layers):
+                k = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + layer), spec.cache_shape,
+                    jnp.float32,
+                ).astype(spec.dtype)
+                v = jax.random.normal(
+                    jax.random.PRNGKey(seed * 100 + 50 + layer),
+                    spec.cache_shape, jnp.float32,
+                ).astype(spec.dtype)
+                out.append((k, v))
+            return out
+
+        contents = {i: mk_caches(i) for i in range(n_roots)}
+        src = np.array([3, 9], np.int32)
+        for i, p in enumerate(prompts):
+            asyncio.run(tiered.save(p, contents[i], src))
+            asyncio.run(allram.save(p, contents[i], src))
+
+        wrong = misses = 0
+
+        def load_verify(cluster, i, verify=True):
+            nonlocal wrong, misses
+            dst = np.array([6, 2], np.int32)
+            t0 = time.perf_counter()
+            loaded, n = asyncio.run(
+                cluster.load(prompts[i], spec.make_caches(), dst)
+            )
+            dt = time.perf_counter() - t0
+            if n == 0:
+                misses += 1
+                return dt
+            if verify:
+                wrong += any(
+                    not np.array_equal(
+                        np.asarray(gather_blocks(
+                            loaded[layer][kind], jnp.asarray(dst)), np.float32),
+                        np.asarray(gather_blocks(
+                            contents[i][layer][kind], jnp.asarray(src)),
+                            np.float32),
+                    )
+                    for layer in range(spec.num_layers)
+                    for kind in (0, 1)
+                )
+            return dt
+
+        # Zipf access rounds feed the temperature sketch: the head is
+        # touched every round, the tail only when the Zipf draw lands on
+        # it — one-touch scans by construction.
+        hot = list(range(8))
+        zipf = rng.zipf(1.5, size=200)
+        for r in range(4):
+            t_clock[0] += 1.0
+            for i in hot:
+                tiered.lookup(prompts[i])
+            i = int(zipf[r] - 1)
+            if i < n_roots:
+                tiered.lookup(prompts[i])
+
+        # SPILL FLOOR: the tail is serving-resident right now, mostly in
+        # the serving members' local spill (16MB through 4MB of RAM).
+        tail_sample = list(range(16, 48))
+        t0 = time.perf_counter()
+        for i in tail_sample:
+            load_verify(tiered, i)
+        spill_dt = time.perf_counter() - t0
+
+        # Converge: the tail is idle past demote_idle_s, the head is not.
+        t_clock[0] += 6.0
+        for i in hot:
+            tiered.lookup(prompts[i])
+        demoted = 0
+        for _ in range(8):
+            got = tiered.tiering.run_pass()
+            demoted += got["demoted"]
+            if got["demoted"] == 0:
+                break
+
+        # COLD READS: the same tail roots, now served by the cold pool.
+        t_clock[0] += 1.0
+        t0 = time.perf_counter()
+        for i in tail_sample:
+            load_verify(tiered, i)
+        cold_dt = time.perf_counter() - t0
+
+        # Promotion-on-hit: those tail reads were touch #1 after a long
+        # gap (scans); a second in-window touch proves reuse and admits.
+        t_clock[0] += 1.0
+        promote_set = tail_sample[:4]
+        for i in promote_set:
+            tiered.lookup(prompts[i])
+        promoted = 0
+        for _ in range(4):
+            got = tiered.tiering.run_pass()
+            promoted += got["promoted"]
+            if got["promoted"] == 0 and promoted:
+                break
+
+        # HOT-SET p99, tiered vs all-RAM: order-alternating paired rounds
+        # over the two live pools; min(median-of-ratios, ratio-of-sums).
+        def hot_p99(cluster):
+            lats = []
+            for _ in range(3):
+                for i in hot:
+                    lats.append(load_verify(cluster, i) * 1e6)
+            return _pctl(lats, 0.99), sum(lats)
+
+        ratios, t_sums, a_sums = [], [], []
+        t_p99 = a_p99 = float("inf")
+        for rnd in range(4):
+            t_clock[0] += 0.1
+            order = (
+                [(tiered, "t"), (allram, "a")] if rnd % 2 == 0
+                else [(allram, "a"), (tiered, "t")]
+            )
+            got = {}
+            for cluster, tag in order:
+                got[tag] = hot_p99(cluster)
+            t_p99 = min(t_p99, got["t"][0])
+            a_p99 = min(a_p99, got["a"][0])
+            ratios.append(got["t"][0] / got["a"][0])
+            t_sums.append(got["t"][1])
+            a_sums.append(got["a"][1])
+        ratios.sort()
+        median_of_ratios = ratios[len(ratios) // 2]
+        ratio_of_sums = sum(t_sums) / sum(a_sums)
+        hot_ratio = min(median_of_ratios, ratio_of_sums)
+
+        st = tiered.tiering.status()
+        nbytes = len(tail_sample) * 2 * 2 * spec.num_layers * spec.block_nbytes
+        return {
+            "tiering_roots": n_roots,
+            "tiering_working_set_over_ram": 4.0,
+            "tiering_hot_p99_ratio": round(hot_ratio, 3),
+            "tiering_hot_p99_tiered_us": round(t_p99, 1),
+            "tiering_hot_p99_allram_us": round(a_p99, 1),
+            "tiering_spill_read_gbps": round(nbytes / spill_dt / (1 << 30), 4),
+            "tiering_cold_read_gbps": round(nbytes / cold_dt / (1 << 30), 4),
+            "tiering_cold_vs_spill_floor": round(spill_dt / cold_dt, 3),
+            "tiering_demotions": st["tier_demotions"],
+            "tiering_promotions": st["tier_promotions"],
+            "tiering_demoted_keys": st["tier_demoted_keys"],
+            "tiering_cold_hits": st["tier_cold_hits"],
+            "tiering_cold_read_p99_us": st["tier_cold_read_p99_us"],
+            "tiering_admit_rejects": st["tier_admit_rejects"],
+            "tiering_demotion_hits": st["tier_demotion_hits"],
+            "tiering_wrong_reads": wrong + st["tier_wrong_reads"],
+            "tiering_misses": misses,
+        }
+    finally:
+        for cl in (tiered, allram):
+            if cl is not None:
+                cl.close()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for s in servers:
+            s.stop()
+
+
 def _recovery_metrics(its, np) -> dict:
     """Crash-safe fleet coordination receipt (the ROADMAP-3 gate,
     docs/membership.md): durable catalog + reshard journal, gossip epoch
@@ -2517,6 +2776,7 @@ def main(argv=None) -> int:
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
     churn = _membership_churn_metrics(its, np)
+    tiering = _tiering_metrics(its, np)
     recovery = _recovery_metrics(its, np)
     try:
         tpu = _tpu_connector_gbps(its, np, conn)
@@ -2720,6 +2980,14 @@ def main(argv=None) -> int:
         "churn_bg_moved_bytes": churn["churn_bg_moved_bytes"],
         "churn_pruned_keys": churn["churn_pruned_keys"],
         "churn_lost_roots": churn["churn_lost_roots"],
+        # Tiered capacity plane (ROADMAP-4, docs/tiering.md): a Zipf
+        # working set 4x the serving-RAM budget over a tiered pool vs an
+        # all-RAM reference. Gated in tools/bench_check.py: hot-set load
+        # p99 within noise of the all-RAM run (order-alternating paired
+        # rounds, min(median-of-ratios, ratio-of-sums) — the weather
+        # rule), pooled-cold reads above the local-spill floor, nonzero
+        # demotion AND promotion, zero wrong reads / misses.
+        **tiering,
         # Crash-safe fleet coordination (ROADMAP-3, docs/membership.md):
         # a client subprocess kill -9'd mid-reshard resumes from its
         # durable journal and converges (0 debt, moved == rendezvous

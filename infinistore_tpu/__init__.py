@@ -17,6 +17,7 @@ from .config import (
     ServerConfig,
 )
 from .lib import (
+    InfiniStoreColdTier,
     InfiniStoreException,
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
@@ -72,6 +73,16 @@ def __getattr__(name):
         from . import membership
 
         return getattr(membership, name)
+    if name in (
+        "TierPolicy",
+        "TierPolicyConfig",
+        "TierManager",
+        "TemperatureSketch",
+        "TIERS",
+    ):
+        from . import tiering
+
+        return getattr(tiering, name)
     if name in ("FaultRule", "FaultyConnection", "kill_transport"):
         from . import faults
 
@@ -98,6 +109,11 @@ __all__ = [
     "MembershipView",
     "Membership",
     "Resharder",
+    "TierPolicy",
+    "TierPolicyConfig",
+    "TierManager",
+    "TemperatureSketch",
+    "TIERS",
     "FaultRule",
     "FaultyConnection",
     "kill_transport",
@@ -135,5 +151,7 @@ __all__ = [
     "InfiniStoreException",
     "InfiniStoreKeyNotFound",
     "InfiniStoreNoMatch",
+    "InfiniStoreResourcePressure",
+    "InfiniStoreColdTier",
     "evict_cache",
 ]

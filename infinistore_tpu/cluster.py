@@ -398,6 +398,10 @@ class ClusterKVConnector:
         journal_path: Optional[str] = None,
         dial_factory=None,
         fsync_interval_s: float = 0.05,
+        cold_members: Optional[Sequence] = None,
+        cold_member_ids: Optional[Sequence[str]] = None,
+        tier_policy=None,
+        tiering_interval_s: float = 1.0,
     ):
         """``member_factory(conn) -> KVConnector-shaped``: what each member
         runs over its connection — defaults to a plain ``KVConnector``; pass
@@ -433,7 +437,19 @@ class ClusterKVConnector:
         ``InfinityConnection`` (connect is best-effort — a down member
         materializes later through its breaker's probe heal).
 
-        ``fsync_interval_s``: the journal's bounded-fsync interval."""
+        ``fsync_interval_s``: the journal's bounded-fsync interval.
+
+        ``cold_members``: connections to capacity-only POOL members (the
+        tiered capacity plane, docs/tiering.md). Cold members are a ROLE,
+        not different software: they never join rendezvous placement,
+        never take foreground writes and never count toward ``replicas``
+        — they hold demoted copies shipped by the background
+        :class:`~.tiering.TierManager` (``self.tiering``), and reads fall
+        through to them when every serving tier misses. Each sits behind
+        its own circuit breaker. ``cold_member_ids`` names them
+        (``host:port`` default); ``tier_policy`` injects a custom
+        :class:`~.tiering.TierPolicy`; ``tiering_interval_s`` paces the
+        reconciler."""
         if not conns:
             raise ValueError("cluster needs at least one connection")
         if member_ids is None:
@@ -506,6 +522,51 @@ class ClusterKVConnector:
                 journal_path, fsync_interval_s=fsync_interval_s
             )
             self._replay_journal()
+        # Tiered capacity plane (docs/tiering.md): capacity-only cold
+        # members OUTSIDE placement, with their own breaker/health arrays
+        # (indices never mix with the membership-aligned serving arrays),
+        # plus the temperature-driven TierManager reconciler.
+        if cold_members is None:
+            cold_members = []
+        if cold_member_ids is None:
+            cold_member_ids = [
+                f"{c.config.host_addr}:{c.config.service_port}"
+                for c in cold_members
+            ]
+        if len(cold_member_ids) != len(cold_members):
+            raise ValueError(
+                f"{len(cold_member_ids)} cold_member_ids for "
+                f"{len(cold_members)} cold connections"
+            )
+        overlap = set(cold_member_ids) & set(self.member_ids)
+        if overlap or len(set(cold_member_ids)) != len(cold_member_ids):
+            raise ValueError(
+                f"cold_member_ids must be unique and disjoint from serving "
+                f"members (overlap: {sorted(overlap)})"
+            )
+        self.cold_ids: List[str] = list(cold_member_ids)
+        self.cold_members = [member_factory(c) for c in cold_members]
+        self.cold_index: Dict[str, int] = {
+            mid: j for j, mid in enumerate(self.cold_ids)
+        }
+        self._cold_health = [
+            _MemberHealth(breaker=breaker_factory(1000 + j))
+            for j in range(len(self.cold_ids))
+        ]
+        self.tiering = None
+        if self.cold_ids:
+            from .tiering import TierManager
+
+            self.tiering = TierManager(
+                self, policy=tier_policy,
+                interval_s=tiering_interval_s or 1.0,
+            )
+            if tiering_interval_s > 0:
+                # Production default: the periodic demotion/promotion
+                # worker runs from construction. tiering_interval_s=0
+                # keeps it manual — tests/bench drive run_pass()
+                # deterministically.
+                self.tiering.start()
 
     # -- routing -------------------------------------------------------------
 
@@ -596,6 +657,251 @@ class ClusterKVConnector:
                 ]
             ids = ids + extras
         return [self.member_index(m) for m in ids], failover
+
+    # -- tiered capacity plane (docs/tiering.md) -------------------------------
+
+    def cold_owner(self, root: str) -> Optional[str]:
+        """The rendezvous-chosen cold member for ``root`` (None without a
+        cold pool). Cold placement is independent of serving placement —
+        the same HRW stability argument applies: draining one cold member
+        remaps only the cold copies it held."""
+        if not self.cold_ids:
+            return None
+        return self.cold_ids[rendezvous_owner(self.cold_ids, root)]
+
+    def placement_for_root(self, root: str) -> List[str]:
+        """The ``replicas`` serving member ids for ``root`` under the
+        CURRENT view (HRW rank order) — the promotion targets."""
+        place = self.membership.view().placement_ids()
+        return self._ranked_ids(place, root)[: self.replicas]
+
+    def catalog_get(self, root: str) -> Optional[_RootRecord]:
+        """Snapshot one catalog record (tokens/blocks/holders copied)."""
+        # Audited: O(1) dict read + one record's holder-dict copy — the
+        # same lock discipline as _read_candidates (no O(n_roots) holder
+        # ever runs on an event loop).
+        with self._cat_lock:  # its: allow[ITS-L003]
+            rec = self._catalog.get(root)
+            if rec is None:
+                return None
+            return _RootRecord(
+                tokens=rec.tokens, blocks=rec.blocks, holders=dict(rec.holders)
+            )
+
+    def tier_member(self, member_id: str, cold: bool = False):
+        """Resolve a member connector by id on either plane (None when
+        unknown)."""
+        if cold:
+            j = self.cold_index.get(member_id)
+            return self.cold_members[j] if j is not None else None
+        try:
+            return self.members[self.member_index(member_id)]
+        except KeyError:
+            return None
+
+    def tier_begin(self, member_id: str, cold: bool = False) -> bool:
+        """Breaker admission by member id for the tier manager's copies:
+        True when the op may proceed. Serving-plane ids route through the
+        ordinary :meth:`_begin`; cold-plane ids through the cold health
+        array (same breaker discipline, same lock)."""
+        if not cold:
+            try:
+                i = self.member_index(member_id)
+            except KeyError:
+                return False
+            return self._begin(i) is not None
+        j = self.cold_index.get(member_id)
+        if j is None:
+            return False
+        return self._cold_begin(j) is not None
+
+    def tier_done(self, member_id: str, exc: Optional[BaseException],
+                  cold: bool = False):
+        """Record a tier-copy outcome against the right plane's breaker."""
+        if not cold:
+            try:
+                i = self.member_index(member_id)
+            except KeyError:
+                return
+            self._done(i, exc)
+            return
+        j = self.cold_index.get(member_id)
+        if j is not None:
+            self._cold_done(j, exc)
+
+    def _cold_begin(self, j: int) -> Optional[bool]:
+        """:meth:`_begin` for the cold plane: same breaker/lock
+        discipline, but a denied cold op does NOT feed the availability
+        SLI — the cold pool is capacity, not the serving path (a down
+        cold member delays demotion, it does not fail a user read; cold
+        READ health is covered by the ``cold_latency`` objective and the
+        tier counters)."""
+        h = self._cold_health[j]
+        # Audited: O(1) breaker state update (see _breaker_lock).
+        with self._breaker_lock:  # its: allow[ITS-L003]
+            if not h.breaker.allow():
+                h.fast_fails += 1
+                return None
+            probe = h.breaker.state == CircuitBreaker.HALF_OPEN
+            if probe:
+                h.probes += 1
+        if probe:
+            telemetry.emit(
+                "breaker_half_open", member=self.cold_ids[j],
+                epoch=self.membership.view().epoch,
+            )
+            conn = getattr(self.cold_members[j], "conn", None)
+            try:
+                if conn is not None and not getattr(conn, "is_connected", True):
+                    # Worker-thread / sync-read-path callers only; the
+                    # reconnect is the probe's heal, as in _probe_heal.
+                    conn.reconnect()  # its: allow[ITS-L001]
+            # Audited: a failed heal just lets the probe op fail and feed
+            # this member's breaker via _cold_done.
+            except (InfiniStoreException, AttributeError):  # its: allow[ITS-P001]
+                pass
+        return probe
+
+    def _cold_done(self, j: int, exc: Optional[BaseException]):
+        h = self._cold_health[j]
+        opened = recovered = False
+        # Audited: O(1) breaker state update (see _breaker_lock).
+        with self._breaker_lock:  # its: allow[ITS-L003]
+            transport = exc is not None and _is_transport(exc)
+            fails = 0
+            if transport:
+                h.errors += 1
+                h.last_error = repr(exc)
+                prev = h.breaker.state
+                h.breaker.record_failure()
+                fails = h.breaker.consecutive_failures
+                opened = (
+                    prev != CircuitBreaker.OPEN
+                    and h.breaker.state == CircuitBreaker.OPEN
+                )
+            else:
+                if h.breaker.record_success():
+                    h.recoveries += 1
+                    recovered = True
+        if opened:
+            telemetry.emit(
+                "breaker_open", member=self.cold_ids[j],
+                epoch=self.membership.view().epoch,
+                error=repr(exc)[:200], consecutive_failures=fails,
+            )
+        elif recovered:
+            telemetry.emit(
+                "breaker_closed", member=self.cold_ids[j],
+                epoch=self.membership.view().epoch,
+            )
+
+    def _cold_candidates(self, root: str) -> List[str]:
+        """Cold member ids provably holding ``root`` (catalog levels > 0),
+        HRW rank order."""
+        if not self.cold_ids:
+            return []
+        rec = self.catalog_get(root)
+        if rec is None:
+            return []
+        holders = [
+            m for m, lv in rec.holders.items()
+            if lv > 0 and m in self.cold_index
+        ]
+        return self._ranked_ids(holders, root)
+
+    def tier_location(self, token_ids) -> Optional[str]:
+        """Which tier serves this prompt's root right now: ``"hot"`` when
+        a readable SERVING member provably holds it (or the root is
+        unknown — optimism keeps the staged path the default),
+        ``"cold"`` when only the capacity pool does, ``None`` when the
+        catalog knows the root but no readable copy exists anywhere. The
+        engine's admission path consults this to pick staged vs direct
+        reads (docs/tiering.md): a cold-only root skips the speculative
+        staged prefetch — reserving staging for a slow cold read would
+        hold the arena hostage — and rides the one-phase direct load."""
+        root = self._root_of(token_ids)
+        if root is None:
+            return None
+        return self._tier_location_root(root)
+
+    def _tier_location_root(self, root: str) -> Optional[str]:
+        """:meth:`tier_location` for callers that already hashed the
+        chain (start_fetch computes the root once for routing anyway)."""
+        rec = self.catalog_get(root)
+        if rec is None:
+            return "hot"
+        readable = set(self.membership.view().readable_ids())
+        if any(m in readable and lv > 0 for m, lv in rec.holders.items()):
+            return "hot"
+        if any(m in self.cold_index and lv > 0
+               for m, lv in rec.holders.items()):
+            return "cold"
+        return None
+
+    def _cold_lookup(self, root: str, token_ids) -> int:
+        """Fall-through prefix probe against the cold pool (the serving
+        tiers all missed). Returns the best cold hit (0 when none)."""
+        for mid in self._cold_candidates(root):
+            j = self.cold_index[mid]
+            if self._cold_begin(j) is None:
+                continue
+            try:
+                hit = self.cold_members[j].lookup(token_ids)
+            except InfiniStoreException as e:
+                self._cold_done(j, e)
+                continue
+            except BaseException:
+                self._cold_done(j, None)  # never wedge a probe
+                raise
+            self._cold_done(j, None)
+            if hit > 0:
+                if self.tiering is not None:
+                    self.tiering.note_cold_hit(root)
+                return hit
+        return 0
+
+    async def _cold_load(self, root: str, token_ids, caches, block_ids,
+                         first_block: int, on_layer):
+        """Fall-through DIRECT read from the cold pool: no staged
+        prefetch, no placement hop — the cold member's own load streams
+        straight into the engine's cache (DAK's direct-access read,
+        docs/tiering.md). Measures the cold-read latency into the
+        ``cold_latency`` SLO objective and queues promotion-on-hit."""
+        for mid in self._cold_candidates(root):
+            j = self.cold_index[mid]
+            # The probe's connection heal blocks up to the connect
+            # timeout: keep it off this event loop (the _begin_async
+            # discipline).
+            if await asyncio.to_thread(self._cold_begin, j) is None:
+                continue
+            t0 = time.perf_counter()
+            try:
+                res = await self.cold_members[j].load(
+                    token_ids, caches, block_ids, first_block=first_block,
+                    on_layer=on_layer,
+                )
+            except PartialReadError as e:
+                # Same contract as the serving path: the caches list in
+                # the error is the only live one — no retry possible.
+                self._cold_done(j, e)
+                self._degrade([], e)
+                return e.caches, 0
+            except InfiniStoreException as e:
+                self._cold_done(j, e)
+                continue
+            except BaseException:
+                self._cold_done(j, None)  # never wedge a probe
+                raise
+            self._cold_done(j, None)
+            if res[1] > 0:
+                if self.tiering is not None:
+                    self.tiering.note_cold_hit(
+                        root, read_us=(time.perf_counter() - t0) * 1e6
+                    )
+                telemetry.slo_engine().record("miss_rate", good=1)
+                return res
+            caches = res[0]
+        return None
 
     # -- elastic membership ----------------------------------------------------
 
@@ -688,6 +994,8 @@ class ClusterKVConnector:
         close the connections this cluster dialed ITSELF (journal restore
         / gossip merge / bootstrap); caller-provided connections stay the
         caller's to close."""
+        if self.tiering is not None:
+            self.tiering.stop()
         self.resharder.stop()
         if self._journal_log is not None:
             self._journal_log.close()
@@ -1242,8 +1550,11 @@ class ClusterKVConnector:
             levels = dict(rec.holders)
             stale = {
                 m for m in levels
-                if view.state_of(m) in (MemberState.DEAD, MemberState.REMOVED)
-                or view.state_of(m) is None
+                if m not in self.cold_index  # cold holders are not view state
+                and (
+                    view.state_of(m) in (MemberState.DEAD, MemberState.REMOVED)
+                    or view.state_of(m) is None
+                )
             }
             if stale:
                 # Lazy scrub (mark_dead stays O(1)): a terminal member's
@@ -1258,6 +1569,12 @@ class ClusterKVConnector:
                     self._journal_append({"k": "hdel", "root": root, "m": m})
             live = {m: lv for m, lv in levels.items() if m in readable_set}
             if not live:
+                if any(m in self.cold_index and lv > 0
+                       for m, lv in levels.items()):
+                    # Cold-only root (demoted — docs/tiering.md): not
+                    # lost, just one tier down; the TierManager owns its
+                    # movement, the resharder has nothing to replicate.
+                    continue
                 lost.append(root)
                 continue
             lvl = max(live.values())
@@ -1459,7 +1776,8 @@ class ClusterKVConnector:
             self._health[candidates[0]].degraded_ops += 1
 
     def _read_failover(
-        self, candidates: Sequence[int], call, miss_value, is_miss=None
+        self, candidates: Sequence[int], call, miss_value, is_miss=None,
+        record_miss: bool = True,
     ):
         """Sync read path: try each replica in HRW order under its breaker;
         first success wins. Only when EVERY candidate is open or errors does
@@ -1509,8 +1827,10 @@ class ClusterKVConnector:
         if answered:
             # Every reachable candidate answered "miss": a legal cache
             # miss under the contract, not an availability failure (but it
-            # is a miss for the miss-rate SLI).
-            telemetry.slo_engine().record("miss_rate", bad=1)
+            # is a miss for the miss-rate SLI — unless the caller defers
+            # the verdict to a tier fall-through, record_miss=False).
+            if record_miss:
+                telemetry.slo_engine().record("miss_rate", bad=1)
             return miss_value
         self._degrade(candidates, last)
         return miss_value
@@ -1522,15 +1842,36 @@ class ClusterKVConnector:
         if root is None:
             return 0
         candidates, failover = self._read_candidates(root)
-        if not candidates:
+        has_cold = bool(self._cold_candidates(root))
+        hit = 0
+        if candidates:
+            self._qos["fg_ops"] += 1
+            hit = self._read_failover(
+                candidates, lambda m: m.lookup(token_ids), 0,
+                # Mid-reshard, a 0-hit answer from the new owner falls
+                # through to the old owner / surviving holder.
+                is_miss=(lambda r: r == 0) if failover else None,
+                # With a cold copy on record the miss verdict belongs to
+                # the fall-through's outcome, not the serving tiers'.
+                record_miss=not has_cold,
+            )
+        if hit > 0:
+            if self.tiering is not None:
+                self.tiering.note_ram_hit(root)
+            return hit
+        if not has_cold:
+            if self.tiering is not None:
+                self.tiering.note_miss(root)
             return 0
-        self._qos["fg_ops"] += 1
-        return self._read_failover(
-            candidates, lambda m: m.lookup(token_ids), 0,
-            # Mid-reshard, a 0-hit answer from the new owner falls through
-            # to the old owner / surviving holder.
-            is_miss=(lambda r: r == 0) if failover else None,
+        # Tier fall-through (docs/tiering.md): the serving tiers missed —
+        # a demoted root still answers from the cold pool.
+        cold_hit = self._cold_lookup(root, token_ids)
+        telemetry.slo_engine().record(
+            "miss_rate", good=1 if cold_hit else 0, bad=0 if cold_hit else 1
         )
+        if cold_hit == 0 and self.tiering is not None:
+            self.tiering.note_miss(root)
+        return cold_hit
 
     def start_fetch(
         self, token_ids, first_block: int = 0, limit_blocks=None, priority: int = 0
@@ -1543,9 +1884,21 @@ class ClusterKVConnector:
         the serving member's prefetch handle, or None when nothing is
         fetchable / no replica is up under the degrade policy — callers
         then use the one-phase ``load``. StagingPoolExhausted propagates
-        (backpressure, not failure)."""
+        (backpressure, not failure).
+
+        Tier consult (docs/tiering.md): a COLD-ONLY root returns None
+        without probing — reserving a staged pipeline for a slow cold
+        read would hold the arena hostage; the caller's one-phase
+        ``load`` then serves the root DIRECTLY from the cold pool
+        (counted in ``tier_direct_reads``)."""
         root = self._root_of(token_ids)
         if root is None:
+            return None
+        if (
+            self.tiering is not None
+            and self._tier_location_root(root) == "cold"
+        ):
+            self.tiering.note_direct_read()
             return None
         candidates, failover = self._read_candidates(root)
         if not candidates:
@@ -1592,6 +1945,58 @@ class ClusterKVConnector:
         self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
         on_layer=None,
     ):
+        """Routed load with tier fall-through (docs/tiering.md): the
+        serving replicas first (epoch-aware, as ever); a clean 0-block
+        answer from every serving tier then tries the cold pool DIRECTLY
+        (no staging hop) before reporting the miss. The returned caches
+        must always be used — donation applies on every path."""
+        root = self._root_of(token_ids)
+        if on_layer is not None:
+            # Layer-progress dedupe across the serving and cold legs: a
+            # serving read that partially scattered layers 0..k before a
+            # semantic failure (swallowed inside KVConnector.load) already
+            # fired on_layer for them; the cold retry re-scatters those
+            # layers and must NOT fire their progress hook again — a
+            # double fire double-decrements the vllm worker's per-layer
+            # remaining counters and releases wait_for_layer_load early.
+            fired: set = set()
+            inner = on_layer
+
+            def on_layer(layer, kv, _inner=inner, _fired=fired):
+                if layer in _fired:
+                    return
+                _fired.add(layer)
+                _inner(layer, kv)
+
+        # Cold knowledge decided up front: when the pool can serve this
+        # root, the serving legs defer the miss-rate verdict to the final
+        # outcome (a cold-served read is a HIT for the SLI — recording the
+        # serving tiers' intermediate miss would page on a 50% "miss rate"
+        # for a workload served entirely from cold).
+        has_cold = root is not None and bool(self._cold_candidates(root))
+        caches, n = await self._load_serving(
+            token_ids, caches, block_ids, first_block, on_layer,
+            record_miss=not has_cold,
+        )
+        if n > 0:
+            if self.tiering is not None and root is not None:
+                self.tiering.note_ram_hit(root)
+            return caches, n
+        if has_cold:
+            cold = await self._cold_load(
+                root, token_ids, caches, block_ids, first_block, on_layer
+            )
+            if cold is not None:
+                return cold
+            telemetry.slo_engine().record("miss_rate", bad=1)
+        if self.tiering is not None and root is not None:
+            self.tiering.note_miss(root)
+        return caches, 0
+
+    async def _load_serving(
+        self, token_ids, caches, block_ids: np.ndarray, first_block: int = 0,
+        on_layer=None, record_miss: bool = True,
+    ):
         root = self._root_of(token_ids)
         if root is None:
             return list(caches), 0
@@ -1632,20 +2037,27 @@ class ClusterKVConnector:
             if tspan is not None:
                 tspan.annotate(cluster_member=i, cluster_rank=rank)
             if failover and res[1] == 0:
-                # Epoch-aware failover: a 0-block load before any scatter
-                # leaves the caches intact (KVConnector.load returns early
-                # on a 0 hit) — the old owner behind this candidate may
-                # still hold the unmigrated copy.
+                # Epoch-aware failover: the old owner behind this
+                # candidate may still hold the unmigrated copy. Rebind the
+                # caches to the RETURNED list before retrying: a member
+                # that swallowed a partial read internally (semantic error
+                # mid-scatter) hands back the only live cache list —
+                # retrying with the original would hand the next replica
+                # donated (deleted-on-TPU) buffers.
+                caches = res[0]
                 answered = True
                 continue
             if rank:
                 self._health[i].replica_serves += 1
-            telemetry.slo_engine().record(
-                "miss_rate", good=1 if res[1] else 0, bad=0 if res[1] else 1
-            )
+            if res[1] or record_miss:
+                telemetry.slo_engine().record(
+                    "miss_rate", good=1 if res[1] else 0,
+                    bad=0 if res[1] else 1,
+                )
             return res
         if answered:
-            telemetry.slo_engine().record("miss_rate", bad=1)
+            if record_miss:
+                telemetry.slo_engine().record("miss_rate", bad=1)
             return list(caches), 0
         self._degrade(candidates, last)
         return list(caches), 0
@@ -1668,6 +2080,10 @@ class ClusterKVConnector:
         if not chains:
             return 0
         root = chains[0]
+        if self.tiering is not None:
+            # A save is a temperature touch: freshly written roots are hot
+            # by definition and must not demote on the next idle scan.
+            self.tiering.policy.on_access(root)
         place = self.membership.view().placement_ids()
         candidates = [
             self.member_index(m)
@@ -1859,8 +2275,42 @@ class ClusterKVConnector:
             self._done(i, None)
             served += 1
             best = max(best, n)
+        # Cold-plane sweep (docs/tiering.md): a demoted copy on a pool
+        # member must not resurrect a dropped prompt through the tier
+        # fall-through. A cold failure is a partial drop too — strict mode
+        # raises, degrade mode counts — but it is attributed to the COLD
+        # member's health row, never to a serving owner that succeeded
+        # (and it feeds neither the serving availability SLI nor the
+        # miss-rate SLI: capacity is not the serving path).
+        cold_last: Optional[InfiniStoreException] = None
+        if rec is not None:
+            for mid in sorted(rec.holders):
+                j = self.cold_index.get(mid)
+                if j is None:
+                    continue
+                if self._cold_begin(j) is None:
+                    cold_last = cold_last or InfiniStoreException(
+                        f"cold member {mid} unreachable for drop"
+                    )
+                    self._cold_health[j].degraded_ops += 1
+                    continue
+                try:
+                    best = max(best, self.cold_members[j].drop(token_ids))
+                except InfiniStoreException as e:
+                    self._cold_done(j, e)
+                    cold_last = e
+                    self._cold_health[j].degraded_ops += 1
+                    continue
+                except BaseException:
+                    self._cold_done(j, None)  # never wedge a probe
+                    raise
+                self._cold_done(j, None)
         if served < len(candidates):
             self._degrade(candidates, last)
+        elif cold_last is not None:
+            if not self.degrade:
+                raise cold_last
+            self.degraded_ops += 1
         return best
 
     # -- observability -------------------------------------------------------
@@ -1890,6 +2340,16 @@ class ClusterKVConnector:
                 for mid, state, h in zip(
                     self.member_ids, view.states, self._health
                 )
+            ],
+            # Tiered capacity plane (docs/tiering.md): the tier_* counter
+            # snapshot plus each cold member's breaker/health row ("cold"
+            # is their fixed role, not a membership state).
+            "tiering": (
+                self.tiering.status() if self.tiering is not None else None
+            ),
+            "cold_members": [
+                {"member_id": mid, "state": "cold", **h.as_dict()}
+                for mid, h in zip(self.cold_ids, self._cold_health)
             ],
         }
 

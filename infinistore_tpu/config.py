@@ -7,6 +7,7 @@ reference src/config.h:7-12). Here the dataclasses below are the only
 definition; the native layer receives plain scalars over the C API.
 """
 
+import os
 from dataclasses import dataclass, field
 
 # Connection types (reference lib.py TYPE_RDMA/TYPE_TCP). On TPU VMs there is
@@ -130,6 +131,37 @@ class ServerConfig:
     # Extra fields tolerated for CLI forward-compat.
     extra: dict = field(default_factory=dict)
 
+    def __post_init__(self):
+        # Spill-tier misconfiguration fails AT CONSTRUCTION with a clear
+        # message — not as a native-layer failure at the first demotion,
+        # minutes into serving (docs/tiering.md). The low-level
+        # ``start_local_server(spill_dir=...)`` test/bench entry point
+        # bypasses this dataclass on purpose (the native layer's
+        # disable-the-tier-not-the-server degrade stays covered by
+        # tests/test_spill_tier.py).
+        self._verify_spill()
+
+    def _verify_spill(self) -> None:
+        if self.spill_size < 0:
+            raise ValueError(
+                f"spill_size must be >= 0 GB, got {self.spill_size}"
+            )
+        if self.spill_dir and self.spill_size == 0:
+            raise ValueError(
+                f"spill_dir {self.spill_dir!r} is set but spill_size is 0 — "
+                "give the tier capacity (GB) or clear spill_dir"
+            )
+        if self.spill_size > 0 and not self.spill_dir:
+            raise ValueError(
+                f"spill_size={self.spill_size} GB but spill_dir is empty — "
+                "name the directory backing the spill file"
+            )
+        if self.spill_dir and not os.path.isdir(self.spill_dir):
+            raise ValueError(
+                f"spill_dir {self.spill_dir!r} does not exist (or is not a "
+                "directory) — create it before starting the server"
+            )
+
     def verify(self) -> None:
         """Validate field values; raises ValueError on any bad setting
         (mirrors the reference ServerConfig.verify, lib.py:140-152)."""
@@ -150,6 +182,7 @@ class ServerConfig:
             raise ValueError("need 0 < on_demand_evict_min < on_demand_evict_max <= 1")
         if self.evict_interval <= 0:
             raise ValueError("evict_interval must be positive seconds")
+        self._verify_spill()
 
     @property
     def prealloc_bytes(self) -> int:

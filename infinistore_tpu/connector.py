@@ -24,10 +24,12 @@ import numpy as np
 
 from . import tracing, wire
 from .lib import (
+    InfiniStoreColdTier,
     InfiniStoreKeyNotFound,
     InfiniStoreNoMatch,
     InfiniStoreResourcePressure,
 )
+from .tiering import note_demotion_hit as tiering_note_demotion_hit
 from .tpu.layerwise import (
     LayerwiseKVReader,
     LayerwiseKVWriter,
@@ -536,6 +538,12 @@ class KVConnector:
                 # survives). Cache semantics either way — the engine just
                 # recomputes; transport errors still propagate (lookup()'s
                 # contract), carrying the partial caches.
+                if isinstance(e.cause, InfiniStoreColdTier):
+                    # The typed 512: cold BUT ALIVE — a tier demotion hit,
+                    # not a miss (the data is one tier down, and the tier
+                    # stats must be able to tell the two apart;
+                    # docs/tiering.md).
+                    tiering_note_demotion_hit()
                 return e.caches, 0
             raise
         return out, n

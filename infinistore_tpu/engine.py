@@ -379,6 +379,26 @@ class EngineKVAdapter:
         store already holds (block-aligned; one control round trip)."""
         return self.connector.lookup(token_ids) * self.block_tokens
 
+    def tier_location(self, token_ids) -> Optional[str]:
+        """Which tier would serve this prompt right now — ``"hot"`` /
+        ``"cold"`` / ``None`` — from the connector's catalog knowledge
+        (``ClusterKVConnector.tier_location``, docs/tiering.md); ``None``
+        for connectors without a tiered pool. Network-free: the harness
+        consults this at admission to pick the staged two-phase path vs
+        the direct one-phase load for a cold-only root."""
+        fn = getattr(self.connector, "tier_location", None)
+        return fn(token_ids) if fn is not None else None
+
+    def note_tier_direct(self):
+        """The harness skipped the staged prefetch for a cold-only root:
+        count it in the connector's tier ledger too, so /metrics
+        ``infinistore_tier_direct_reads`` reflects engine flows (the
+        harness-local ``tier_direct_loads`` metric counts the same
+        events engine-side)."""
+        tiering = getattr(self.connector, "tiering", None)
+        if tiering is not None:
+            tiering.note_direct_read()
+
     def start_fetch(
         self, token_ids, limit_blocks: Optional[int] = None, priority: int = 0
     ):
@@ -562,6 +582,10 @@ class ContinuousBatchingHarness:
         # Admissions that wanted a prefetch but found the staging arena
         # full and fell back to the one-phase gated load (backpressure).
         self.prefetch_fallbacks = 0
+        # Admissions whose root was COLD-ONLY (tiered capacity plane,
+        # docs/tiering.md): the staged prefetch was skipped on purpose and
+        # the one-phase load read the root directly from the cold pool.
+        self.tier_direct_loads = 0
         # Prefetch bytes from requests that DIED before install (cancelled
         # mid-admission): they never reach self.stats, but their waste is
         # real and must show in prefetch_waste.
@@ -785,6 +809,20 @@ class ContinuousBatchingHarness:
                 self.adapter, "start_fetch_async",
                 getattr(self.adapter, "start_fetch", None),
             )
+            # Tier consult (docs/tiering.md): a COLD-ONLY root skips the
+            # staged speculative prefetch entirely — a slow pooled-cold
+            # read must not reserve (and hold hostage) staging regions the
+            # current wave's hot fetches need. The one-phase load below
+            # reads it DIRECTLY from the cold member instead (the DAK
+            # direct-access path). Network-free check (catalog knowledge).
+            tier_fn = getattr(self.adapter, "tier_location", None)
+            if starter is not None and tier_fn is not None:
+                if tier_fn(token_ids) == "cold":
+                    starter = None
+                    self.tier_direct_loads += 1
+                    note = getattr(self.adapter, "note_tier_direct", None)
+                    if note is not None:
+                        note()
             starter_is_async = asyncio.iscoroutinefunction(starter)
             if starter is not None:
                 # QoS: a request the block pool cannot admit right now is
@@ -1015,8 +1053,10 @@ class ContinuousBatchingHarness:
         vs device-gate queueing (``p50_gate_stall_us``,
         ``p99_gate_stall_us``); the two-phase admission overlap story
         (``p50_gate_hold_us``, ``p99_gate_hold_us``, ``overlap_fraction``,
-        ``prefetch_waste``, ``prefetch_fallbacks``) and end-to-end prefix
-        residency (``p50_prefix_ready_hit_us``,
+        ``prefetch_waste``, ``prefetch_fallbacks``,
+        ``tier_direct_loads`` — cold-only roots read DIRECTLY via the
+        one-phase load, skipping staged prefetch, docs/tiering.md) and
+        end-to-end prefix residency (``p50_prefix_ready_hit_us``,
         ``p50_prefix_ready_miss_us``); the recompute ledger
         (``recompute_saved_s``, ``prefill_per_block_s``); concurrency
         receipts (``max_live_requests``, ``max_concurrent_saves``); the
@@ -1092,6 +1132,10 @@ class ContinuousBatchingHarness:
             ),
             "prefetch_waste": wasted / prefetched if prefetched else 0.0,
             "prefetch_fallbacks": self.prefetch_fallbacks,
+            # Tiered capacity plane (docs/tiering.md): admissions that
+            # skipped the staged prefetch for a cold-only root and read it
+            # directly from the pooled cold tier via the one-phase load.
+            "tier_direct_loads": self.tier_direct_loads,
             # End-to-end prefix residency split by outcome: the number that
             # says whether a cache hit actually beats recomputing.
             "p50_prefix_ready_hit_us": _p(ready_hit, 0.50),

@@ -63,6 +63,17 @@ class InfiniStoreResourcePressure(InfiniStoreException):
     (data absent) and from transport failure (base class)."""
 
 
+class InfiniStoreColdTier(InfiniStoreResourcePressure):
+    """The key is PRESENT but demoted — alive in the spill tier, and the
+    server's RAM is too pressured to promote it for this op (the typed
+    512 status, docs/tiering.md): "cold but alive". A subclass of
+    :class:`InfiniStoreResourcePressure` so every existing pressure
+    handler keeps working; tier-aware callers catch it first to count a
+    DEMOTION HIT instead of a miss (tiering.note_demotion_hit) and to
+    retry smaller / read the root through the pooled cold tier instead
+    of recomputing."""
+
+
 class InfiniStoreNoMatch(InfiniStoreException):
     """get_match_last_index found no matching prefix — a semantic miss,
     distinct from a transport/timeout failure (which raises the base
@@ -899,6 +910,11 @@ class InfinityConnection:
                 fut.set_result(code)
             elif code == wire.STATUS_KEY_NOT_FOUND:
                 fut.set_exception(InfiniStoreKeyNotFound(f"{op_name}: key not found"))
+            elif code == wire.STATUS_COLD_TIER:
+                fut.set_exception(InfiniStoreColdTier(
+                    f"{op_name}: key(s) cold but alive (spilled beyond the "
+                    "promotion budget — retry smaller/later)"
+                ))
             elif code == wire.STATUS_OOM:
                 fut.set_exception(InfiniStoreResourcePressure(
                     f"{op_name}: store out of memory (data may survive spilled)"
@@ -1032,6 +1048,11 @@ class InfinityConnection:
             return wire.STATUS_OK
         if rc == -wire.STATUS_KEY_NOT_FOUND:
             raise InfiniStoreKeyNotFound(f"{op_name}: key not found")
+        if rc == -wire.STATUS_COLD_TIER:
+            raise InfiniStoreColdTier(
+                f"{op_name}: key(s) cold but alive (spilled beyond the "
+                "promotion budget — retry smaller/later)"
+            )
         if rc == -wire.STATUS_OOM:
             raise InfiniStoreResourcePressure(
                 f"{op_name}: store out of memory (data may survive spilled)"
@@ -1114,10 +1135,15 @@ class InfinityConnection:
         )
         if rc == -wire.STATUS_KEY_NOT_FOUND:
             raise InfiniStoreKeyNotFound(f"key not found: {key}")
+        if rc == -wire.STATUS_COLD_TIER:
+            # Present-but-unpromotable spilled key (server.cpp single-key
+            # GET, the typed 512): the data is COLD BUT ALIVE — tier-aware
+            # callers count a demotion hit, not a miss (docs/tiering.md).
+            raise InfiniStoreColdTier(
+                f"tcp_read_cache: {key!r} is cold but alive (spilled; RAM "
+                "too pressured to promote now)"
+            )
         if rc == -wire.STATUS_OOM:
-            # Present but unpromotable spilled key (server.cpp single-key GET
-            # 507): the data survives — recompute or retry later, distinct
-            # from transport failure.
             raise InfiniStoreResourcePressure(
                 f"tcp_read_cache: store too pressured to serve {key!r} now"
             )

@@ -93,7 +93,7 @@ def _http_response(status: int, payload: dict) -> bytes:
 
 def _prometheus_text(stats: dict, membership_status: dict = None,
                      slo_status: dict = None, event_counts: dict = None,
-                     gossip_status: dict = None,
+                     gossip_status: dict = None, tier_status: dict = None,
                      exemplars: bool = False) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
     reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
@@ -275,6 +275,8 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
         lines += _membership_prometheus_lines(membership_status)
     if gossip_status is not None:
         lines += _gossip_prometheus_lines(gossip_status)
+    if tier_status is not None:
+        lines += _tier_prometheus_lines(tier_status)
     if slo_status is not None:
         lines += _slo_prometheus_lines(slo_status)
     if event_counts is not None:
@@ -412,6 +414,63 @@ def _gossip_prometheus_lines(gs: dict) -> list:
     ]
 
 
+def _tier_prometheus_lines(ts: dict) -> list:
+    """Tiered-capacity-plane gauge families for /metrics, from the flat
+    ``tiering.TierManager.status`` snapshot (the same dict ``GET /tiers``
+    serves). The counters checker (ITS-C007, tools/analysis/counters.py)
+    holds this exporter to the ``tier_*`` status vocabulary both ways —
+    a tier the dashboards cannot see is observability drift
+    (docs/tiering.md)."""
+    return [
+        "# TYPE infinistore_tier_cold_members gauge",
+        f"infinistore_tier_cold_members {ts['tier_cold_members']}",
+        "# TYPE infinistore_tier_cold_roots gauge",
+        f"infinistore_tier_cold_roots {ts['tier_cold_roots']}",
+        "# TYPE infinistore_tier_tracked_roots gauge",
+        f"infinistore_tier_tracked_roots {ts['tier_tracked_roots']}",
+        "# TYPE infinistore_tier_sketch_evictions counter",
+        f"infinistore_tier_sketch_evictions {ts['tier_sketch_evictions']}",
+        "# TYPE infinistore_tier_hits counter",
+        f'infinistore_tier_hits{{tier="ram"}} {ts["tier_ram_hits"]}',
+        f'infinistore_tier_hits{{tier="cold"}} {ts["tier_cold_hits"]}',
+        f'infinistore_tier_hits{{tier="demotion"}} {ts["tier_demotion_hits"]}',
+        "# TYPE infinistore_tier_misses counter",
+        f"infinistore_tier_misses {ts['tier_misses']}",
+        "# TYPE infinistore_tier_cold_reads counter",
+        f"infinistore_tier_cold_reads {ts['tier_cold_reads']}",
+        "# TYPE infinistore_tier_cold_read_p99_us gauge",
+        f"infinistore_tier_cold_read_p99_us {ts['tier_cold_read_p99_us']}",
+        "# TYPE infinistore_tier_demotions counter",
+        f"infinistore_tier_demotions {ts['tier_demotions']}",
+        "# TYPE infinistore_tier_demoted_keys counter",
+        f"infinistore_tier_demoted_keys {ts['tier_demoted_keys']}",
+        "# TYPE infinistore_tier_demoted_bytes counter",
+        f"infinistore_tier_demoted_bytes {ts['tier_demoted_bytes']}",
+        "# TYPE infinistore_tier_demote_failures counter",
+        f"infinistore_tier_demote_failures {ts['tier_demote_failures']}",
+        "# TYPE infinistore_tier_promotions counter",
+        f"infinistore_tier_promotions {ts['tier_promotions']}",
+        "# TYPE infinistore_tier_promoted_keys counter",
+        f"infinistore_tier_promoted_keys {ts['tier_promoted_keys']}",
+        "# TYPE infinistore_tier_promoted_bytes counter",
+        f"infinistore_tier_promoted_bytes {ts['tier_promoted_bytes']}",
+        "# TYPE infinistore_tier_promote_failures counter",
+        f"infinistore_tier_promote_failures {ts['tier_promote_failures']}",
+        "# TYPE infinistore_tier_admit_rejects counter",
+        f"infinistore_tier_admit_rejects {ts['tier_admit_rejects']}",
+        "# TYPE infinistore_tier_direct_reads counter",
+        f"infinistore_tier_direct_reads {ts['tier_direct_reads']}",
+        "# TYPE infinistore_tier_promote_backlog gauge",
+        f"infinistore_tier_promote_backlog {ts['tier_promote_backlog']}",
+        "# TYPE infinistore_tier_demote_backlog gauge",
+        f"infinistore_tier_demote_backlog {ts['tier_demote_backlog']}",
+        "# TYPE infinistore_tier_wrong_reads counter",
+        f"infinistore_tier_wrong_reads {ts['tier_wrong_reads']}",
+        "# TYPE infinistore_tier_last_pass_ms gauge",
+        f"infinistore_tier_last_pass_ms {ts['tier_last_pass_ms']}",
+    ]
+
+
 def _slo_prometheus_lines(slo: dict) -> list:
     """SLO gauge families for /metrics, from the flat ``SloEngine.status``
     snapshot (the same dict ``GET /slo`` serves). The counters checker
@@ -423,6 +482,8 @@ def _slo_prometheus_lines(slo: dict) -> list:
         f"infinistore_slo_availability {slo['slo_availability']}",
         "# TYPE infinistore_slo_fg_p99_us gauge",
         f"infinistore_slo_fg_p99_us {slo['slo_fg_p99_us']}",
+        "# TYPE infinistore_slo_cold_p99_us gauge",
+        f"infinistore_slo_cold_p99_us {slo['slo_cold_p99_us']}",
         "# TYPE infinistore_slo_miss_rate gauge",
         f"infinistore_slo_miss_rate {slo['slo_miss_rate']}",
         "# TYPE infinistore_slo_reshard_drain gauge",
@@ -533,7 +594,8 @@ class ManageServer:
     op-tracing dump; ?scope=cluster joins the fleet, docs/observability.md),
     /slo (burn-rate verdict) and /events (the causal event journal) — plus,
     with a cluster attached, /membership GET/POST (the elastic-membership
-    control surface, docs/membership.md).
+    control surface, docs/membership.md) and /tiers (the tiered capacity
+    plane's tier_* counter snapshot, docs/tiering.md).
 
     ``cluster``: an optional ``ClusterKVConnector``-shaped object (needs
     ``membership`` / ``resharder`` / ``membership_status()`` / ``health()``
@@ -626,6 +688,12 @@ class ManageServer:
                     if self.cluster is not None else None
                 )
                 gs = self.gossip.status() if self.gossip is not None else None
+                ts = (
+                    self.cluster.tiering.status()
+                    if self.cluster is not None
+                    and getattr(self.cluster, "tiering", None) is not None
+                    else None
+                )
                 params = urllib.parse.parse_qs(query)
                 slo = telemetry.slo_engine().status()
                 counts = telemetry.get_journal().counts()
@@ -641,6 +709,7 @@ class ManageServer:
                     lines = (
                         _membership_prometheus_lines(ms)
                         + (_gossip_prometheus_lines(gs) if gs is not None else [])
+                        + (_tier_prometheus_lines(ts) if ts is not None else [])
                         + _slo_prometheus_lines(slo)
                         + _events_prometheus_lines(counts)
                     )
@@ -653,7 +722,7 @@ class ManageServer:
                     ).encode() + body
                 return _prometheus_text(
                     stats, membership_status=ms, slo_status=slo,
-                    event_counts=counts, gossip_status=gs,
+                    event_counts=counts, gossip_status=gs, tier_status=ts,
                     exemplars=params.get("exemplars") == ["1"],
                 )
             if path == "/health" and method == "GET":
@@ -715,6 +784,29 @@ class ManageServer:
                 return _trace_payload(stats, fmt, member_spans=member_spans)
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
+            if path == "/tiers" and method == "GET":
+                # Tiered capacity plane (docs/tiering.md): the flat
+                # tier_* counter snapshot — the TierManager.status
+                # vocabulary /metrics exports as infinistore_tier_*
+                # (ITS-C007) — plus each cold member's breaker row.
+                tiering = (
+                    getattr(self.cluster, "tiering", None)
+                    if self.cluster is not None else None
+                )
+                if tiering is None:
+                    return _http_response(
+                        200, {"enabled": False, "error": "no tiering attached"}
+                    )
+                return _http_response(200, {
+                    "enabled": True,
+                    **tiering.status(),
+                    "cold_members": [
+                        {"member_id": mid, **h.as_dict()}
+                        for mid, h in zip(
+                            self.cluster.cold_ids, self.cluster._cold_health
+                        )
+                    ],
+                })
             if path == "/membership" and method == "GET":
                 return self._membership_get()
             if path == "/membership" and method == "POST":
@@ -725,7 +817,7 @@ class ManageServer:
                 return await self._bootstrap_get(query)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
                         "/selftest", "/health", "/trace", "/membership",
-                        "/slo", "/events", "/gossip", "/bootstrap"):
+                        "/slo", "/events", "/gossip", "/bootstrap", "/tiers"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request

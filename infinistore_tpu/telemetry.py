@@ -68,6 +68,8 @@ EVENT_KINDS = (
     "slo_alert",          # burn-rate alert fired or cleared (edge)
     "gossip_round",       # one anti-entropy peer-exchange round completed
     "client_restart",     # a crashed client replayed its durable journal
+    "tier_demotion",      # an idle root's copy shipped to the pooled cold tier
+    "tier_promotion",     # a reused cold root copied back to its serving owner
 )
 
 _DEFAULT_JOURNAL_CAPACITY = 512
@@ -223,6 +225,13 @@ def default_objectives() -> List[SloObjective]:
                      latency_threshold_us=50_000.0),
         SloObjective("miss_rate", target=0.90),
         SloObjective("reshard_drain", target=0.90),
+        # Pooled-cold-tier read latency (docs/tiering.md): cold reads are
+        # allowed to be slow — they exist to beat recompute, not RAM — but
+        # a cold read slower than ~0.5s has likely stopped doing that.
+        # Fed by the cluster's cold-load fall-through
+        # (tiering.note_cold_read_us).
+        SloObjective("cold_latency", target=0.95, kind="latency",
+                     latency_threshold_us=500_000.0),
     ]
 
 
@@ -483,6 +492,7 @@ class SloEngine:
         return {
             "slo_availability": round(self.sli("availability", now=now), 6),
             "slo_fg_p99_us": round(self.p99_us("fg_latency", now=now), 1),
+            "slo_cold_p99_us": round(self.p99_us("cold_latency", now=now), 1),
             "slo_miss_rate": round(1.0 - self.sli("miss_rate", now=now), 6),
             "slo_reshard_drain": round(self.sli("reshard_drain", now=now), 6),
             "slo_burn_rate_max": round(burn_max, 4),

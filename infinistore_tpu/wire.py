@@ -53,6 +53,9 @@ STATUS_INTERNAL = 500
 STATUS_UNAVAILABLE = 503
 STATUS_OUT_OF_MEMORY = 507
 STATUS_OOM = STATUS_OUT_OF_MEMORY
+# Present-but-unpromotable spilled key: "cold but alive" — data survives one
+# tier down; distinct from 507 (allocation exhaustion) and 404 (absent).
+STATUS_COLD_TIER = 512
 
 _REQ_HEADER = struct.Struct("<IBI")  # magic, op, body_size (9 bytes)
 _RESP_HEADER = struct.Struct("<IIQ")  # status, body_size, payload_size (16 bytes)

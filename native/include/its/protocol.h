@@ -101,6 +101,13 @@ enum Status : uint32_t {
     kStatusInternal = 500,
     kStatusUnavailable = 503,
     kStatusOutOfMemory = 507,
+    // Present-but-unpromotable: the key is ALIVE in the spill tier but the
+    // server's RAM is too pressured to promote it for this op right now —
+    // "cold but alive", distinct from 507 (genuine allocation exhaustion)
+    // and from 404 (data absent). Callers retry smaller/later or read it
+    // through the pooled cold tier; tier stats count it as a demotion hit,
+    // never a miss (docs/tiering.md).
+    kStatusColdTier = 512,
 };
 
 // ---------------------------------------------------------------------------

@@ -1075,9 +1075,11 @@ Server::PinResult Server::pin_slice(
                 return PinResult::kFinished;
             }
             if (slice_capped_) return PinResult::kYield;  // pins kept
-            // Reclaim ran dry with the key still spilled: genuine pressure
-            // (typically this op's own pins exceed RAM).
-            finish_cont(c, kStatusOutOfMemory);
+            // Reclaim ran dry with the key still spilled: the key is cold
+            // but ALIVE (typically this op's own pins exceed RAM) — the
+            // typed 512, so callers can tell "retry smaller / read via the
+            // cold tier" from genuine allocation exhaustion (507).
+            finish_cont(c, kStatusColdTier);
             return PinResult::kFinished;
         }
         if (!validate(ct.idx, b)) {
@@ -1846,9 +1848,9 @@ void Server::handle_get_batch(Conn* c) {
     uint64_t total = 0;
     for (const auto& key : m.keys) {
         BlockRef b = kv_->get(key);  // touches LRU (reference :629-634)
-        if (b == nullptr) {  // spilled + unpromotable: pressure, not a miss
+        if (b == nullptr) {  // spilled + unpromotable: cold but alive — 512
             c->reset_read();
-            send_status(c, kStatusOutOfMemory);
+            send_status(c, kStatusColdTier);
             return;
         }
         // ...and each stored size must fit the client's block stride (:620-624).
@@ -1886,9 +1888,10 @@ void Server::handle_simple(Conn* c) {
             BlockRef b = kv_->get(m.key);
             if (b == nullptr) {
                 // Present-but-unpromotable (spill tier, RAM pressure) is
-                // 507 like the batch paths — the data survives; only a
-                // truly absent key is 404.
-                status = present ? kStatusOutOfMemory : kStatusKeyNotFound;
+                // the typed 512 "cold but alive" — the data survives one
+                // tier down; only a truly absent key is 404, and 507 stays
+                // reserved for genuine allocation exhaustion.
+                status = present ? kStatusColdTier : kStatusKeyNotFound;
             } else {
                 payload.push_back(iovec{b->data(), b->size()});
                 refs.push_back(std::move(b));

@@ -168,3 +168,54 @@ def test_empty_receipt_is_not_a_pass(tmp_path):
     p = tmp_path / "empty.json"
     p.write_text(json.dumps({"rc": 0, "tail": "no metrics here"}))
     assert bench_check.main([str(p)]) == 2
+
+
+def test_tiering_gates_pass_on_healthy_receipt(tmp_path):
+    doc = {
+        "tiering_hot_p99_ratio": 1.01,
+        "tiering_cold_vs_spill_floor": 2.1,
+        "tiering_demotions": 120,
+        "tiering_promotions": 4,
+        "tiering_admit_rejects": 32,
+        "tiering_wrong_reads": 0,
+        "tiering_misses": 0,
+    }
+    p = tmp_path / "tier.json"
+    p.write_text(json.dumps(doc))
+    assert bench_check.main([str(p)]) == 0
+
+
+def test_tiering_hot_isolation_gate(tmp_path):
+    # A tier plane stalling the hot path (policy hooks / fall-through
+    # probing on serving hits) fails the paired-ratio gate.
+    p = tmp_path / "tier.json"
+    p.write_text(json.dumps({"tiering_hot_p99_ratio": 1.6}))
+    assert bench_check.main([str(p)]) == 1
+
+
+def test_tiering_cold_floor_and_mechanism_gates(tmp_path):
+    # Cold reads far below the spill floor (a per-key fallback storm).
+    p = tmp_path / "tier.json"
+    p.write_text(json.dumps({"tiering_cold_vs_spill_floor": 0.2}))
+    assert bench_check.main([str(p)]) == 1
+    # Movement must run BOTH directions; one wrong read fails outright.
+    p.write_text(json.dumps({
+        "tiering_hot_p99_ratio": 1.0,
+        "tiering_cold_vs_spill_floor": 2.0,
+        "tiering_demotions": 120,
+        "tiering_promotions": 0,
+        "tiering_admit_rejects": 32,
+        "tiering_wrong_reads": 0,
+        "tiering_misses": 0,
+    }))
+    assert bench_check.main([str(p)]) == 1
+    p.write_text(json.dumps({
+        "tiering_hot_p99_ratio": 1.0,
+        "tiering_cold_vs_spill_floor": 2.0,
+        "tiering_demotions": 120,
+        "tiering_promotions": 4,
+        "tiering_admit_rejects": 32,
+        "tiering_wrong_reads": 1,
+        "tiering_misses": 0,
+    }))
+    assert bench_check.main([str(p)]) == 1

@@ -79,7 +79,8 @@ class TestWireDrift:
         py = wire_drift.parse_wire(ctx)
         ops = [k for k in cpp.constants if k.startswith("OP_")]
         assert len(ops) == 18
-        assert len([k for k in cpp.constants if k.startswith("STATUS_")]) == 9
+        assert len([k for k in cpp.constants if k.startswith("STATUS_")]) == 10
+        assert cpp.constants["STATUS_COLD_TIER"] == 512
         assert cpp.constants["PRIORITY_BACKGROUND"] == 1
         assert cpp.header_asserts == {
             "ReqHeader": 9, "RespHeader": 16,
@@ -741,6 +742,17 @@ class TestPolicyP003:
         found = [f for f in policy.scan(ctx) if f.rule == "ITS-P003"]
         assert found == []
 
+    def test_tiering_is_in_p003_scope(self, tmp_path):
+        # The tiered capacity plane's copy engine (docs/tiering.md) is
+        # migration traffic too: an untagged op in tiering.py fires.
+        ctx = make_tree(tmp_path, {"pkg/tiering.py": P003_FIXTURE})
+        found = policy.scan(
+            ctx, package_rel="pkg", p001_exempt=set(), p002_exempt=set(),
+            p003_files=policy.P003_FILES | {"pkg/tiering.py"},
+        )
+        assert [f for f in found if f.rule == "ITS-P003"]
+        assert "infinistore_tpu/tiering.py" in policy.P003_FILES
+
 
 # ---------------------------------------------------------------------------
 # counters ITS-C005: membership status keys reach the /metrics exporter
@@ -996,6 +1008,95 @@ class TestCountersTelemetry:
     def test_real_telemetry_vocabulary_is_clean(self):
         ctx = core.Context(str(REPO))
         found = [f for f in counters.scan(ctx) if f.rule == "ITS-C006"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# counters ITS-C007: tiered-capacity-plane vocabulary lockstep
+# ---------------------------------------------------------------------------
+
+C007_TIERING = '''\
+class TierManager:
+    def __init__(self):
+        self._c = {"tier_demotions": 0, "tier_cold_hits": 0}
+
+    def status(self):
+        return {**self._c, "tier_cold_members": 1, "tier_promote_backlog": 0}
+'''
+
+C007_MANAGE_OK = '''\
+def _tier_prometheus_lines(ts):
+    return [
+        f"a {ts['tier_demotions']}",
+        f"b {ts['tier_cold_hits']}",
+        f"c {ts['tier_cold_members']}",
+        f"d {ts['tier_promote_backlog']}",
+    ]
+
+route = "/tiers"   # served from the cluster's tiering status
+'''
+
+C007_DOCS = (
+    "| tier_demotions | tier_cold_hits | tier_cold_members | "
+    "tier_promote_backlog |\n"
+)
+
+
+class TestCountersTiering:
+    def scan(self, tmp_path, manage_src=C007_MANAGE_OK,
+             tiering_src=C007_TIERING, docs=C007_DOCS):
+        ctx = make_tree(tmp_path, {
+            "manage.py": manage_src,
+            "tiering.py": tiering_src,
+            "docs/tiering.md": docs,
+        })
+        return counters._scan_tiering(
+            ctx, "manage.py", tiering_rel="tiering.py",
+            docs_rel="docs/tiering.md",
+        )
+
+    def test_complete_vocabulary_is_clean(self, tmp_path):
+        assert self.scan(tmp_path) == []
+
+    def test_unexported_tier_key_fires(self, tmp_path):
+        manage = C007_MANAGE_OK.replace(
+            "        f\"b {ts['tier_cold_hits']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.rule == "ITS-C007" and f.key.endswith(":tier_cold_hits")
+            for f in found
+        )
+
+    def test_unexported_init_ledger_key_fires(self, tmp_path):
+        # Keys living only in the __init__ counter dict (not the status
+        # literal) are vocabulary too — the C005 Resharder.__init__ rule.
+        manage = C007_MANAGE_OK.replace(
+            "        f\"a {ts['tier_demotions']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith(":tier_demotions") for f in found)
+
+    def test_stale_exporter_key_fires(self, tmp_path):
+        manage = C007_MANAGE_OK.replace("tier_cold_hits", "tier_gone_key")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("stale:tier_gone_key") for k in keys)
+        assert any(k.endswith(":tier_cold_hits") for k in keys)
+
+    def test_undocumented_tier_key_fires(self, tmp_path):
+        docs = C007_DOCS.replace("tier_cold_members", "")
+        found = self.scan(tmp_path, docs=docs)
+        assert any(
+            f.key.endswith("undocumented:tier_cold_members") for f in found
+        )
+
+    def test_missing_tiers_route_fires(self, tmp_path):
+        manage = C007_MANAGE_OK.replace('"/tiers"', '"/nope"').replace(
+            "tiering", "nothing")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("tiers-route") for f in found)
+
+    def test_real_tiering_vocabulary_is_clean(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in counters.scan(ctx) if f.rule == "ITS-C007"]
         assert found == []
 
 

@@ -37,6 +37,13 @@ and cross-checks them:
   ``telemetry.EVENT_KINDS``, every kind must keep at least one producer
   and a docs row; and the manage plane must keep serving ``/slo`` and
   ``/events``.
+- ITS-C007 tiered-capacity-plane vocabulary drift (docs/tiering.md):
+  every ``tier_*`` key of ``tiering.TierManager.status`` must be
+  consumed by the /metrics tier exporter
+  (``server.py _tier_prometheus_lines``) and enumerated in
+  docs/tiering.md — and the exporter must not consume keys the snapshot
+  no longer emits; the manage plane must keep serving ``GET /tiers``
+  from the TierManager status.
 
 Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
 both sides.
@@ -70,6 +77,8 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/membership.py", "Resharder.progress"),
     ("infinistore_tpu/membership.py", "DurableLog.status"),
     ("infinistore_tpu/telemetry.py", "GossipAgent.status"),
+    ("infinistore_tpu/tiering.py", "TierManager.__init__"),
+    ("infinistore_tpu/tiering.py", "TierManager.status"),
 ]
 
 # The elastic-membership status snapshot (ITS-C005): the dict-literal
@@ -99,6 +108,14 @@ TELEMETRY_GOSSIP_LEDGER = "GossipAgent.status"
 GOSSIP_EXPORT_FN = "_gossip_prometheus_lines"
 TELEMETRY_DOCS_REL = "docs/observability.md"
 TELEMETRY_PACKAGE_REL = "infinistore_tpu"
+
+# The tiered capacity plane (ITS-C007, docs/tiering.md): the TierManager
+# status ledger whose ``tier_*`` keys must reach the /metrics tier exporter
+# both ways, be enumerated in the tiering docs, and keep the /tiers route.
+TIERING_REL = "infinistore_tpu/tiering.py"
+TIERING_LEDGERS = ["TierManager.__init__", "TierManager.status"]
+TIER_EXPORT_FN = "_tier_prometheus_lines"
+TIERING_DOCS_REL = "docs/tiering.md"
 
 # Trace-surface exporters (docs/observability.md): the /trace payload
 # builder consumes the native ring's counters from the stats snapshot, and
@@ -415,6 +432,77 @@ def scan(
         ))
     findings += _scan_membership(ctx, manage_rel, MEMBERSHIP_REL)
     findings += _scan_telemetry(ctx, manage_rel)
+    findings += _scan_tiering(ctx, manage_rel)
+    return findings
+
+
+def _scan_tiering(
+    ctx: Context,
+    manage_rel: str = MANAGE_REL,
+    tiering_rel: str = TIERING_REL,
+    docs_rel: str = TIERING_DOCS_REL,
+) -> List[Finding]:
+    """ITS-C007: the tiered-capacity-plane vocabulary in lockstep —
+    ``tier_*`` status keys vs the /metrics tier exporter (both
+    directions), the tiering docs, and the /tiers manage route
+    (docs/tiering.md)."""
+    findings: List[Finding] = []
+    if not ctx.exists(tiering_rel):
+        return findings
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+
+    status_keys: Set[str] = set()
+    status_line = 1
+    for dotted in TIERING_LEDGERS:
+        keys, line = ledger_keys(ctx, tiering_rel, dotted)
+        status_keys |= {k.rsplit(".", 1)[-1] for k in keys}
+        status_line = line or status_line
+    status_keys = {k for k in status_keys if k.startswith("tier_")}
+    consumed = {
+        k for k in metrics_consumed_keys(
+            ctx, manage_rel, fn_name=TIER_EXPORT_FN
+        )
+        if k.startswith("tier_")
+    }
+    for key in sorted(status_keys - consumed):
+        findings.append(Finding(
+            rule="ITS-C007", file=manage_rel, line=1,
+            message=f"tier status key {key!r} is not exported by the "
+                    f"/metrics tier exporter ({TIER_EXPORT_FN}) — a "
+                    "capacity tier dashboards cannot see is observability "
+                    "drift (docs/tiering.md)",
+            key=f"ITS-C007:{manage_rel}:{key}",
+        ))
+    for key in sorted(consumed - status_keys):
+        findings.append(Finding(
+            rule="ITS-C007", file=manage_rel, line=1,
+            message=f"/metrics tier exporter consumes key {key!r} which "
+                    "the TierManager status snapshot no longer emits "
+                    "(KeyError at scrape time)",
+            key=f"ITS-C007:{manage_rel}:stale:{key}",
+        ))
+    for key in sorted(status_keys):
+        if key not in doc_words:
+            findings.append(Finding(
+                rule="ITS-C007", file=tiering_rel, line=status_line,
+                message=f"tier status key {key!r} is undocumented in "
+                        f"{docs_rel} — the tier counter vocabulary table "
+                        "must enumerate it",
+                key=f"ITS-C007:{tiering_rel}:undocumented:{key}",
+            ))
+    manage_src = ctx.read(manage_rel)
+    if (
+        not re.search(r'[\'"]/tiers[\'"]', manage_src)
+        or "tiering" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C007", file=manage_rel, line=1,
+            message="manage plane must serve GET /tiers from the cluster's "
+                    "TierManager status — the tiered-capacity-plane "
+                    "surface (docs/tiering.md)",
+            key=f"ITS-C007:{manage_rel}:tiers-route",
+        ))
     return findings
 
 

@@ -25,9 +25,11 @@ exactly what this pass does:
 
 - ITS-P003 **migration traffic is BACKGROUND, always.** Inside the
   membership subsystem (``membership.py`` — the resharder's copy/prune
-  machinery), every data-plane call (the batched ops AND the single-key
-  ``tcp_*_cache`` ops) must pass a ``priority`` whose expression names
-  BACKGROUND (``PRIORITY_BACKGROUND`` / ``wire.PRIORITY_BACKGROUND``).
+  machinery) and the tiered capacity plane (``tiering.py`` — the
+  demotion/promotion copy engine, docs/tiering.md), every data-plane
+  call (the batched ops AND the single-key ``tcp_*_cache`` ops) must
+  pass a ``priority`` whose expression names BACKGROUND
+  (``PRIORITY_BACKGROUND`` / ``wire.PRIORITY_BACKGROUND``).
   ITS-P002's "any explicit class" is not enough here: a reshard moving
   ~1/N of the pool at FOREGROUND priority would push the decode-blocking
   p99 exactly when the fleet is already churning (docs/membership.md,
@@ -58,7 +60,7 @@ SEMANTIC_EXC = {
 # future-parking, or the cluster degrade accounting.
 ROUTING_CALLS = {
     "_degrade", "_done", "_quarantine", "record_failure", "set_exception",
-    "_absorb", "_record", "fail",
+    "_absorb", "_record", "fail", "tier_done", "_cold_done",
 }
 
 # ITS-P001 exemptions (whole files): fault injection exists to fabricate
@@ -81,9 +83,10 @@ P002_EXEMPT_FILES = {
     "infinistore_tpu/benchmark.py",
 }
 
-# ITS-P003 scope: the membership subsystem's migration machinery, where
-# every data-plane op — batched AND single-key — must be BACKGROUND.
-P003_FILES = {"infinistore_tpu/membership.py"}
+# ITS-P003 scope: the membership subsystem's migration machinery AND the
+# tiered capacity plane's demotion/promotion copies (docs/tiering.md),
+# where every data-plane op — batched AND single-key — must be BACKGROUND.
+P003_FILES = {"infinistore_tpu/membership.py", "infinistore_tpu/tiering.py"}
 P003_OPS = BATCHED_OPS | {"tcp_read_cache", "tcp_write_cache"}
 
 

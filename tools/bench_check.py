@@ -408,6 +408,65 @@ CHECKS = [
             "wave (must be > 1.0, paired-interleaved)"
         ),
     ),
+    # Tiered capacity plane (ROADMAP-4, docs/tiering.md), three gates.
+    # Hot-set isolation: with a Zipf working set 4x the serving-RAM budget
+    # and the tail demoted to the pooled cold tier, the HOT set's load p99
+    # must stay within noise of the same workload on an all-RAM pool —
+    # sampled as order-alternating paired rounds over the two live pools
+    # with the min(median-of-ratios, ratio-of-sums) estimator (the weather
+    # rule). Honest history 0.87-1.02; 1.25 clears the single-core scatter
+    # while a tier plane that stalls hot reads (policy hooks on the hot
+    # path, fall-through probing serving hits) reads well past 1.5.
+    Check(
+        "tiering_hot_isolation",
+        ["tiering_hot_p99_ratio"],
+        lambda m: m["tiering_hot_p99_ratio"] <= 1.25,
+        lambda m: (
+            f"hot-set load p99 is {m['tiering_hot_p99_ratio']:.3f}x the "
+            "all-RAM run under a 4x working set (must be <= 1.25, "
+            "paired-interleaved)"
+        ),
+    ),
+    # Cold reads above the spill floor: the SAME tail roots read from the
+    # serving members' local spill (pre-demotion) vs the pooled cold tier
+    # (post-demotion). Honest range 0.90-2.25 on loopback (standalone the
+    # cold member's roomy RAM wins ~2x; inside the full bench the two
+    # phases straddle different weather windows and the ratio compresses
+    # toward 1) — 0.6 clears that spread while a per-key fallback storm
+    # or a broken batched cold path reads ~0.2.
+    Check(
+        "tiering_cold_floor",
+        ["tiering_cold_vs_spill_floor"],
+        lambda m: m["tiering_cold_vs_spill_floor"] >= 0.6,
+        lambda m: (
+            f"pooled-cold reads run {m['tiering_cold_vs_spill_floor']:.3f}x "
+            "the local-spill floor (must be >= 0.6)"
+        ),
+    ),
+    # Mechanism receipts: the temperature plane actually MOVED data both
+    # directions (demotion of the idle tail, promotion of an admitted
+    # reuse), the anti-scan admission rejected the one-touch cold reads,
+    # and every byte came back correct from whatever tier served it.
+    Check(
+        "tiering_mechanism",
+        ["tiering_demotions", "tiering_promotions", "tiering_admit_rejects",
+         "tiering_wrong_reads", "tiering_misses"],
+        lambda m: (
+            m["tiering_demotions"] >= 1
+            and m["tiering_promotions"] >= 1
+            and m["tiering_admit_rejects"] >= 1
+            and m["tiering_wrong_reads"] == 0
+            and m["tiering_misses"] == 0
+        ),
+        lambda m: (
+            f"{m['tiering_demotions']:.0f} demotions / "
+            f"{m['tiering_promotions']:.0f} promotions / "
+            f"{m['tiering_admit_rejects']:.0f} scan rejects, "
+            f"wrong={m['tiering_wrong_reads']:.0f} "
+            f"misses={m['tiering_misses']:.0f} "
+            "(needs movement both directions, rejects >= 1, 0 / 0)"
+        ),
+    ),
     # Crash-safe fleet coordination (ROADMAP-3, docs/membership.md), four
     # gates over the recovery leg's REAL-subprocess flow. Convergence is
     # binary: the client that kill -9'd itself mid-reshard (rc must be

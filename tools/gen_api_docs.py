@@ -49,6 +49,10 @@ SURFACE = [
         "MemberState", "MembershipView", "Membership", "Resharder",
         "DurableLog",
     ]),
+    ("infinistore_tpu.tiering", [
+        "TemperatureSketch", "TierPolicyConfig", "TierPolicy", "TierManager",
+        "note_demotion_hit", "demotion_hits", "note_cold_read_us",
+    ]),
     ("infinistore_tpu.faults", [
         "FaultRule", "FaultyConnection", "kill_transport", "crash_process",
     ]),

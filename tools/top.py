@@ -156,6 +156,37 @@ def render(frame: dict, width: int = 100) -> list:
         # lifetime descriptor volume, and the doorbell coalescing ratio
         # (descriptors per rx doorbell — high is good: posts were pure
         # shared memory while the server stayed awake).
+        # Tiered capacity plane (docs/tiering.md): per-tier bytes (RAM
+        # pool + local spill from the local server's gauges, cold-root
+        # count from the cluster plane), hit ratios across ram / cold /
+        # demotion-hit / miss outcomes, movement totals, and the two
+        # backlogs (demote = idle roots awaiting shipment, promote =
+        # admitted cold hits awaiting copy-back).
+        tcold = fam.get("infinistore_tier_cold_members")
+        if tcold is not None:
+            ram_b = fam.get('infinistore_pool_bytes{kind="used"}', 0)
+            spill_b = fam.get('infinistore_spill_bytes{kind="used"}', 0)
+            hits_ram = fam.get('infinistore_tier_hits{tier="ram"}', 0)
+            hits_cold = fam.get('infinistore_tier_hits{tier="cold"}', 0)
+            hits_dem = fam.get('infinistore_tier_hits{tier="demotion"}', 0)
+            miss = fam.get("infinistore_tier_misses", 0)
+            total = hits_ram + hits_cold + hits_dem + miss
+            ratio = (
+                f"ram {100 * hits_ram / total:.0f}% cold "
+                f"{100 * hits_cold / total:.0f}% miss "
+                f"{100 * miss / total:.0f}%" if total else "-"
+            )
+            lines.append(
+                f"tiers cold_members={tcold:.0f}  "
+                f"ram={ram_b / (1 << 20):.1f}MB spill={spill_b / (1 << 20):.1f}MB "
+                f"cold_roots={fam.get('infinistore_tier_cold_roots', 0):.0f}  "
+                f"hits [{ratio}]  "
+                f"demote={fam.get('infinistore_tier_demotions', 0):.0f}"
+                f"(bl={fam.get('infinistore_tier_demote_backlog', 0):.0f})  "
+                f"promote={fam.get('infinistore_tier_promotions', 0):.0f}"
+                f"(bl={fam.get('infinistore_tier_promote_backlog', 0):.0f})  "
+                f"cold_p99={fam.get('infinistore_tier_cold_read_p99_us', 0):.0f}us"
+            )
         rconns = fam.get("infinistore_ring_conns")
         if rconns:
             descs = fam.get("infinistore_ring_descriptors", 0)
