@@ -494,12 +494,17 @@ class ClusterKVConnector:
         self._breaker_factory = breaker_factory
         self.membership = Membership(self.member_ids)
         self.resharder = Resharder(self)
+        # its: guard[_catalog: _cat_lock]
         self._catalog: Dict[str, _RootRecord] = {}
         self._cat_lock = threading.Lock()
         # Serializes membership transitions (add/remove/mark_dead): the
         # member-array append + view publish must be atomic against OTHER
         # transitions (a rejected add's rollback must never delete a
         # concurrently admitted member's entries). Ops never take this.
+        # The member arrays follow the published-snapshot discipline: every
+        # writer holds the admin lock (construction-time restores aside);
+        # readers resolve indices through the immutable view, lock-free.
+        # its: guard[members, member_ids, _health: _admin_lock!w]
         self._admin_lock = threading.Lock()
         # Serializes breaker admission/outcome across threads: CircuitBreaker
         # itself is not thread-safe, and with the resharder worker feeding
@@ -513,6 +518,7 @@ class ClusterKVConnector:
         # (journal restore / gossip merge / bootstrap — closed with us),
         # and the replay summary (None when no journal or a fresh one).
         self._dial_factory = dial_factory or self._default_dial
+        # its: guard[_owned_dials: _admin_lock]
         self._owned_dials: List = []
         self._journal_log: Optional[DurableLog] = None
         self.recovered: Optional[dict] = None
@@ -999,12 +1005,16 @@ class ClusterKVConnector:
         self.resharder.stop()
         if self._journal_log is not None:
             self._journal_log.close()
-        for conn in self._owned_dials:
+        # Under the admin lock: a gossip merge dialing new members must
+        # never append into a list this teardown is clearing (ITS-R001
+        # guard discipline on _owned_dials).
+        with self._admin_lock:
+            dials, self._owned_dials = self._owned_dials, []
+        for conn in dials:
             try:
                 conn.close()
             except Exception:
                 pass
-        self._owned_dials = []
 
     # -- durable journal (crash-safe catalog + reshard state) ------------------
 
@@ -1036,9 +1046,10 @@ class ClusterKVConnector:
                 pass
         return conn
 
-    def _dial_member(self, member_id: str, state: str):
+    def _dial_member(self, member_id: str, state: str):  # its: construction
         """A ``_LazyMember`` over a self-dialed connection (readable states
-        get a connect attempt; tombstones just get the object)."""
+        get a connect attempt; tombstones just get the object).
+        Construction-time only (journal restore), before any thread."""
         conn = self._dial_factory(member_id, state in MemberState.READABLE)
         self._owned_dials.append(conn)
         return _LazyMember(member_id, conn, self._member_factory)
@@ -1155,7 +1166,7 @@ class ClusterKVConnector:
             if journal:
                 self._journal_root(root, rec)
 
-    def _replay_journal(self):
+    def _replay_journal(self):  # its: construction
         """Construction-time crash recovery: fold the journal's records
         (last-wins per key; ``drop`` tombstones keep dropped roots
         dropped), rebuild the member arrays in the journaled entry order
@@ -1227,7 +1238,7 @@ class ClusterKVConnector:
         if resume:
             self.resharder.kick()
 
-    def _restore_view(self, view_rec: dict):
+    def _restore_view(self, view_rec: dict):  # its: construction
         """Rebuild the member arrays in the JOURNALED entry order (indices
         are the identity the health/breaker arrays key on): constructor-
         provided connections slot in at their id's latest incarnation,
@@ -1326,7 +1337,7 @@ class ClusterKVConnector:
                     self._owned_dials.append(conn)
                     dialed[mid] = conn
 
-            def on_new(mid, state, _since):
+            def on_new(mid, state, _since):  # its: requires[ClusterKVConnector._admin_lock]
                 conn = dialed.pop(mid, None)
                 if conn is None:
                     # Construction only (connect=False): no I/O under the

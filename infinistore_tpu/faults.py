@@ -204,6 +204,12 @@ class FaultyConnection:
     _ASYNC_OPS = ("write_cache_async", "read_cache_async")
 
     def __init__(self, inner, rules: Sequence[FaultRule], seed: int = 0):
+        # Concurrency contract (ITS-R, races.CLASS_EXEMPT): the op
+        # counter, rule match state and audit log are deliberately
+        # lock-free — each wrapped connection is driven by ONE test
+        # thread, and a deterministic fault script requires a
+        # deterministic op order anyway (two racing drivers would make
+        # "fires at op #7" meaningless before any lock could help).
         self.inner = inner
         self.rules = list(rules)
         self.rng = random.Random(seed)

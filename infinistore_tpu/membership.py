@@ -125,12 +125,16 @@ class DurableLog:
 
     def __init__(self, path: str, fsync_interval_s: float = 0.05,
                  clock=time.monotonic):
+        # its: cross-thread  (event loop, resharder worker and operator
+        # threads all append; compaction runs from the worker)
         self.path = path
         self.fsync_interval_s = fsync_interval_s
         self._clock = clock
         self._lock = threading.Lock()
+        # its: guard[_f, _last_fsync: _lock]
         self._f = open(path, "ab")
         self._last_fsync = clock()
+        # its: guard[records, fsyncs, compactions: _lock!w]
         self.records = 0
         self.fsyncs = 0
         self.compactions = 0
@@ -221,6 +225,11 @@ class DurableLog:
             if self._f is None:
                 return
             if callable(records):
+                # The cluster's snapshot callable takes the catalog lock
+                # HERE, under the log lock — the one blessed direction of
+                # that pair. Summarized for the static lock-order graph
+                # (the callback indirection hides it from inference):
+                # its: acquires[ClusterKVConnector._cat_lock]
                 records = records()
             tmp = self.path + ".compact.tmp"
             with open(tmp, "wb") as f:
@@ -392,6 +401,10 @@ class Membership:
             raise ValueError(f"member_ids must be unique, got {list(member_ids)}")
         self._lock = threading.Lock()
         self._clock = clock
+        # The published-snapshot discipline (ITS-R001): every mutation
+        # happens under _lock and republishes _view; readers take the
+        # immutable view (or a single-reference read) lock-free.
+        # its: guard[epoch, epoch_changes, _entries: _lock!w]
         self.epoch = 1
         self._entries: List[_Entry] = [
             _Entry(mid, MemberState.ACTIVE, 1) for mid in member_ids
@@ -399,6 +412,7 @@ class Membership:
         self.epoch_changes = 0  # transitions applied (counter, not gauge)
         # Placement ids as of the last SETTLED view; the read-failover
         # fallback set while a transition is in flight. None when settled.
+        # its: guard[_prev_placement, _owner, _view: _lock!w]
         self._prev_placement: Optional[Tuple[str, ...]] = None
         # True while THIS process originated the pending transition: only
         # the originator finalizes (a gossip adopter with an empty catalog
@@ -482,7 +496,7 @@ class Membership:
     def add_member(self, member_id: str) -> MembershipView:
         """Admit ``member_id`` as JOINING (it immediately takes new writes;
         the resharder copies its rendezvous share of existing roots)."""
-        def apply():
+        def apply():  # its: requires[_lock]
             try:
                 live = self._entry(member_id).state
             except KeyError:
@@ -502,7 +516,7 @@ class Membership:
         Refused for the LAST placement member — a graceful drain promises
         the data survives, and there would be nowhere to re-mirror it
         (``mark_dead`` remains available to record a real crash)."""
-        def apply():
+        def apply():  # its: requires[_lock]
             e = self._entry(member_id)
             if e.state not in (MemberState.JOINING, MemberState.ACTIVE):
                 raise ValueError(
@@ -525,7 +539,7 @@ class Membership:
     def mark_dead(self, member_id: str) -> MembershipView:
         """Write a member off: out of placement AND unreadable. Its copies
         are lost; the resharder re-replicates from surviving replicas."""
-        def apply():
+        def apply():  # its: requires[_lock]
             e = self._entry(member_id)
             if e.state in MemberState.TERMINAL:
                 raise ValueError(
@@ -831,11 +845,16 @@ class Resharder:
         self.retry_backoff_s = retry_backoff_s
         self._clock = clock
         self._cv = threading.Condition()
+        # its: guard[_dirty: _cv]
         self._dirty = False
+        # its: guard[_stop, _active: _cv!w]
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self._active = False  # worker mid-pass or debt outstanding
-        # Counters (reshard_* vocabulary — docs/membership.md).
+        # Counters (reshard_* vocabulary — docs/membership.md). Written
+        # only on the reconciler thread (cluster.reshard_plan's
+        # lost-roots bump runs there too); progress() snapshots them.
+        # its: guard[_c: single_writer]
         self._c = {
             "reshard_passes": 0,
             "reshard_replans": 0,
@@ -942,9 +961,12 @@ class Resharder:
             with self._cv:
                 if debt and not self._stop:
                     # Failed roots stay as debt: retry with a light backoff
-                    # (a kicked epoch change interrupts the sleep).
+                    # (a kicked epoch change interrupts the sleep). A timed
+                    # backoff, not a predicate wait: a spurious wake only
+                    # retries the pass sooner, and the loop-top while
+                    # re-checks _dirty/_stop before the next sleep.
                     self._dirty = True
-                    self._cv.wait(timeout=backoff)
+                    self._cv.wait(timeout=backoff)  # its: allow[ITS-R004]
                     backoff = min(backoff * 2.0, 1.0)
                 else:
                     backoff = self.retry_backoff_s

@@ -23,6 +23,7 @@ import dataclasses
 import json
 import signal
 import sys
+import threading
 import urllib.parse
 
 from . import lib as _lib
@@ -625,7 +626,12 @@ class ManageServer:
         self.gossip = gossip
         self._server = None
         # member_id -> InfinityConnection this manage plane connected
-        # (POST add); swept once the member goes terminal.
+        # (POST add); swept once the member goes terminal. Guarded: the
+        # add runs on an executor thread (_add_member_blocking) while a
+        # concurrent /membership request sweeps on the event loop —
+        # unguarded, the insert can race the pop (ITS-R001).
+        # its: guard[_owned_conns: _conns_lock]
+        self._conns_lock = threading.Lock()
         self._owned_conns = {}
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -831,18 +837,26 @@ class ManageServer:
         (REMOVED after a drain completes, DEAD after a crash). Lazy: runs
         on each /membership request, so a leave's connection lives exactly
         until its drain finalizes."""
-        if self.cluster is None or not self._owned_conns:
+        if self.cluster is None or not self._owned_conns:  # its: allow[ITS-R001]
             return
         from .membership import MemberState
 
         view = self.cluster.membership.view()
-        for mid in list(self._owned_conns):
-            if view.state_of(mid) in MemberState.TERMINAL:
-                conn = self._owned_conns.pop(mid)
-                try:
-                    conn.close()
-                except Exception:
-                    pass
+        doomed = []
+        # Audited bare read above: an empty-check racing an insert only
+        # defers the sweep to the next request. The pop itself is guarded.
+        # Audited lock-on-loop: O(members) dict scan + pop, no I/O — the
+        # blocking close() runs after release (same discipline as the
+        # cluster's _cat_lock sites).
+        with self._conns_lock:  # its: allow[ITS-L003]
+            for mid in list(self._owned_conns):
+                if view.state_of(mid) in MemberState.TERMINAL:
+                    doomed.append(self._owned_conns.pop(mid))
+        for conn in doomed:
+            try:
+                conn.close()
+            except Exception:
+                pass
 
     def _membership_get(self) -> bytes:
         """GET /membership: the epoch-stamped view (per-member states) plus
@@ -1005,7 +1019,8 @@ class ManageServer:
             raise
         # Admitted: the manage plane owns this connection until the member
         # goes terminal (_sweep_owned_conns).
-        self._owned_conns[member_id] = conn
+        with self._conns_lock:
+            self._owned_conns[member_id] = conn
         return view
 
     def _selftest(self) -> dict:

@@ -92,6 +92,7 @@ class EventJournal:
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = capacity
+        # its: guard[_events, _seq, emitted, _counts: _lock]
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._seq = 0
@@ -279,17 +280,19 @@ class SloEngine:
         self._max_window = max((w[1] for w in self.windows), default=3600.0)
         self._lock = threading.Lock()
         # name -> deque[[bucket_start_s, good, bad]]
+        # its: guard[_buckets, _lat, _firing: _lock]
         self._buckets: Dict[str, deque] = {}
         # latency objectives: name -> deque[[bucket_start_s, {le_us: count}]]
         self._lat: Dict[str, deque] = {}
         # (objective, long_s) -> firing bool; plus the fire-edge counter.
         self._firing: Dict[Tuple[str, float], bool] = {}
+        # its: guard[alerts_total: _lock!w]
         self.alerts_total = 0
 
     # -- feeding -------------------------------------------------------------
 
     def _bucket(self, store: Dict[str, deque], name: str, now: float,
-                empty) -> list:
+                empty) -> list:  # its: requires[_lock]
         dq = store.setdefault(name, deque())
         start = now - (now % self.bucket_s)
         if not dq or dq[-1][0] != start:
@@ -334,7 +337,7 @@ class SloEngine:
     # -- window math ---------------------------------------------------------
 
     def _window_counts(self, name: str, window_s: float,
-                       now: float) -> Tuple[int, int]:
+                       now: float) -> Tuple[int, int]:  # its: requires[_lock]
         dq = self._buckets.get(name)
         if not dq:
             return 0, 0
@@ -568,6 +571,7 @@ class FleetScraper:
         self.fail_threshold = fail_threshold
         self.backoff_s = backoff_s
         self._clock = clock
+        # its: guard[_targets: _lock]
         self._targets: List[_TargetState] = []
         self._lock = threading.Lock()
         # Serializes whole scrape passes: the background thread and an
@@ -577,8 +581,10 @@ class FleetScraper:
         self._pass_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # its: guard[scrapes_total, scrape_failures_total: _pass_lock!w]
         self.scrapes_total = 0
         self.scrape_failures_total = 0
+        # its: guard[_prev_debt: _pass_lock]
         self._prev_debt: Optional[int] = None
         for t in targets:
             self.add_target(*t)
@@ -637,7 +643,7 @@ class FleetScraper:
             st.ops_per_s = total_delta / dt
         st.queue_depth = stats.get("suspended_ops", 0)
 
-    def _feed_cluster(self):
+    def _feed_cluster(self):  # its: requires[_pass_lock]
         """Reshard-drain SLI from the attached cluster: a scrape tick is
         GOOD when the migration debt is zero or shrinking, BAD when debt
         exists and did not drain since the last look."""
@@ -671,7 +677,7 @@ class FleetScraper:
         with self._pass_lock:
             return self._scrape_pass(spans)
 
-    def _scrape_pass(self, want_spans: bool = True) -> dict:
+    def _scrape_pass(self, want_spans: bool = True) -> dict:  # its: requires[_pass_lock]
         now = self._clock()
         ok = skipped = failed = 0
         with self._lock:
@@ -740,8 +746,11 @@ class FleetScraper:
                 self.scrape_once(spans=False)
             except Exception:
                 # The scraper must never die to one bad payload; per-target
-                # failures are already counted in scrape_once.
-                self.scrape_failures_total += 1
+                # failures are already counted in scrape_once. Counter under
+                # the pass lock: a concurrent on-demand pass increments the
+                # same total (ITS-R001 guard discipline).
+                with self._pass_lock:
+                    self.scrape_failures_total += 1
             if self._stop.wait(self.interval_s):
                 return
 
@@ -834,14 +843,25 @@ class GossipAgent:
         self.journal = journal if journal is not None else get_journal()
         self._clock = clock
         self._lock = threading.Lock()
+        # its: guard[_targets: _lock]
         self._targets: List[_TargetState] = []
+        # Serializes whole gossip rounds (ITS-R audit, PR 13): the
+        # background thread and a manual round (tools/fleet, tests) used
+        # to interleave freely — double-counting the round ledger and
+        # racing two merge_remote_view pulls of the same payload. The
+        # FleetScraper grew the same pass lock in PR 8; this is the
+        # gossip agent's missing post-review hardening.
+        self._round_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # its: guard[rounds, exchanges, exchange_failures: _round_lock!w]
         self.rounds = 0
         self.exchanges = 0
         self.exchange_failures = 0
+        # its: guard[merges_in, merges_out: _round_lock!w]
         self.merges_in = 0   # this process adopted a peer's knowledge
         self.merges_out = 0  # a peer adopted ours (its response said so)
+        # its: guard[last_epoch_seen, last_round_ms: _round_lock!w]
         self.last_epoch_seen = 0
         self.last_round_ms = 0.0
         for p in peers:
@@ -864,8 +884,21 @@ class GossipAgent:
     def exchange_once(self) -> dict:
         """One gossip round over every admitted peer (blocking HTTP —
         callers keep this off the event loop; the background thread and
-        tests drive it). Returns ``{"ok", "failed", "skipped",
-        "adopted"}`` and journals one ``gossip_round`` event."""
+        tests drive it). Concurrent callers serialize on the round lock —
+        the second round runs after the first (same discipline as the
+        scraper's pass lock). Returns ``{"ok", "failed", "skipped",
+        "adopted"}`` and journals one ``gossip_round`` event (emitted
+        OUTSIDE the round lock — the ITS-R003 discipline)."""
+        with self._round_lock:
+            summary, epoch = self._exchange_round()
+        self.journal.emit(
+            "gossip_round", epoch=epoch, peers_ok=summary["ok"],
+            peers_failed=summary["failed"], peers_skipped=summary["skipped"],
+            adopted=summary["adopted"],
+        )
+        return summary
+
+    def _exchange_round(self):  # its: requires[_round_lock]
         t0 = self._clock()
         payload = self.cluster.gossip_payload()
         ok = failed = skipped = 0
@@ -916,12 +949,8 @@ class GossipAgent:
         self.last_round_ms = round((self._clock() - t0) * 1e3, 3)
         epoch = int(self.cluster.membership.view().epoch)
         self.last_epoch_seen = max(self.last_epoch_seen, epoch)
-        self.journal.emit(
-            "gossip_round", epoch=epoch, peers_ok=ok, peers_failed=failed,
-            peers_skipped=skipped, adopted=adopted,
-        )
         return {"ok": ok, "failed": failed, "skipped": skipped,
-                "adopted": adopted}
+                "adopted": adopted}, epoch
 
     # -- background loop -----------------------------------------------------
 
@@ -943,8 +972,11 @@ class GossipAgent:
                 self.exchange_once()
             except Exception:
                 # One malformed local payload must not kill anti-entropy;
-                # per-peer failures are already counted in exchange_once.
-                self.exchange_failures += 1
+                # per-peer failures are already counted in the round. The
+                # counter takes the round lock — a concurrent manual round
+                # increments the same ledger (ITS-R001 guard discipline).
+                with self._round_lock:
+                    self.exchange_failures += 1
             if self._stop.wait(self.interval_s):
                 return
 

@@ -149,10 +149,12 @@ class TemperatureSketch:
         self.reuse_window_s = reuse_window_s
         self._clock = clock
         self._mask = cap - 1
+        # its: guard[_sig, _last, _streak: _lock]
         self._sig = [0] * cap    # 0 = empty
         self._last = [0.0] * cap
         self._streak = [0] * cap
         self._lock = threading.Lock()
+        # its: guard[tracked, evictions: _lock!w]
         self.tracked = 0
         self.evictions = 0
 
@@ -300,15 +302,25 @@ class TierManager:
         self.max_moves_per_pass = max_moves_per_pass
         self._clock = clock
         self._cv = threading.Condition()
-        self._dirty = False
-        self._stop = False
+        self._dirty = False   # its: guard[_dirty: _cv]
+        self._stop = False    # its: guard[_stop: _cv!w]
         self._thread: Optional[threading.Thread] = None
         # Promotion requests from the read path (root ids), deduped.
+        # its: guard[_promote_queue, _promote_set: _cv]
         self._promote_queue: List[str] = []
         self._promote_set: set = set()
+        # Counter/latency ledger lock (ITS-R001 confirmed race, PR 13): the
+        # tier_* counters are bumped from the reconciler thread AND the
+        # read-path hooks (asyncio loop via _cold_load, scheduler threads
+        # via lookup) — unguarded `_c[k] += 1` loses updates under the
+        # forced interleaving in tests/test_interleave.py. Held for O(1)
+        # item updates and the status() snapshot only.
+        self._stats_lock = threading.Lock()
         # Bounded recent cold-read latencies for the p99 status gauge (the
         # authoritative windowed view lives in the SLO engine).
+        # its: guard[_cold_lat_us: _stats_lock]
         self._cold_lat_us: List[float] = []
+        # its: guard[_c: _stats_lock]
         self._c = {
             "tier_ram_hits": 0,
             "tier_cold_hits": 0,
@@ -327,6 +339,17 @@ class TierManager:
             "tier_wrong_reads": 0,
             "tier_last_pass_ms": 0.0,
         }
+
+    def _bump(self, key: str, n=1):
+        """Serialized counter update: every ``tier_*`` mutation routes
+        through the stats lock (reconciler thread and read-path hooks
+        write concurrently; see ``_stats_lock``)."""
+        with self._stats_lock:
+            self._c[key] += n
+
+    def _set_stat(self, key: str, value):
+        with self._stats_lock:
+            self._c[key] = value
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -358,8 +381,13 @@ class TierManager:
     def _run(self):
         while True:
             with self._cv:
-                if not self._dirty and not self._stop:
-                    self._cv.wait(timeout=self.interval_s)
+                # Predicate-looped wait (ITS-R004): a spurious wake must
+                # re-check dirty/stop, not charge into a pass; only a real
+                # TIMEOUT (wait() returns False) breaks out for the
+                # periodic demotion scan.
+                while not self._dirty and not self._stop:
+                    if not self._cv.wait(timeout=self.interval_s):
+                        break
                 if self._stop:
                     return
                 self._dirty = False
@@ -371,11 +399,11 @@ class TierManager:
     # -- read-path hooks (called by the cluster) -------------------------------
 
     def note_ram_hit(self, root: str):
-        self._c["tier_ram_hits"] += 1
+        self._bump("tier_ram_hits")
         self.policy.on_access(root)
 
     def note_miss(self, root: Optional[str]):
-        self._c["tier_misses"] += 1
+        self._bump("tier_misses")
         if root is not None:
             self.policy.on_access(root)
 
@@ -383,22 +411,23 @@ class TierManager:
         """The engine's admission path skipped the staged prefetch for a
         cold-only root and took the direct one-phase load
         (docs/tiering.md, the DAK argument)."""
-        self._c["tier_direct_reads"] += 1
+        self._bump("tier_direct_reads")
 
     def note_cold_hit(self, root: str, read_us: Optional[float] = None):
         """A read was served from the cold pool: count it, feed the SLO
         engine's ``cold_latency`` objective, and — when the policy's
         admission test passes — queue a promotion back to the serving
         tier. One-touch scans are REJECTED (counted) and stay cold."""
-        self._c["tier_cold_hits"] += 1
+        self._bump("tier_cold_hits")
         self.policy.on_access(root)
         if read_us is not None:
-            self._c["tier_cold_reads"] += 1
             note_cold_read_us(read_us)
-            lat = self._cold_lat_us
-            lat.append(float(read_us))
-            if len(lat) > 512:
-                del lat[: len(lat) - 512]
+            with self._stats_lock:
+                self._c["tier_cold_reads"] += 1
+                lat = self._cold_lat_us
+                lat.append(float(read_us))
+                if len(lat) > 512:
+                    del lat[: len(lat) - 512]
         if self.policy.should_promote(root):
             # Queue + notify only: the worker runs when the owner started
             # it (ClusterKVConnector does by default; tests/bench pass
@@ -410,7 +439,7 @@ class TierManager:
                 self._dirty = True
                 self._cv.notify_all()
         else:
-            self._c["tier_admit_rejects"] += 1
+            self._bump("tier_admit_rejects")
 
     # -- one reconcile pass ----------------------------------------------------
 
@@ -436,7 +465,7 @@ class TierManager:
             # scan — even a pathologically low demote_idle_s must not
             # undo a promotion in the same breath.
             demoted = self._demote_scan(budget, exempt=set(batch))
-        self._c["tier_last_pass_ms"] = round((self._clock() - t0) * 1e3, 3)
+        self._set_stat("tier_last_pass_ms", round((self._clock() - t0) * 1e3, 3))
         return {"promoted": promoted, "demoted": demoted}
 
     def _catalog_items(self):
@@ -497,14 +526,14 @@ class TierManager:
                 src_id = mid
                 break
         if copied is None:
-            self._c["tier_demote_failures"] += 1
+            self._bump("tier_demote_failures")
             return False
         keys_moved, bytes_moved, skipped = copied
         if skipped:
             # A holey cold copy must never justify deleting the complete
             # serving one (the resharder's prune-safety rule).
             cluster.catalog_add_holder(root, cold_id, 0)
-            self._c["tier_demote_failures"] += 1
+            self._bump("tier_demote_failures")
             return False
         if not cluster.catalog_add_holder(root, cold_id, blocks):
             # The root was dropped while the copy was in flight: the cold
@@ -512,9 +541,9 @@ class TierManager:
             # would resurrect a dropped prompt (the resharder's rule).
             self._undo_copy(root, tokens, blocks, cold_id, cold=True)
             return False
-        self._c["tier_demotions"] += 1
-        self._c["tier_demoted_keys"] += keys_moved
-        self._c["tier_demoted_bytes"] += bytes_moved
+        self._bump("tier_demotions")
+        self._bump("tier_demoted_keys", keys_moved)
+        self._bump("tier_demoted_bytes", bytes_moved)
         telemetry.emit(
             "tier_demotion", member=cold_id,
             epoch=cluster.membership.view().epoch,
@@ -612,9 +641,9 @@ class TierManager:
                     # Dropped mid-promotion: undo the stray serving copy.
                     self._undo_copy(root, rec.tokens, lv, dst, cold=False)
                     return False
-                self._c["tier_promotions"] += 1
-                self._c["tier_promoted_keys"] += keys_moved
-                self._c["tier_promoted_bytes"] += bytes_moved
+                self._bump("tier_promotions")
+                self._bump("tier_promoted_keys", keys_moved)
+                self._bump("tier_promoted_bytes", bytes_moved)
                 # A promotion IS a temperature touch: the freshly promoted
                 # root must not bounce straight back to cold on the next
                 # idle scan (promote/demote ping-pong).
@@ -627,7 +656,7 @@ class TierManager:
                 ok_any = True
                 break
         if not ok_any:
-            self._c["tier_promote_failures"] += 1
+            self._bump("tier_promote_failures")
         return ok_any
 
     # -- the copy engine (the resharder's discipline) --------------------------
@@ -794,12 +823,14 @@ class TierManager:
             elif any(m in readable and lv > 0 for m, lv in holders.items()):
                 if cold_index and self.policy.should_demote(root):
                     demote_backlog += 1
-        lat = sorted(self._cold_lat_us)
+        with self._stats_lock:
+            counters = dict(self._c)
+            lat = sorted(self._cold_lat_us)
         p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0.0
         with self._cv:
             backlog = len(self._promote_queue)
         return {
-            **self._c,
+            **counters,
             "tier_cold_members": len(cold_index),
             "tier_cold_roots": cold_roots,
             "tier_tracked_roots": self.policy.sketch.tracked,

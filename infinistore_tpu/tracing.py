@@ -203,10 +203,14 @@ class FlightRecorder:
         self.capacity = capacity
         self.slow_op_us = slow_op_us  # 0 = watchdog off
         self.slow_capacity = max(1, slow_capacity)
+        # its: cross-thread  (spans finish on loop, engine and worker
+        # threads alike; the manage plane snapshots)
+        # its: guard[_slots, _next, _slow: _lock]
         self._slots: List[Optional[Span]] = [None] * capacity
         self._next = 0  # monotone: total spans ever recorded
         self._slow: List[dict] = []
         self._lock = threading.Lock()
+        # its: guard[recorded, dropped, slow_ops_total: _lock!w]
         self.recorded = 0
         self.dropped = 0  # spans a full ring overwrote
         self.slow_ops_total = 0
@@ -235,7 +239,7 @@ class FlightRecorder:
                     # to fail the recording hot path.
                     pass
 
-    def _capture_slow_locked(self, span: Span):
+    def _capture_slow_locked(self, span: Span):  # its: requires[_lock]
         self.slow_ops_total += 1
         tree = [s.as_dict() for s in self._slots
                 if s is not None and s.trace_id == span.trace_id]

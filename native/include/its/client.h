@@ -29,6 +29,7 @@
 
 #include "its/iovec_util.h"
 #include "its/protocol.h"
+#include "its/thread_safety.h"
 
 namespace its {
 
@@ -251,7 +252,7 @@ class Connection {
     std::atomic<bool> stop_{false};
 
     std::mutex submit_mu_;
-    std::vector<std::unique_ptr<Request>> submitted_;
+    std::vector<std::unique_ptr<Request>> submitted_ ITS_GUARDED_BY(submit_mu_);
 
     // Seqlock-style counter bracketing every reactor region that touches
     // caller memory (writev from tx_payload, readv into rx_addrs, shm
@@ -281,14 +282,14 @@ class Connection {
     bool rx_setup_done_ = false;
 
     mutable std::mutex mr_mu_;
-    std::vector<std::pair<const char*, size_t>> regions_;
+    std::vector<std::pair<const char*, size_t>> regions_ ITS_GUARDED_BY(mr_mu_);
 
     // Completion ring (see set_completion_fd). Pushed by the reactor (and by
     // fail_all on close), drained by the owning event loop — and, at
     // teardown, by the closing thread.
     std::atomic<int> comp_fd_{-1};
     std::mutex ring_mu_;
-    std::vector<std::pair<uint64_t, int32_t>> ring_;
+    std::vector<std::pair<uint64_t, int32_t>> ring_ ITS_GUARDED_BY(ring_mu_);
     // Wakeup-coalescing counters (see completion_counters).
     std::atomic<uint64_t> comp_pushed_{0};
     std::atomic<uint64_t> comp_signalled_{0};
@@ -301,14 +302,14 @@ class Connection {
         std::string name;  // empty once unlinked (server declined)
         bool server_mapped = false;
     };
-    std::vector<ClientSeg> client_segs_;  // guarded by mr_mu_
+    std::vector<ClientSeg> client_segs_ ITS_GUARDED_BY(mr_mu_);
     const ClientSeg* find_seg(const void* base, size_t span) const;
 
     // Shm fast-path state. Written at connect (handshake) and by the reactor
     // (on-demand mapping of auto-extended pools); guarded for the overlap.
     std::atomic<bool> shm_ok_{false};
     mutable std::mutex shm_mu_;
-    std::unordered_map<uint16_t, ShmMap> shm_pools_;
+    std::unordered_map<uint16_t, ShmMap> shm_pools_ ITS_GUARDED_BY(shm_mu_);
 
     // Descriptor-ring state (docs/descriptor_ring.md; "dring" because the
     // PR 2 completion ring above already owns the plain ring_/ring_mu_
@@ -320,10 +321,14 @@ class Connection {
     std::unique_ptr<RingState> dring_;
     std::atomic<bool> ring_ok_{false};
     mutable std::mutex dring_mu_;
-    std::unordered_map<uint64_t, std::unique_ptr<Request>> ring_inflight_;
-    uint64_t ring_next_token_ = 1;  // guarded by dring_mu_
-    uint64_t ring_sq_seq_ = 0;      // descriptors posted (guarded by dring_mu_)
-    uint64_t ring_cq_seq_ = 0;      // completions consumed (reactor-only)
+    std::unordered_map<uint64_t, std::unique_ptr<Request>> ring_inflight_
+        ITS_GUARDED_BY(dring_mu_);
+    uint64_t ring_next_token_ ITS_GUARDED_BY(dring_mu_) = 1;
+    uint64_t ring_sq_seq_ ITS_GUARDED_BY(dring_mu_) = 0;  // descriptors posted
+    // Completions consumed: reactor-only by design (drain_cq runs on the
+    // reactor thread; ring_teardown zeroes it under dring_mu_ after the
+    // reactor stopped) — deliberately NOT capability-annotated.
+    uint64_t ring_cq_seq_ = 0;
     // Ledger (ring_counters): posted descriptors, doorbells actually sent,
     // ring-full and oversized-meta socket fallbacks, CQ completions.
     std::atomic<uint64_t> ring_posted_{0};

@@ -33,6 +33,7 @@
 #include "its/kvstore.h"
 #include "its/mempool.h"
 #include "its/protocol.h"
+#include "its/thread_safety.h"
 
 namespace its {
 
@@ -217,7 +218,7 @@ class Server {
     std::atomic<bool> stop_requested_{false};
 
     std::mutex posted_mu_;
-    std::vector<std::function<void()>> posted_;
+    std::vector<std::function<void()>> posted_ ITS_GUARDED_BY(posted_mu_);
 
     std::unordered_map<int, std::unique_ptr<Conn>> conns_;
     // Connections with a suspended sliced segment op, split by QoS class.

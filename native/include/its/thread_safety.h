@@ -1,0 +1,37 @@
+// Clang thread-safety capability annotations (-Wthread-safety) — the
+// native side of the ITS-R concurrency discipline (docs/static_analysis.md).
+//
+// The Python checker (tools/analysis/races.py) enforces declared guards on
+// the client-side shared state; these macros give the C++ client/server
+// structs the same contract, checked by clang's static analysis on the
+// clang build path (the Makefile turns the warnings into errors there;
+// gcc expands them to nothing). TSAN (`make -C native check-tsan`) covers
+// dynamically what the annotations cannot express (the cross-process ring
+// atomics in ring.h, which are __atomic by construction).
+//
+// Only the subset this codebase uses is defined; see
+// https://clang.llvm.org/docs/ThreadSafetyAnalysis.html for semantics.
+#pragma once
+
+#if defined(__clang__) && defined(__has_attribute)
+#define ITS_TS_ATTR(x) __attribute__((x))
+#else
+#define ITS_TS_ATTR(x)  // gcc / msvc: annotations compile away
+#endif
+
+// On a mutex member: this state may only be touched while `mu` is held.
+#define ITS_GUARDED_BY(mu) ITS_TS_ATTR(guarded_by(mu))
+// On a pointer member: the POINTED-TO data is guarded (the pointer itself
+// may be read to compare/null-check without the lock).
+#define ITS_PT_GUARDED_BY(mu) ITS_TS_ATTR(pt_guarded_by(mu))
+// On a function: callers must hold `mu` (the `# its: requires[...]`
+// contract, natively).
+#define ITS_REQUIRES(mu) ITS_TS_ATTR(requires_capability(mu))
+// On a function: it acquires/releases `mu` internally (lock wrappers).
+#define ITS_ACQUIRE(mu) ITS_TS_ATTR(acquire_capability(mu))
+#define ITS_RELEASE(mu) ITS_TS_ATTR(release_capability(mu))
+// On a function: it must NOT be called with `mu` held (deadlock fences).
+#define ITS_EXCLUDES(mu) ITS_TS_ATTR(locks_excluded(mu))
+// Escape hatch for audited sites the analysis cannot see through
+// (teardown paths where single-threadedness is established by joins).
+#define ITS_NO_THREAD_SAFETY_ANALYSIS ITS_TS_ATTR(no_thread_safety_analysis)

@@ -31,6 +31,7 @@ from tools.analysis import (  # noqa: E402
     counters,
     loop_block,
     policy,
+    races,
     trace_stages,
     wire_drift,
 )
@@ -597,9 +598,16 @@ class TestFramework:
         payload = json.loads(out.read_text())
         assert payload["failed"] is False
         assert set(payload["per_checker"]) == {
-            "counters", "loop_block", "policy", "trace_stages", "wire_drift",
+            "counters", "loop_block", "policy", "races", "trace_stages",
+            "wire_drift",
         }
         assert payload["counts"]["new"] == 0
+        # Per-rule-family drift rows: every checker reports its finding
+        # counts AND wall-clock, so the CI receipt shows which family is
+        # growing (the bench-receipt pattern).
+        for name, row in payload["per_checker"].items():
+            assert set(row) == {"new", "baselined", "suppressed", "ms"}, name
+            assert row["ms"] >= 0.0
 
     def test_cli_rejects_unknown_checker(self):
         proc = subprocess.run(
@@ -1244,3 +1252,284 @@ class TestTraceStages:
         assert set(tick_map.values()) == {
             "server_recv", "first_slice", "last_slice",
         }
+
+
+# ---------------------------------------------------------------------------
+# races (ITS-R*): cross-thread shared-state discipline
+# ---------------------------------------------------------------------------
+
+def mutated_pkg(tmp_path, rel, sub=None, append=""):
+    """Fixture tree holding a copy of ONE real package module with a
+    targeted mutation (the wire-drift pattern: anchors must exist, so a
+    refactor that moves them fails loudly instead of testing nothing)."""
+    src = (REPO / rel).read_text()
+    if sub is not None:
+        old, new = sub
+        assert old in src, f"fixture anchor missing from {rel}: {old!r}"
+        src = src.replace(old, new, 1)
+    src += append
+    return make_tree(tmp_path, {rel: src})
+
+
+class TestRaces:
+    def test_real_tree_is_clean_after_suppressions(self):
+        ctx = core.Context(str(REPO))
+        found = races.scan(ctx)
+        assert not [f for f in found if not ctx.suppressed(f)]
+
+    def test_registry_classifies_the_daemon_owners(self):
+        """The shared-state registry must see the known worker-thread
+        owners (a regression that stops classifying them would also stop
+        finding anything)."""
+        ctx = core.Context(str(REPO))
+        names = {sc.cls.name for sc in races.build_registry(ctx)}
+        for expected in ("TierManager", "Resharder", "FleetScraper",
+                         "GossipAgent", "Membership", "ClusterKVConnector",
+                         "EventJournal", "DurableLog"):
+            assert expected in names, expected
+
+    # -- R001: guard discipline over mutated REAL sources -------------------
+
+    def test_removed_guard_annotation_fires(self, tmp_path):
+        """Deleting the `guard[_c: _stats_lock]` declaration re-exposes
+        the confirmed PR 13 race: TierManager._c is written on both sides
+        with no declared guard."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/tiering.py",
+            sub=("# its: guard[_c: _stats_lock]", "#"),
+        )
+        found = races.scan(ctx, docs=False)
+        assert any(
+            f.rule == "ITS-R001" and f.key.endswith("TierManager._c")
+            for f in found
+        )
+
+    def test_access_outside_declared_guard_fires(self, tmp_path):
+        """Stripping the lock out of _bump (the declared guard stays)
+        must fire the dominance check on the bare write."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/tiering.py",
+            sub=(
+                "        with self._stats_lock:\n            self._c[key] += n",
+                "        if True:\n            self._c[key] += n",
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        hits = [
+            f for f in found
+            if f.rule == "ITS-R001" and "TierManager._c" in f.key
+            and "_bump" in f.key
+        ]
+        assert hits and "outside its declared guard" in hits[0].message
+
+    def test_single_writer_violation_fires(self, tmp_path):
+        """A single_writer ledger written from BOTH sides is a lie: seed a
+        loop-side write into Resharder (declared single_writer) and the
+        checker must fire."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/membership.py",
+            sub=(
+                "    def kick(self):\n        \"\"\"Wake the reconciler",
+                "    def kick(self):\n"
+                "        self._c[\"reshard_passes\"] += 0  # seeded\n"
+                "        \"\"\"Wake the reconciler",
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        assert any(
+            f.rule == "ITS-R001" and "Resharder._c" in f.key
+            and "single-writer" in f.key
+            for f in found
+        )
+
+    # -- R002: lock-order cycles --------------------------------------------
+
+    def test_inverted_lock_order_fires(self, tmp_path):
+        """add_member nests _cat_lock under _admin_lock; appending one
+        function taking them in the OPPOSITE order closes a deadlock
+        cycle the graph must report."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/cluster.py",
+            append=(
+                "\n\ndef _seeded_inversion(self):\n"
+                "    with self._cat_lock:\n"
+                "        with self._admin_lock:\n"
+                "            pass\n"
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        cycles = [f for f in found if f.rule == "ITS-R002" and "cycle" in f.key]
+        assert cycles and any(
+            "_admin_lock" in f.message and "_cat_lock" in f.message
+            for f in cycles
+        )
+
+    def test_reacquiring_a_plain_lock_fires(self, tmp_path):
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/cluster.py",
+            append=(
+                "\n\ndef _seeded_reacquire(self):\n"
+                "    with self._cat_lock:\n"
+                "        with self._cat_lock:\n"
+                "            pass\n"
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        assert any(
+            f.rule == "ITS-R002" and "reacquire" in f.key for f in found
+        )
+
+    def test_real_lock_order_graph_is_acyclic(self):
+        idx = races.PackageIndex(core.Context(str(REPO)))
+        edges = races.lock_order_edges(idx)
+        assert races.find_cycles(edges) == []
+        # The blessed journal-compaction direction is in the graph (the
+        # `its: acquires[...]` summary; the tracer validates it live).
+        assert ("DurableLog._lock", "ClusterKVConnector._cat_lock") in edges
+
+    # -- R003: journal/emit outside engine locks -----------------------------
+
+    def test_journal_under_catalog_lock_fires(self, tmp_path):
+        """Moving catalog_add_holder's journal append INSIDE the catalog
+        lock breaks the emit-outside-lock discipline structurally."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/cluster.py",
+            sub=(
+                "            rec.holders[member_id] = "
+                "max(rec.holders.get(member_id, 0), blocks)\n",
+                "            rec.holders[member_id] = "
+                "max(rec.holders.get(member_id, 0), blocks)\n"
+                "            self._journal_append({\"k\": \"seeded\"})\n",
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        hits = [
+            f for f in found
+            if f.rule == "ITS-R003" and "catalog_add_holder" in f.key
+        ]
+        assert hits and "_cat_lock" in hits[0].message
+
+    def test_real_tree_honors_emit_discipline(self):
+        ctx = core.Context(str(REPO))
+        idx = races.PackageIndex(ctx)
+        assert races.check_r003(ctx, idx) == []
+
+    # -- R004: predicate-looped condition waits ------------------------------
+
+    def test_bare_if_gated_wait_fires(self, tmp_path):
+        """Regressing TierManager._run to its pre-PR-13 `if`-gated wait
+        (acting on a possibly-spurious wake) must fire."""
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/tiering.py",
+            sub=(
+                "                while not self._dirty and not self._stop:\n"
+                "                    if not self._cv.wait(timeout=self.interval_s):\n"
+                "                        break",
+                "                if not self._dirty and not self._stop:\n"
+                "                    self._cv.wait(timeout=self.interval_s)",
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        assert any(
+            f.rule == "ITS-R004" and "TierManager._cv" in f.message
+            for f in found
+        )
+
+    def test_wait_for_and_event_waits_are_exempt(self, tmp_path):
+        ctx = make_tree(tmp_path, {"infinistore_tpu/m.py": (
+            "import threading\n\n\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._cv = threading.Condition()\n"
+            "        self._ev = threading.Event()\n"
+            "        self._thread = None\n\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._run)\n\n"
+            "    def _run(self):\n"
+            "        with self._cv:\n"
+            "            self._cv.wait_for(lambda: True)\n"
+            "        self._ev.wait(1.0)\n"
+        )})
+        found = races.scan(ctx, docs=False)
+        assert not [f for f in found if f.rule == "ITS-R004"]
+
+    # -- R005: concurrency-model docs lockstep -------------------------------
+
+    def test_real_docs_table_is_in_lockstep(self):
+        ctx = core.Context(str(REPO))
+        idx = races.PackageIndex(ctx)
+        assert races.check_r005(ctx, idx) == []
+
+    def test_missing_docs_row_fires(self, tmp_path):
+        src = (REPO / "infinistore_tpu/tiering.py").read_text()
+        ctx = make_tree(tmp_path, {
+            "infinistore_tpu/tiering.py": src,
+            "docs/design.md": "# design\n\nno table here\n",
+        })
+        found = races.check_r005(ctx, races.PackageIndex(ctx))
+        assert any(
+            f.rule == "ITS-R005" and "TierManager._c" in f.key for f in found
+        )
+
+    def test_stale_docs_row_fires(self, tmp_path):
+        ctx = core.Context(str(REPO))
+        doc = (REPO / "docs/design.md").read_text() + (
+            "\n| `GhostClass._gone` | `_lock` | all accesses | "
+            "`infinistore_tpu/nope.py` |\n"
+        )
+        ctx2 = make_tree(tmp_path, {"docs/design.md": doc})
+        # Same package, doctored docs: copy the package reference files in.
+        import shutil
+        shutil.copytree(
+            REPO / "infinistore_tpu", tmp_path / "infinistore_tpu",
+            ignore=shutil.ignore_patterns("__pycache__", "_native", "*.so"),
+        )
+        found = races.check_r005(ctx2, races.PackageIndex(ctx2))
+        assert any(
+            f.rule == "ITS-R005" and "stale" in f.key and "GhostClass" in f.key
+            for f in found
+        )
+        del ctx
+
+    # -- framework plumbing ---------------------------------------------------
+
+    def test_requires_contract_is_honored(self, tmp_path):
+        """`# its: requires[lock]` marks a caller-holds contract: the
+        method's accesses count as guarded."""
+        ctx = make_tree(tmp_path, {"infinistore_tpu/m.py": (
+            "import threading\n\n\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        # its: guard[state: _lock]\n"
+            "        self.state = 0\n"
+            "        self._thread = None\n\n"
+            "    def start(self):\n"
+            "        self._thread = threading.Thread(target=self._run)\n\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._step()\n\n"
+            "    def _step(self):  # its: requires[_lock]\n"
+            "        self.state += 1\n\n"
+            "    def read(self):\n"
+            "        with self._lock:\n"
+            "            return self.state\n"
+        )})
+        found = races.scan(ctx, docs=False)
+        assert not [f for f in found if f.rule == "ITS-R001"]
+
+    def test_inline_allow_suppresses_races_findings(self, tmp_path):
+        ctx = mutated_pkg(
+            tmp_path, "infinistore_tpu/tiering.py",
+            sub=(
+                "                while not self._dirty and not self._stop:\n"
+                "                    if not self._cv.wait(timeout=self.interval_s):\n"
+                "                        break",
+                "                if not self._dirty and not self._stop:\n"
+                "                    self._cv.wait(timeout=self.interval_s)"
+                "  # its: allow[ITS-R004]",
+            ),
+        )
+        found = races.scan(ctx, docs=False)
+        hits = [f for f in found if f.rule == "ITS-R004"]
+        assert hits and ctx.suppressed(hits[0])

@@ -14,10 +14,14 @@ Four project-specific checkers over invariants unit tests can only sample
 - ``trace_stages`` (ITS-T*): every stage name a tracing producer stamps
   must exist in tracing.STAGES, the /trace schema and
   docs/observability.md — the span vocabulary never drifts one-sided.
+- ``races``       (ITS-R*): cross-thread shared-state guard discipline,
+  lock-order acyclicity, journal-outside-lock, predicate-looped cv waits,
+  concurrency-model docs lockstep; the dynamic confirmation side (lock
+  tracer + deterministic interleaving) lives in interleave.py.
 
 Importing the subpackage registers every checker with core.CHECKERS.
 """
 
 from . import core  # noqa: F401
-from . import counters, loop_block, policy, trace_stages, wire_drift  # noqa: F401
+from . import counters, loop_block, policy, races, trace_stages, wire_drift  # noqa: F401
 from .core import CHECKERS, Context, Finding, run  # noqa: F401

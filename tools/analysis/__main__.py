@@ -74,6 +74,16 @@ def main(argv=None) -> int:
             f"analysis: {len(names)} checker(s); {counts['new']} new, "
             f"{counts['baselined']} baselined, {counts['suppressed']} suppressed"
         )
+        # Per-rule-family timing + finding counts: the drift row the CI
+        # receipt tracks PR over PR (which checker is growing/slowing).
+        for name in names:
+            row = result.per_checker.get(name, {})
+            print(
+                f"  {name:14s} {row.get('ms', 0.0):8.1f} ms  "
+                f"{int(row.get('new', 0))} new / "
+                f"{int(row.get('baselined', 0))} baselined / "
+                f"{int(row.get('suppressed', 0))} suppressed"
+            )
     if args.json:
         payload = json.dumps(result.to_json(), indent=2, sort_keys=True)
         if args.json == "-":

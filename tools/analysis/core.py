@@ -1,10 +1,12 @@
 """Shared core of the in-repo static-analysis suite.
 
-The four project checkers (wire_drift, loop_block, counters, policy — see
-docs/static_analysis.md) are exhaustive passes over invariants the unit
-tests can only sample: protocol-layout agreement between C++ and Python,
-event-loop blocking reachability, observability-export completeness, and
-the degrade/QoS policy discipline. This module owns everything they share:
+The project checkers (wire_drift, loop_block, counters, policy,
+trace_stages, races — see docs/static_analysis.md) are exhaustive passes
+over invariants the unit tests can only sample: protocol-layout agreement
+between C++ and Python, event-loop blocking reachability,
+observability-export completeness, the degrade/QoS policy discipline, and
+the cross-thread guard/lock-order discipline. This module owns everything
+they share:
 
 - ``Finding``: one diagnostic with a STABLE identity key (rule + file +
   symbol, never a line number) so baselines and suppressions survive
@@ -23,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -189,12 +192,17 @@ def write_baseline(
 
 @dataclass
 class RunResult:
-    """Outcome of one suite run, split by disposition."""
+    """Outcome of one suite run, split by disposition.
+
+    ``per_checker`` carries one row per rule family — finding counts by
+    disposition plus wall-clock ``ms`` — so the CI receipt shows WHICH
+    checker is growing (and slowing) PR over PR, the same way the bench
+    receipt tracks per-leg drift."""
 
     new: List[Finding] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
-    per_checker: Dict[str, int] = field(default_factory=dict)
+    per_checker: Dict[str, Dict[str, float]] = field(default_factory=dict)
 
     @property
     def failed(self) -> bool:
@@ -231,15 +239,21 @@ def run(
     result = RunResult()
     for name in names:
         chk = CHECKERS[name]
+        t0 = time.perf_counter()
         findings = sorted(chk.fn(ctx), key=lambda f: (f.file, f.line, f.rule, f.key))
-        result.per_checker[name] = 0
+        row = result.per_checker[name] = {
+            "new": 0, "baselined": 0, "suppressed": 0, "ms": 0.0,
+        }
         for f in findings:
             if ctx.suppressed(f):
                 result.suppressed.append(f)
+                row["suppressed"] += 1
             elif f.key in baseline:
                 f.baselined = True
                 result.baselined.append(f)
+                row["baselined"] += 1
             else:
                 result.new.append(f)
-                result.per_checker[name] += 1
+                row["new"] += 1
+        row["ms"] = round((time.perf_counter() - t0) * 1e3, 1)
     return result

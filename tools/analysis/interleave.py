@@ -1,0 +1,391 @@
+"""Deterministic interleaving harness + lock tracer — the DYNAMIC side of
+the ITS-R concurrency discipline (static side: tools/analysis/races.py).
+
+Two instruments, no ``sys.settrace`` (tracing every opcode would perturb
+the very schedules under test and cost ~30x):
+
+- :class:`LockTracer` — a wrapped ``threading.Lock``/``RLock``/``Condition``
+  factory shim. Code constructed under :func:`trace_locks` records every
+  REAL acquisition order at test time: while a thread holds lock A and
+  acquires lock B, the tracer records the edge ``A -> B``. Tests union the
+  observed edges with the static lock-order graph
+  (``races.lock_order_edges``) and assert the combined graph stays acyclic
+  — so an acquisition order the static pass cannot see (callback
+  indirection, data-dependent paths) still lands in the cycle check.
+
+- :class:`Interleaver` — a bounded deterministic schedule explorer. A
+  *schedule* is the exact global order in which named checkpoints may be
+  passed (``["t1:load", "t2:load", "t2:store", "t1:store"]``); threads
+  block at :meth:`Interleaver.point` until the front of the schedule is
+  theirs. Shared state is instrumented (``instrument_mapping`` wraps a
+  counter dict so its loads/stores are checkpoints), so a PLAUSIBLE static
+  finding — "this ``d[k] += 1`` races" — becomes a REPRODUCIBLE failure:
+  force ``t1`` to pause between its load and store while ``t2`` runs a
+  full increment, and the lost update happens on every run, not one run in
+  ten thousand. When the code is correctly locked the forced interleaving
+  is IMPOSSIBLE: the second thread blocks on the guard before reaching its
+  checkpoint, the explorer's stall watchdog trips, and the run reports
+  ``serialized`` instead — which is exactly the regression assertion for a
+  fixed race (tests/test_interleave.py).
+
+Both are test-time instruments: nothing here imports the package, and
+production code never pays for them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Bound at import, BEFORE any trace_locks() patching: isinstance checks in
+# adopt() must see the real Condition class even while the factory is
+# swapped out.
+_REAL_CONDITION = threading.Condition
+
+
+# ---------------------------------------------------------------------------
+# Lock tracer.
+# ---------------------------------------------------------------------------
+
+class TracedLock:
+    """A real lock wrapped so every acquisition records ordering edges
+    against the locks the acquiring thread already holds."""
+
+    def __init__(self, tracer: "LockTracer", inner, name: str):
+        self._tracer = tracer
+        self._inner = inner
+        self.name = name
+
+    # threading.Condition probes these on its lock argument; delegate so a
+    # TracedLock(RLock) behaves exactly like the RLock it wraps.
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._tracer._note_acquire(self)
+        return got
+
+    def release(self):
+        self._tracer._note_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class LockTracer:
+    """Records (held -> acquired) edges and per-lock acquisition counts
+    from every :class:`TracedLock` built under :func:`trace_locks`."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._mu = threading.Lock()
+        self.locks: List[TracedLock] = []
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.acquisitions: Dict[str, int] = {}
+
+    def _held(self) -> List[TracedLock]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    def _note_acquire(self, lock: TracedLock):
+        held = self._held()
+        with self._mu:
+            self.acquisitions[lock.name] = self.acquisitions.get(lock.name, 0) + 1
+            for h in held:
+                if h.name != lock.name:
+                    key = (h.name, lock.name)
+                    self.edges[key] = self.edges.get(key, 0) + 1
+        held.append(lock)
+
+    def _note_release(self, lock: TracedLock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                break
+
+    # -- naming -------------------------------------------------------------
+
+    def adopt(self, obj, cls_name: Optional[str] = None):
+        """Name every traced lock found in ``obj.__dict__`` as
+        ``Class.attr`` — the same tokens the static graph uses, so
+        observed and inferred edges join on identity. Only direct
+        TracedLock attributes and REAL Condition objects (whose inner
+        lock is traced) are renamed: a sub-object that happens to carry a
+        ``_lock`` attribute (a DurableLog held by a cluster) keeps its
+        own name and must be adopted itself, or its edges could never
+        join the static graph's node for it."""
+        cls_name = cls_name or type(obj).__name__
+        for attr, val in vars(obj).items():
+            if isinstance(val, TracedLock):
+                val.name = f"{cls_name}.{attr}"
+            elif isinstance(val, _REAL_CONDITION):
+                inner = getattr(val, "_lock", None)
+                if isinstance(inner, TracedLock):
+                    inner.name = f"{cls_name}.{attr}"
+        return obj
+
+    def edge_set(self) -> Set[Tuple[str, str]]:
+        with self._mu:
+            return set(self.edges)
+
+
+@contextmanager
+def trace_locks():
+    """Swap ``threading.Lock``/``RLock``/``Condition`` for traced
+    factories while constructing the objects under test; restores the
+    real factories on exit (already-built traced locks keep tracing)."""
+    tracer = LockTracer()
+    real_lock, real_rlock, real_cond = (
+        threading.Lock, threading.RLock, threading.Condition,
+    )
+    counter = [0]
+
+    def make(inner_factory, kind):
+        def factory():
+            counter[0] += 1
+            lk = TracedLock(tracer, inner_factory(), f"{kind}#{counter[0]}")
+            tracer.locks.append(lk)
+            return lk
+        return factory
+
+    traced_lock = make(real_lock, "Lock")
+    traced_rlock = make(real_rlock, "RLock")
+
+    def traced_condition(lock=None):
+        return real_cond(lock if lock is not None else traced_rlock())
+
+    threading.Lock = traced_lock
+    threading.RLock = traced_rlock
+    threading.Condition = traced_condition
+    try:
+        yield tracer
+    finally:
+        threading.Lock = real_lock
+        threading.RLock = real_rlock
+        threading.Condition = real_cond
+
+
+def find_cycle(edges: Sequence[Tuple[str, str]]) -> Optional[List[str]]:
+    """First directed cycle in ``edges`` (as a node list), or None —
+    the acyclicity assertion for static ∪ observed lock-order graphs."""
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    parent: Dict[str, str] = {}
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        for nxt in graph[n]:
+            if color[nxt] == GREY:
+                cycle = [nxt, n]
+                cur = n
+                while cur != nxt:
+                    cur = parent[cur]
+                    cycle.append(cur)
+                return list(reversed(cycle))
+            if color[nxt] == WHITE:
+                parent[nxt] = n
+                got = dfs(nxt)
+                if got:
+                    return got
+        color[n] = BLACK
+        return None
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got:
+                return got
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Deterministic schedule explorer.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RunReport:
+    """Outcome of one forced schedule.
+
+    ``completed``  — every scheduled checkpoint was passed in order: the
+                     forced interleaving HAPPENED (for a race schedule,
+                     the racy outcome is now deterministic).
+    ``serialized`` — the schedule stalled because some thread never
+                     reached its next checkpoint (it was blocked on a
+                     lock): the code under test MUTUALLY EXCLUDES the
+                     sections — the regression verdict for a fixed race.
+    ``stalled_at`` — the checkpoint the schedule was waiting on when the
+                     watchdog tripped (None when completed).
+    """
+
+    completed: bool
+    stalled_at: Optional[str]
+    errors: List[BaseException] = field(default_factory=list)
+
+    @property
+    def serialized(self) -> bool:
+        return not self.completed
+
+
+class Interleaver:
+    """Run two (or more) callables on real threads under a forced global
+    checkpoint order. Instrumented shared state calls :meth:`point`
+    with a label like ``"t1:load"``; the call blocks until the front of
+    the schedule is that label. A thread that cannot reach its scheduled
+    checkpoint within ``stall_timeout_s`` (because a lock correctly
+    excludes it) trips the watchdog: the schedule aborts, every waiter is
+    released, and the report says ``serialized``."""
+
+    def __init__(self, schedule: Sequence[str], stall_timeout_s: float = 1.0):
+        self.schedule: List[str] = list(schedule)
+        self.stall_timeout_s = stall_timeout_s
+        self._cv = threading.Condition()
+        self._idx = 0
+        self._aborted = False
+
+    # -- checkpoints --------------------------------------------------------
+
+    def point(self, label: str):
+        """Block until the schedule's front equals ``label``. Labels not
+        present anywhere in the schedule pass through immediately (so one
+        instrumented dict can serve many schedules)."""
+        with self._cv:
+            if label not in self.schedule:
+                return
+            while not self._aborted:
+                if self._idx >= len(self.schedule):
+                    return  # schedule fully consumed: free-run to finish
+                if self.schedule[self._idx] == label:
+                    self._idx += 1
+                    self._cv.notify_all()
+                    return
+                # Not our turn — but if this label never appears again,
+                # fall through (a later loop iteration re-touches the key).
+                if label not in self.schedule[self._idx:]:
+                    return
+                self._cv.wait(timeout=0.05)
+
+    def thread_label(self) -> str:
+        return threading.current_thread().name
+
+    # -- instrumented state -------------------------------------------------
+
+    def instrument_mapping(self, data: dict, key,
+                           points: Tuple[str, str] = ("load", "store")) -> dict:
+        """A dict replacement whose ``[key]`` load and store are
+        checkpoints named ``<thread>:<load|store>`` — enough to force a
+        scheduler switch INSIDE ``d[key] += 1``."""
+        il = self
+        load_tag, store_tag = points
+
+        class _Instrumented(dict):
+            def __getitem__(self, k):
+                if k == key:
+                    il.point(f"{il.thread_label()}:{load_tag}")
+                return dict.__getitem__(self, k)
+
+            def __setitem__(self, k, v):
+                if k == key:
+                    il.point(f"{il.thread_label()}:{store_tag}")
+                dict.__setitem__(self, k, v)
+
+        return _Instrumented(data)
+
+    # -- driving ------------------------------------------------------------
+
+    def run(self, actors: Dict[str, "callable"]) -> RunReport:
+        """Run each actor callable on a thread named with its label;
+        watchdog-abort when the schedule stops advancing."""
+        errors: List[BaseException] = []
+
+        def wrap(fn):
+            def run():
+                try:
+                    fn()
+                except BaseException as e:  # surfaced in the report
+                    errors.append(e)
+            return run
+
+        threads = [
+            threading.Thread(target=wrap(fn), name=label, daemon=True)
+            for label, fn in actors.items()
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.stall_timeout_s
+        last_idx = -1
+        stalled_at: Optional[str] = None
+        while True:
+            with self._cv:
+                idx = self._idx
+                done = idx >= len(self.schedule)
+            if done:
+                break
+            if idx != last_idx:
+                last_idx = idx
+                deadline = time.monotonic() + self.stall_timeout_s
+            if time.monotonic() >= deadline:
+                with self._cv:
+                    stalled_at = (
+                        self.schedule[self._idx]
+                        if self._idx < len(self.schedule) else None
+                    )
+                    self._aborted = True
+                    self._cv.notify_all()
+                break
+            time.sleep(0.002)
+        for t in threads:
+            t.join(timeout=5.0)
+        alive = [t for t in threads if t.is_alive()]
+        if alive:
+            errors.append(RuntimeError(
+                f"actors still alive after abort: {[t.name for t in alive]}"
+            ))
+        return RunReport(
+            completed=stalled_at is None and not self._aborted,
+            stalled_at=stalled_at, errors=errors,
+        )
+
+
+def force_lost_update(bump_a, bump_b, counters: dict, key,
+                      stall_timeout_s: float = 1.0) -> Tuple[RunReport, int]:
+    """The canonical ITS-R001 confirmation: force thread ``t1`` to pause
+    between the load and store of ``counters[key] += 1`` while ``t2`` runs
+    its full increment, then let ``t1`` store its stale value.
+
+    ``bump_a``/``bump_b`` are callables that perform one increment of
+    ``counters[key]`` (the REAL production code path under test — e.g.
+    ``TierManager.note_cold_hit``). Returns ``(report, final_value)``:
+
+    - unguarded increments  -> ``report.completed`` and final == initial+1
+      (one update LOST, deterministically);
+    - guarded increments    -> ``report.serialized`` (the second thread
+      blocked on the guard; no interleaving possible) and final ==
+      initial+2.
+    """
+    il = Interleaver(
+        ["t1:load", "t2:load", "t2:store", "t1:store"],
+        stall_timeout_s=stall_timeout_s,
+    )
+    instrumented = il.instrument_mapping(counters, key)
+    report = il.run({
+        "t1": lambda: bump_a(instrumented),
+        "t2": lambda: bump_b(instrumented),
+    })
+    return report, instrumented[key]

@@ -1,0 +1,1290 @@
+"""ITS-R*: cross-thread shared-state race analysis (the static side of the
+concurrency discipline; the dynamic side — lock tracer + deterministic
+interleaving — lives in tools/analysis/interleave.py).
+
+PRs 6-12 grew seven daemon threads (resharder, fleet scraper, gossip agent,
+tier reconciler, slow-op watchdog, the QoS gate executor, the vllm IO loop)
+that mutate state also touched from the asyncio loop, and every race fixed
+so far (breaker `_breaker_lock` serialization, SloEngine fire/clear
+atomicity, admin-lock rollback) was found by a reviewer reading diffs. This
+pass makes the discipline mechanical, ThreadSanitizer-style:
+
+- **ITS-R001** shared-attribute guard discipline. A *shared-state registry*
+  is inferred from the AST: any class whose methods are reachable both from
+  a ``threading.Thread(target=...)`` / ``to_thread`` / ``run_in_executor``
+  worker and from an ``async def`` (the loop side) has its instance
+  attributes classified. An attribute written on one side and read or
+  written on the other must be covered by a declared guard —
+  ``# its: guard[attr: lock]`` in the class body — and every access must be
+  dominated by ``with self.<lock>`` (or a ``# its: requires[lock]``
+  caller-holds contract on the method). Guard modes:
+
+  * ``guard[attr: lock]`` — every access under the lock;
+  * ``guard[attr: lock!w]`` — writes under the lock, reads lock-free (the
+    published-snapshot pattern: ``Membership._view``);
+  * ``guard[attr: single_writer]`` — all writes confined to ONE side
+    (counter dicts snapshot-read by the manage plane).
+
+  Attributes assigned only in ``__init__`` (or ``# its: construction``
+  methods) and synchronization primitives themselves are exempt.
+
+- **ITS-R002** lock-order graph. Nested ``with``-acquisitions (direct, via
+  resolvable calls while a lock is held, and via ``# its: acquires[Lock]``
+  summaries for callback indirection like ``DurableLog.compact``) build a
+  directed acquired-after graph; any cycle — or re-acquiring a
+  non-reentrant ``Lock`` already held — is a potential deadlock.
+
+- **ITS-R003** journal-outside-lock discipline. ``EventJournal.emit`` /
+  ``telemetry.emit`` / the cluster's ``_journal_append``-family sinks must
+  never run while an engine lock (breaker, catalog, membership, SLO,
+  reconciler CV, ...) is held — structurally, not by convention.
+
+- **ITS-R004** condition-variable waits must loop on a predicate
+  (``wait()`` inside a ``while``; ``wait_for`` carries its own loop;
+  ``Event.wait`` is exempt — the event IS the predicate).
+
+- **ITS-R005** docs lockstep: the guard registry is the source of truth
+  for the "concurrency model" section of docs/design.md
+  (``concurrency_model_lines``); a guard added without a docs row — or a
+  stale docs row — fails the run, so the doc can never drift from the
+  annotations ITS-R001 enforces.
+
+Call resolution reuses loop_block's machinery (same-module names, ``self.``
+methods, ``module.func`` import aliases) plus one extension: a method call
+on an *unresolvable* receiver (``cluster.catalog_add_holder(...)``)
+resolves when exactly one class in the package defines that method name and
+the name is distinctive (not in ``COMMON_METHODS``) — that is what carries
+reachability across the cluster/tiering/membership object graph without
+type inference.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .core import Context, Finding, register
+from .loop_block import _is_threading_ctor  # the shared ctor fingerprint
+
+PACKAGE_REL = "infinistore_tpu"
+DESIGN_DOC_REL = "docs/design.md"
+
+# Synchronization-primitive ctor names (threading.X / queue.X). LOCKABLE
+# ones participate in `with` tracking; Event/queues are exempt state.
+LOCKABLE = {"Lock", "RLock", "Condition"}
+
+# Container mutations that count as a WRITE of the holding attribute
+# (`self._promote_queue.append(...)` mutates `_promote_queue`).
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard", "remove",
+    "pop", "popleft", "popitem", "clear", "update", "setdefault", "sort",
+}
+
+# Names too generic for unique-method resolution: an ("any", name) edge is
+# created only for names OUTSIDE this set, so `promote_queue.append` can
+# never resolve to DurableLog.append and fabricate a call edge.
+COMMON_METHODS = {
+    "append", "add", "get", "pop", "put", "update", "clear", "extend",
+    "remove", "discard", "items", "keys", "values", "copy", "sort", "index",
+    "count", "insert", "setdefault", "popleft", "appendleft", "join",
+    "start", "stop", "close", "run", "read", "write", "send", "recv",
+    "flush", "acquire", "release", "wait", "notify", "notify_all", "set",
+    "is_set", "record", "status", "health", "stats", "load", "save",
+    "drop", "lookup", "connect", "reconnect", "encode", "decode", "kick",
+    "tolist", "search", "match", "group", "split", "strip", "format",
+    "exists", "mkdir", "unlink", "resolve", "to_thread", "submit",
+}
+
+# Classes excluded from R001 attribute classification, with the audit
+# reason (the loop_block.AUDITED pattern). Their guard declarations still
+# feed the registry/docs and their locks still feed R002/R003.
+CLASS_EXEMPT = {
+    "InfinityConnection":
+        "native-reactor client: cross-thread discipline is the connection "
+        "_lock + the C++ side's -Wthread-safety/TSAN jurisdiction "
+        "(native/include/its/client.h GUARDED_BY annotations)",
+    "StripedConnection":
+        "fan-out over InfinityConnection stripes; same jurisdiction",
+    "KVConnector":
+        "engine-side wrapper over one connection; driven by one engine "
+        "step at a time (the DeviceGate contract, docs/engine_integration)",
+    "FaultyConnection":
+        "scripted chaos harness: each wrapped conn is driven by one test "
+        "thread by contract (faults.py module docstring)",
+    "InfiniStoreConnector":
+        "vllm v1 connector: scheduler-side state is single-threaded by the "
+        "vLLM scheduler contract; worker/IO-loop KV handoff is _kv_lock",
+    "CircuitBreaker":
+        "lock-free by design: every access is serialized by the owning "
+        "cluster's _breaker_lock (the PR-6 hardening; cluster.py _begin/"
+        "_done/_cold_begin/_cold_done are the only callers)",
+    "ContinuousBatchingHarness":
+        "cache mutation is serialized by the engine's exclusive/shared "
+        "DeviceGate (asyncio-level, one engine loop by contract); the "
+        "executor-side snapshot binds the cache list under the shared gate "
+        "before hopping",
+}
+
+_GUARD_RE = re.compile(r"its:\s*guard\[([^\]]+)\]")
+_REQUIRES_RE = re.compile(r"its:\s*requires\[([^\]]+)\]")
+_ACQUIRES_RE = re.compile(r"its:\s*acquires\[([^\]]+)\]")
+_CONSTRUCTION_RE = re.compile(r"its:\s*construction\b")
+_CROSS_RE = re.compile(r"its:\s*cross-thread\b")
+
+
+# ---------------------------------------------------------------------------
+# Scan model.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Access:
+    attr: str
+    kind: str  # "r" | "w"
+    line: int
+    held: FrozenSet[str]
+    meth: str = ""  # owning method name (filled by the registry pass)
+
+
+@dataclass
+class LockSite:
+    token: str
+    line: int
+    held_before: Tuple[str, ...]
+
+
+@dataclass
+class CallSite:
+    call: Tuple[str, ...]  # ("name", f) | ("self", m) | ("mod", mod, f) | ("any", m)
+    line: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class WaitSite:
+    token: str
+    line: int
+    looped: bool
+    wait_for: bool
+
+
+@dataclass
+class Meth:
+    name: str
+    qual: str
+    cls: Optional[str]
+    file: str
+    is_async: bool
+    lineno: int
+    accesses: List[Access] = field(default_factory=list)
+    lock_sites: List[LockSite] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    waits: List[WaitSite] = field(default_factory=list)
+    thread_targets: List[Tuple[str, ...]] = field(default_factory=list)
+    requires: FrozenSet[str] = frozenset()
+    acquires_decl: Tuple[Tuple[str, int], ...] = ()
+    construction: bool = False
+
+
+@dataclass
+class Cls:
+    name: str
+    file: str
+    lineno: int
+    end_lineno: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr -> ctor
+    guards: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    methods: Dict[str, Meth] = field(default_factory=dict)
+    marked_cross: bool = False
+
+
+class RaceModule:
+    def __init__(self, rel: str, tree: ast.Module, source: str):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.import_aliases: Dict[str, str] = {}
+        self.module_locks: Dict[str, str] = {}  # name -> ctor
+        self.classes: Dict[str, Cls] = {}
+        self.functions: Dict[str, Meth] = {}  # module-level + nested
+        self._collect(tree)
+
+    # -- collection ---------------------------------------------------------
+
+    def _collect(self, tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name.split(".")[-1]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name] = a.name
+            elif isinstance(node, ast.Assign) and _is_threading_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.module_locks[tgt.id] = node.value.func.attr
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_fn(node, qual=node.name, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+
+    def _collect_class(self, node: ast.ClassDef):
+        cls = Cls(
+            name=node.name, file=self.rel, lineno=node.lineno,
+            end_lineno=getattr(node, "end_lineno", node.lineno),
+        )
+        self.classes[node.name] = cls
+        # Lock discovery first (the body scanner consults it).
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and _is_threading_ctor(sub.value):
+                for tgt in sub.targets:
+                    if (
+                        isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                    ):
+                        cls.lock_attrs[tgt.attr] = sub.value.func.attr
+        span = self.lines[cls.lineno - 1: cls.end_lineno]
+        for raw in span:
+            if _CROSS_RE.search(raw):
+                cls.marked_cross = True
+            m = _GUARD_RE.search(raw)
+            if m:
+                self._parse_guard(cls, m.group(1))
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_fn(
+                    item, qual=f"{node.name}.{item.name}", cls=node.name
+                )
+
+    def _parse_guard(self, cls: Cls, payload: str):
+        # "attr: lock", "attr: lock!w", "a, b: lock", "attr: single_writer"
+        if ":" not in payload:
+            return
+        attrs, lock = payload.rsplit(":", 1)
+        lock = lock.strip()
+        mode = "full"
+        if lock.endswith("!w"):
+            lock, mode = lock[:-2].strip(), "writes"
+        elif lock == "single_writer":
+            mode = "single_writer"
+        for attr in attrs.split(","):
+            attr = attr.strip()
+            if attr:
+                cls.guards[attr] = (lock, mode)
+
+    def _def_markers(self, lineno: int,
+                     body_lineno: int) -> Tuple[FrozenSet[str], bool]:
+        """requires/construction markers on the line above the def, or
+        anywhere in the (possibly multi-line) signature."""
+        req: Set[str] = set()
+        construction = False
+        for ln in range(max(1, lineno - 1), min(body_lineno, len(self.lines) + 1)):
+            raw = self.lines[ln - 1]
+            m = _REQUIRES_RE.search(raw)
+            if m:
+                req |= {s.strip() for s in m.group(1).split(",") if s.strip()}
+            if _CONSTRUCTION_RE.search(raw):
+                construction = True
+        return frozenset(req), construction
+
+    def _collect_fn(self, node, qual: str, cls: Optional[str]):
+        requires, construction = self._def_markers(
+            node.lineno, node.body[0].lineno if node.body else node.lineno
+        )
+        info = Meth(
+            name=node.name, qual=qual, cls=cls, file=self.rel,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            lineno=node.lineno, requires=requires,
+            construction=construction or node.name == "__init__",
+        )
+        # acquires[] summaries anywhere in the body span.
+        acq: List[Tuple[str, int]] = []
+        end = getattr(node, "end_lineno", node.lineno)
+        for i in range(node.lineno, min(end, len(self.lines)) + 1):
+            m = _ACQUIRES_RE.search(self.lines[i - 1])
+            if m:
+                for tok in m.group(1).split(","):
+                    tok = tok.strip()
+                    if tok:
+                        acq.append((tok, i))
+        info.acquires_decl = tuple(acq)
+        store = self.functions if cls is None else self.classes[cls].methods
+        store[node.name if cls is not None else qual] = info
+        scanner = _FnScanner(self, info)
+        for stmt in node.body:
+            scanner.visit(stmt)
+        for inner in scanner.nested:
+            # Nested defs: separate functions (module table, qualified), so
+            # requires[] contracts attach to e.g. merge_remote_view.on_new.
+            self._collect_fn(inner, qual=f"{qual}.<locals>.{inner.name}", cls=None)
+            # Keep nested defs resolvable from the enclosing class' methods.
+            nested = self.functions[f"{qual}.<locals>.{inner.name}"]
+            nested.cls = cls
+
+
+class _FnScanner(ast.NodeVisitor):
+    """One function body: attribute accesses with the held-lock stack,
+    lock acquisition sites, call edges, cv waits, thread-target refs."""
+
+    _EXECUTORS = {"to_thread", "run_in_executor", "submit"}
+
+    def __init__(self, mod: RaceModule, info: Meth):
+        self.mod = mod
+        self.info = info
+        self.nested: List[ast.AST] = []
+        self.held: List[str] = [*sorted(self._resolve_requires())]
+        # Statement-context stack for the R004 gating rule: "while",
+        # "if_cont" (branch ends with continue/return/raise/break — the
+        # loop re-checks), "if_nocont" (falls through: the code below may
+        # ACT on a predicate a spurious wake faked).
+        self._ctx: List[str] = []
+
+    def _resolve_requires(self) -> Set[str]:
+        out = set()
+        for name in self.info.requires:
+            tok = self._token_for_name(name)
+            if tok:
+                out.add(tok)
+        return out
+
+    def _token_for_name(self, name: str) -> Optional[str]:
+        if "." in name:  # already qualified: Class.attr
+            return name
+        cls = self.info.cls
+        if cls and name in self.mod.classes.get(cls, Cls("", "", 0, 0)).lock_attrs:
+            return f"{cls}.{name}"
+        if name in self.mod.module_locks:
+            return f"{self.mod.rel}:{name}"
+        return name  # qualified elsewhere; resolved globally later
+
+    # -- tokens -------------------------------------------------------------
+
+    def _lock_token(self, expr) -> Optional[str]:
+        """Lock identity of a with/wait receiver, or None."""
+        if isinstance(expr, ast.Name) and expr.id in self.mod.module_locks:
+            if self.mod.module_locks[expr.id] in LOCKABLE | {"Event"}:
+                return f"{self.mod.rel}:{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self" and self.info.cls:
+                cls = self.mod.classes.get(self.info.cls)
+                if cls and expr.attr in cls.lock_attrs:
+                    return f"{self.info.cls}.{expr.attr}"
+            # Foreign receiver (cluster._cat_lock, self.cluster._cat_lock):
+            # resolves when exactly one scanned class owns that lock attr.
+            return f"?{expr.attr}"
+        return None
+
+    # -- visitors -----------------------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.nested.append(node)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_While(self, node):
+        self._ctx.append("while")
+        for stmt in node.body:
+            self.visit(stmt)
+        self._ctx.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.visit(node.test)
+
+    def visit_For(self, node):
+        self._ctx.append("while")  # a for loop re-checks too
+        for stmt in node.body:
+            self.visit(stmt)
+        self._ctx.pop()
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.visit(node.iter)
+        self._target(node.target, node.lineno)
+
+    def visit_If(self, node):
+        self.visit(node.test)
+        for branch in (node.body, node.orelse):
+            if not branch:
+                continue
+            exits = isinstance(
+                branch[-1], (ast.Continue, ast.Return, ast.Raise, ast.Break)
+            )
+            self._ctx.append("if_cont" if exits else "if_nocont")
+            for stmt in branch:
+                self.visit(stmt)
+            self._ctx.pop()
+
+    def _wait_looped(self) -> bool:
+        """True when the wait sits in a loop that re-checks its predicate:
+        walking outward, a `while`/`for` before any fall-through `if`
+        branch (`if not pred: cv.wait()` then acting below is the bug)."""
+        for ctx in reversed(self._ctx):
+            if ctx == "while":
+                return True
+            if ctx == "if_nocont":
+                return False
+        return False
+
+    def visit_With(self, node):
+        tokens = []
+        for item in node.items:
+            # In-scope tokens resolve here; "?attr" foreign-receiver
+            # tokens are recorded as-is and resolved globally later
+            # (unique lock-attr name across classes).
+            tok = self._lock_token(item.context_expr)
+            if tok is not None:
+                self.info.lock_sites.append(LockSite(
+                    token=tok, line=node.lineno,
+                    held_before=tuple(self.held),
+                ))
+                tokens.append(tok)
+        self.held.extend(tokens)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in tokens:
+            self.held.pop()
+        # items' context expressions may contain calls (rare) — skipped.
+
+    def visit_AsyncWith(self, node):
+        self.generic_visit(node)
+
+    def _access(self, attr: str, kind: str, line: int):
+        self.info.accesses.append(Access(
+            attr=attr, kind=kind, line=line, held=frozenset(self.held),
+        ))
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if (
+            isinstance(node.value, ast.Name) and node.value.id == "self"
+            and isinstance(node.ctx, ast.Load)
+        ):
+            self._access(node.attr, "r", node.lineno)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._target(tgt, node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._target(node.target, node.lineno, aug=True)
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                base = tgt.value
+                if (
+                    isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                ):
+                    self._access(base.attr, "w", node.lineno)
+
+    def _target(self, tgt, line: int, aug: bool = False):
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name) and tgt.value.id == "self"
+        ):
+            self._access(tgt.attr, "w", line)
+            if aug:
+                self._access(tgt.attr, "r", line)
+        elif isinstance(tgt, ast.Subscript):
+            base = tgt.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name) and base.value.id == "self"
+            ):
+                # self.x[k] = v mutates x (and aug also reads it).
+                self._access(base.attr, "w", line)
+                self._access(base.attr, "r", line)
+            else:
+                self.visit(tgt)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._target(el, line)
+        else:
+            self.visit(tgt)
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        held = frozenset(self.held)
+        if isinstance(fn, ast.Name):
+            if (
+                fn.id == "getattr" and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)
+            ):
+                # The duck-typed hook pattern (`getattr(cluster,
+                # "compact_journal", None)` then called via the local):
+                # conservatively treat the reference as a call edge so
+                # reachability crosses it.
+                self.info.calls.append(CallSite(
+                    ("any", node.args[1].value), node.lineno, held,
+                ))
+            else:
+                self.info.calls.append(
+                    CallSite(("name", fn.id), node.lineno, held)
+                )
+        elif isinstance(fn, ast.Attribute):
+            self._attr_call(node, fn, held)
+        self._thread_target(node)
+        self.generic_visit(node)
+
+    def _attr_call(self, node: ast.Call, fn: ast.Attribute, held):
+        recv = fn.value
+        # cv waits (R004): receiver must be a Condition / Event token.
+        tok = self._lock_token(recv) if isinstance(recv, (ast.Name, ast.Attribute)) else None
+        if fn.attr in ("wait", "wait_for") and tok is not None:
+            self.info.waits.append(WaitSite(
+                token=tok, line=node.lineno,
+                looped=self._wait_looped(), wait_for=fn.attr == "wait_for",
+            ))
+        if isinstance(recv, ast.Name):
+            if recv.id == "self":
+                self.info.calls.append(CallSite(("self", fn.attr), node.lineno, held))
+                # A mutating call on self.<attr> would be Attribute recv;
+                # self.meth() is a call edge only.
+                return
+            if recv.id in self.mod.import_aliases:
+                self.info.calls.append(CallSite(
+                    ("mod", self.mod.import_aliases[recv.id], fn.attr),
+                    node.lineno, held,
+                ))
+                return
+            self.info.calls.append(CallSite(("any", fn.attr), node.lineno, held))
+            return
+        if isinstance(recv, ast.Attribute):
+            if (
+                isinstance(recv.value, ast.Name) and recv.value.id == "self"
+                and fn.attr in MUTATORS
+            ):
+                # self.x.append(...): a WRITE of x.
+                self._access(recv.attr, "w", node.lineno)
+            self.info.calls.append(CallSite(("any", fn.attr), node.lineno, held))
+            return
+        self.info.calls.append(CallSite(("any", fn.attr), node.lineno, held))
+
+    def _thread_target(self, node: ast.Call):
+        """threading.Thread(target=X), to_thread(X), run_in_executor(_, X),
+        submit(X): X runs on a WORKER thread."""
+        fn = node.func
+        ref = None
+        if (
+            isinstance(fn, ast.Attribute) and fn.attr == "Thread"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "threading"
+        ):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = kw.value
+        elif isinstance(fn, ast.Attribute) and fn.attr in self._EXECUTORS:
+            args = node.args
+            if fn.attr == "to_thread" and args:
+                ref = args[0]
+            elif fn.attr == "run_in_executor" and len(args) >= 2:
+                ref = args[1]
+            elif fn.attr == "submit" and args:
+                ref = args[0]
+        if ref is None:
+            return
+        if (
+            isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Name) and ref.value.id == "self"
+        ):
+            self.info.thread_targets.append(("self", ref.attr))
+        elif isinstance(ref, ast.Name):
+            self.info.thread_targets.append(("name", ref.id))
+
+
+# ---------------------------------------------------------------------------
+# Package index + call resolution (loop_block's scheme + ("any", m)).
+# ---------------------------------------------------------------------------
+
+class PackageIndex:
+    def __init__(self, ctx: Context, package_rel: str = PACKAGE_REL):
+        self.modules: Dict[str, RaceModule] = {}
+        for rel in ctx.walk_py(package_rel):
+            try:
+                src = ctx.read(rel)
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue
+            self.modules[rel] = RaceModule(rel, tree, src)
+        # Shallowest path wins on basename collisions (loop_block's rule).
+        self.by_base: Dict[str, RaceModule] = {}
+        for rel in sorted(self.modules, key=lambda r: (r.count("/"), r)):
+            self.by_base.setdefault(rel.rsplit("/", 1)[-1][:-3], self.modules[rel])
+        # Unique-method map: name -> (module, class, Meth) when exactly one
+        # class in the package defines it.
+        owner: Dict[str, List[Tuple[RaceModule, Cls, Meth]]] = {}
+        self.lock_attr_owner: Dict[str, List[str]] = {}
+        for m in self.modules.values():
+            for cls in m.classes.values():
+                for name, meth in cls.methods.items():
+                    owner.setdefault(name, []).append((m, cls, meth))
+                for attr in cls.lock_attrs:
+                    self.lock_attr_owner.setdefault(attr, []).append(cls.name)
+        self.unique_method = {
+            n: v[0] for n, v in owner.items()
+            if len(v) == 1 and n not in COMMON_METHODS
+        }
+
+    def resolve_lock_token(self, token: str) -> Optional[str]:
+        """Globally resolve a '?attr' foreign-receiver lock token."""
+        if not token.startswith("?"):
+            return token
+        attr = token[1:]
+        owners = self.lock_attr_owner.get(attr, [])
+        if len(owners) == 1:
+            return f"{owners[0]}.{attr}"
+        return None
+
+    def meths(self):
+        for m in self.modules.values():
+            for meth in m.functions.values():
+                yield m, None, meth
+            for cls in m.classes.values():
+                for meth in cls.methods.values():
+                    yield m, cls, meth
+
+    def resolve(self, mod: RaceModule, info: Meth,
+                call: Tuple[str, ...]) -> Optional[Tuple[RaceModule, Meth]]:
+        if call[0] == "name":
+            nested = mod.functions.get(f"{info.qual}.<locals>.{call[1]}")
+            if nested is not None:
+                return mod, nested
+            fn = mod.functions.get(call[1])
+            if fn is not None:
+                return mod, fn
+            return None
+        if call[0] == "self" and info.cls:
+            cls = mod.classes.get(info.cls)
+            if cls and call[1] in cls.methods:
+                return mod, cls.methods[call[1]]
+            # Fall through: a self-call on a class the module splits across
+            # mixins resolves like ("any", m).
+            call = ("any", call[1])
+        if call[0] == "mod":
+            target = self.by_base.get(call[1])
+            if target:
+                fn = target.functions.get(call[2])
+                if fn is not None:
+                    return target, fn
+            return None
+        if call[0] == "any":
+            hit = self.unique_method.get(call[1])
+            if hit is not None:
+                return hit[0], hit[2]
+        return None
+
+
+def _closure(idx: PackageIndex, roots: List[Tuple[RaceModule, Meth]]) -> Set[int]:
+    seen: Set[int] = set()
+    stack = list(roots)
+    while stack:
+        mod, meth = stack.pop()
+        if id(meth) in seen:
+            continue
+        seen.add(id(meth))
+        for cs in meth.calls:
+            got = idx.resolve(mod, meth, cs.call)
+            if got is not None and id(got[1]) not in seen:
+                stack.append(got)
+    return seen
+
+
+def thread_roots(idx: PackageIndex) -> List[Tuple[RaceModule, Meth]]:
+    roots: List[Tuple[RaceModule, Meth]] = []
+    for mod, cls, meth in idx.meths():
+        for ref in meth.thread_targets:
+            if ref[0] == "self" and meth.cls:
+                c = mod.classes.get(meth.cls)
+                if c and ref[1] in c.methods:
+                    roots.append((mod, c.methods[ref[1]]))
+            elif ref[0] == "name":
+                nested = mod.functions.get(f"{meth.qual}.<locals>.{ref[1]}")
+                if nested is not None:
+                    roots.append((mod, nested))
+                elif ref[1] in mod.functions:
+                    roots.append((mod, mod.functions[ref[1]]))
+    return roots
+
+
+def async_roots(idx: PackageIndex) -> List[Tuple[RaceModule, Meth]]:
+    return [(m, meth) for m, _c, meth in idx.meths() if meth.is_async]
+
+
+# ---------------------------------------------------------------------------
+# Shared-state registry (R001 + the docs generator's source of truth).
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SharedClass:
+    file: str
+    cls: Cls
+    thread_methods: Set[str]
+    other_methods: Set[str]
+    own_thread_root: bool
+
+
+def build_registry(ctx: Context, package_rel: str = PACKAGE_REL,
+                   idx: Optional[PackageIndex] = None) -> List[SharedClass]:
+    """The shared-state registry: every class with methods on both the
+    worker-thread side and the loop/caller side, with the side split.
+    Sorted for deterministic findings and docs output."""
+    idx = idx or PackageIndex(ctx, package_rel)
+    t_closure = _closure(idx, thread_roots(idx))
+    a_closure = _closure(idx, async_roots(idx))
+    out: List[SharedClass] = []
+    for rel in sorted(idx.modules):
+        mod = idx.modules[rel]
+        for cname in sorted(mod.classes):
+            cls = mod.classes[cname]
+            t_m = {n for n, m in cls.methods.items() if id(m) in t_closure}
+            a_m = {n for n, m in cls.methods.items() if id(m) in a_closure}
+            own_root = any(
+                ref[0] == "self" and ref[1] in cls.methods
+                for m in cls.methods.values() for ref in m.thread_targets
+            )
+            # A method reachable from BOTH closures (view(), status(), ...)
+            # is exactly the shared surface: it counts on both sides.
+            other = set(a_m)
+            if own_root or cls.marked_cross:
+                other |= {
+                    n for n, m in cls.methods.items()
+                    if n not in t_m and not m.construction
+                }
+            if not t_m or not other:
+                continue
+            out.append(SharedClass(
+                file=rel, cls=cls, thread_methods=t_m,
+                other_methods=other, own_thread_root=own_root,
+            ))
+    return out
+
+
+def _attr_table(sc: SharedClass) -> Dict[str, Dict[str, List[Access]]]:
+    """attr -> side ("T"/"O"/"X") -> accesses (construction methods and
+    methods on neither side are the X bucket — guarded like any other
+    access once the attr is cross-side, but they do not make it so)."""
+    table: Dict[str, Dict[str, List[Access]]] = {}
+    for name, meth in sc.cls.methods.items():
+        if meth.construction:
+            continue
+        sides = set()
+        if name in sc.thread_methods:
+            sides.add("T")
+        if name in sc.other_methods:
+            sides.add("O")
+        if not sides:
+            sides.add("X")
+        for acc in meth.accesses:
+            if acc.attr in sc.cls.lock_attrs:
+                continue
+            if acc.attr in sc.cls.methods:
+                continue  # self.meth references, properties by name
+            acc.meth = name
+            for side in sides:
+                table.setdefault(acc.attr, {}).setdefault(side, []).append(acc)
+    return table
+
+
+def _guard_token(cls: Cls, lock: str) -> Optional[str]:
+    if lock in cls.lock_attrs:
+        return f"{cls.name}.{lock}"
+    return None
+
+
+def _enforce_guard(findings: List[Finding], idx: PackageIndex, file: str,
+                   cls: Cls, attr: str, sides: Dict[str, List[Access]]):
+    """Hold a DECLARED guard to its contract (full / writes-only /
+    single-writer) over every non-construction access."""
+    lock, mode = cls.guards[attr]
+    key = f"ITS-R001:{file}:{cls.name}.{attr}"
+    writes_t = [a for a in sides.get("T", []) if a.kind == "w"]
+    writes_o = [a for a in sides.get("O", []) if a.kind == "w"]
+    if mode == "single_writer":
+        if writes_t and writes_o:
+            findings.append(Finding(
+                rule="ITS-R001", file=file, line=writes_o[0].line,
+                message=(
+                    f"{cls.name}.{attr} is declared single_writer but is "
+                    "written on BOTH the worker and loop sides (e.g. lines "
+                    f"{writes_t[0].line} and {writes_o[0].line})"
+                ),
+                key=key + ":single-writer",
+            ))
+        return
+    token = _guard_token(cls, lock)
+    if token is None:
+        findings.append(Finding(
+            rule="ITS-R001", file=file, line=cls.lineno,
+            message=(
+                f"{cls.name}.{attr} declares guard {lock!r} but the class "
+                "constructs no such lock attribute"
+            ),
+            key=key + ":unknown-guard",
+        ))
+        return
+    checked_raw = (
+        [a for accs in sides.values() for a in accs]
+        if mode == "full" else
+        [a for accs in sides.values() for a in accs if a.kind == "w"]
+    )
+    checked = list({id(a): a for a in checked_raw}.values())
+    for acc in sorted(checked, key=lambda a: a.line):
+        held = {idx.resolve_lock_token(t) or t for t in acc.held}
+        if token in held:
+            continue
+        findings.append(Finding(
+            rule="ITS-R001", file=file, line=acc.line,
+            message=(
+                f"{cls.name}.{attr} "
+                f"{'write' if acc.kind == 'w' else 'read'} outside its "
+                f"declared guard self.{lock} "
+                f"(`guard[{attr}: {lock}{'!w' if mode == 'writes' else ''}]`)"
+                " — take the lock or annotate the caller-holds contract "
+                "(`# its: requires[...]`)"
+            ),
+            key=f"{key}:{acc.meth}:{acc.kind}",
+        ))
+
+
+def check_r001(ctx: Context, registry: Sequence[SharedClass],
+               idx: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    handled: Set[Tuple[str, str, str]] = set()  # (file, class, attr)
+    for sc in registry:
+        cls = sc.cls
+        if cls.name in CLASS_EXEMPT:
+            continue
+        table = _attr_table(sc)
+        for attr in sorted(table):
+            sides = table[attr]
+            writes_t = [a for a in sides.get("T", []) if a.kind == "w"]
+            writes_o = [a for a in sides.get("O", []) if a.kind == "w"]
+            touched_t = sides.get("T", [])
+            touched_o = sides.get("O", [])
+            cross = (writes_t and touched_o) or (writes_o and touched_t)
+            if not cross:
+                continue
+            if attr not in cls.guards:
+                first = min(
+                    (a for accs in sides.values() for a in accs),
+                    key=lambda a: a.line,
+                )
+                findings.append(Finding(
+                    rule="ITS-R001", file=sc.file, line=first.line,
+                    message=(
+                        f"{cls.name}.{attr} is written on "
+                        f"{'the worker-thread side' if writes_t else 'the loop side'}"
+                        f" and accessed on the other with no declared guard — "
+                        f"add `# its: guard[{attr}: <lock>]` and take the lock, "
+                        "or prove single-ownership (docs/static_analysis.md)"
+                    ),
+                    key=f"ITS-R001:{sc.file}:{cls.name}.{attr}",
+                ))
+            else:
+                _enforce_guard(findings, idx, sc.file, cls, attr, sides)
+            handled.add((sc.file, cls.name, attr))
+    # Declared guards are contracts EVERYWHERE, not only on classes the
+    # reachability inference classifies: a guard on FlightRecorder still
+    # fails the run when an access bypasses the lock.
+    shared_by_cls = {(sc.file, sc.cls.name): sc for sc in registry}
+    for rel in sorted(idx.modules):
+        mod = idx.modules[rel]
+        for cname in sorted(mod.classes):
+            cls = mod.classes[cname]
+            if cls.name in CLASS_EXEMPT or not cls.guards:
+                continue
+            sc = shared_by_cls.get((rel, cname)) or SharedClass(
+                file=rel, cls=cls, thread_methods=set(),
+                other_methods=set(), own_thread_root=False,
+            )
+            table = _attr_table(sc)
+            for attr in sorted(cls.guards):
+                if (rel, cname, attr) in handled:
+                    continue
+                _enforce_guard(findings, idx, rel, cls, attr,
+                               table.get(attr, {}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R002: lock-order graph.
+# ---------------------------------------------------------------------------
+
+def lock_order_edges(idx: PackageIndex) -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """Directed acquired-after edges {(held, acquired): (file, line)} from
+    lexical nesting, calls under a held lock (via a may-acquire fixpoint),
+    and `# its: acquires[...]` summaries."""
+    may: Dict[int, Set[str]] = {}
+
+    def resolve_tok(t: str) -> Optional[str]:
+        return idx.resolve_lock_token(t)
+
+    # Fixpoint of may-acquire over the call graph.
+    meth_list = [(m, meth) for m, _c, meth in idx.meths()]
+    for _m, meth in meth_list:
+        base: Set[str] = set()
+        for ls in meth.lock_sites:
+            tok = resolve_tok(ls.token)
+            if tok:
+                base.add(tok)
+        for tok, _line in meth.acquires_decl:
+            base.add(tok)
+        may[id(meth)] = base
+    changed = True
+    while changed:
+        changed = False
+        for mod, meth in meth_list:
+            cur = may[id(meth)]
+            for cs in meth.calls:
+                got = idx.resolve(mod, meth, cs.call)
+                if got is None:
+                    continue
+                extra = may[id(got[1])] - cur
+                if extra:
+                    cur |= extra
+                    changed = True
+
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add(src: str, dst: str, file: str, line: int):
+        if src != dst:
+            edges.setdefault((src, dst), (file, line))
+
+    for mod, meth in meth_list:
+        for ls in meth.lock_sites:
+            dst = resolve_tok(ls.token)
+            if not dst:
+                continue
+            for held in ls.held_before:
+                src = resolve_tok(held)
+                if src:
+                    add(src, dst, meth.file, ls.line)
+        for tok, line in meth.acquires_decl:
+            for ls in meth.lock_sites:
+                src = resolve_tok(ls.token)
+                if src:
+                    add(src, tok, meth.file, line)
+        for cs in meth.calls:
+            if not cs.held:
+                continue
+            got = idx.resolve(mod, meth, cs.call)
+            if got is None:
+                continue
+            for held in cs.held:
+                src = resolve_tok(held)
+                if not src:
+                    continue
+                for dst in may[id(got[1])]:
+                    add(src, dst, meth.file, cs.line)
+    return edges
+
+
+def find_cycles(edges) -> List[List[str]]:
+    """Elementary cycles via DFS (graphs here are tiny)."""
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], visited: Set[str]):
+        for nxt in graph.get(node, ()):
+            if nxt == start:
+                canon = tuple(sorted(path))
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(path + [start])
+            elif nxt not in visited and nxt > start:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return cycles
+
+
+def check_r002(ctx: Context, idx: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    edges = lock_order_edges(idx)
+    for cycle in find_cycles(edges):
+        chain = " -> ".join(cycle)
+        first_edge = edges.get((cycle[0], cycle[1]), ("", 0))
+        findings.append(Finding(
+            rule="ITS-R002", file=first_edge[0] or PACKAGE_REL, line=first_edge[1],
+            message=(
+                f"lock-order cycle {chain}: two threads taking these locks "
+                "in opposite orders can deadlock — impose one global order "
+                "or split the critical sections"
+            ),
+            key=f"ITS-R002:cycle:{':'.join(sorted(set(cycle)))}",
+        ))
+    # Re-acquiring a non-reentrant Lock already held (self-deadlock).
+    lock_kinds: Dict[str, str] = {}
+    for m in idx.modules.values():
+        for name, ctor in m.module_locks.items():
+            lock_kinds[f"{m.rel}:{name}"] = ctor
+        for cls in m.classes.values():
+            for attr, ctor in cls.lock_attrs.items():
+                lock_kinds[f"{cls.name}.{attr}"] = ctor
+    for _mod, _c, meth in idx.meths():
+        for ls in meth.lock_sites:
+            tok = idx.resolve_lock_token(ls.token)
+            if not tok:
+                continue
+            helds = {idx.resolve_lock_token(t) for t in ls.held_before}
+            if tok in helds and lock_kinds.get(tok) == "Lock":
+                findings.append(Finding(
+                    rule="ITS-R002", file=meth.file, line=ls.line,
+                    message=(
+                        f"{tok} re-acquired while already held "
+                        f"(threading.Lock is not reentrant: self-deadlock)"
+                    ),
+                    key=f"ITS-R002:{meth.file}:{meth.qual}:reacquire:{tok}",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R003: journal/emit outside engine locks.
+# ---------------------------------------------------------------------------
+
+# Journal sinks: (class, method) pairs plus module functions. The journal's
+# and durable log's OWN locks are exempt holders (they serialize the sink
+# itself); everything else counts as an engine lock.
+SINK_METHODS = {
+    ("EventJournal", "emit"),
+    ("DurableLog", "append"),
+    ("ClusterKVConnector", "_journal_append"),
+    ("ClusterKVConnector", "_journal_root"),
+    ("ClusterKVConnector", "journal_reshard_event"),
+}
+SINK_MODULE_FNS = {("telemetry", "emit")}
+JOURNAL_OWN_LOCKS = {"EventJournal._lock", "DurableLog._lock"}
+
+# Coarse control-plane serialization locks where journaling INSIDE is
+# deliberate, not a discipline violation: membership transitions must land
+# in the journal in admission order (the admin lock IS that order), and the
+# fleet scraper's pass lock serializes whole scrape passes (rare alert-edge
+# emits inside are the pass's output). Hot state locks (breaker, catalog,
+# membership._lock, SLO engine, reconciler CVs) stay non-exempt.
+CONTROL_PLANE_LOCKS = {
+    "ClusterKVConnector._admin_lock",
+    "FleetScraper._pass_lock",
+    # The gossip round lock serializes whole anti-entropy rounds; the
+    # merge (which journals its epoch adoption) is the round's body.
+    "GossipAgent._round_lock",
+}
+
+
+def check_r003(ctx: Context, idx: PackageIndex) -> List[Finding]:
+    sink_ids: Set[int] = set()
+    for mod in idx.modules.values():
+        base = mod.rel.rsplit("/", 1)[-1][:-3]
+        for cls in mod.classes.values():
+            for name, meth in cls.methods.items():
+                if (cls.name, name) in SINK_METHODS:
+                    sink_ids.add(id(meth))
+        for name, fn in mod.functions.items():
+            if (base, name) in SINK_MODULE_FNS:
+                sink_ids.add(id(fn))
+    # may-emit fixpoint.
+    meth_list = [(m, meth) for m, _c, meth in idx.meths()]
+    emits: Dict[int, bool] = {id(meth): id(meth) in sink_ids for _m, meth in meth_list}
+    changed = True
+    while changed:
+        changed = False
+        for mod, meth in meth_list:
+            if emits[id(meth)]:
+                continue
+            for cs in meth.calls:
+                got = idx.resolve(mod, meth, cs.call)
+                if got is not None and emits.get(id(got[1])):
+                    emits[id(meth)] = True
+                    changed = True
+                    break
+    findings: List[Finding] = []
+    for mod, meth in meth_list:
+        if id(meth) in sink_ids:
+            continue  # the sink's own body may hold its own lock
+        for cs in meth.calls:
+            if not cs.held:
+                continue
+            got = idx.resolve(mod, meth, cs.call)
+            if got is None or not emits.get(id(got[1])):
+                continue
+            engine = sorted(
+                t for t in (
+                    idx.resolve_lock_token(h) for h in cs.held
+                ) if t and t not in JOURNAL_OWN_LOCKS
+                and t not in CONTROL_PLANE_LOCKS
+            )
+            if not engine:
+                continue
+            callee = got[1].qual
+            findings.append(Finding(
+                rule="ITS-R003", file=meth.file, line=cs.line,
+                message=(
+                    f"journal/emit sink reached via {callee}() while holding "
+                    f"{', '.join(engine)} — emit after releasing the lock "
+                    "(the established emit/journal-outside-lock discipline; "
+                    "docs/static_analysis.md ITS-R003)"
+                ),
+                key=f"ITS-R003:{meth.file}:{meth.qual}:{callee.rsplit('.', 1)[-1]}",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R004: condition waits loop on a predicate.
+# ---------------------------------------------------------------------------
+
+def check_r004(ctx: Context, idx: PackageIndex) -> List[Finding]:
+    lock_kinds: Dict[str, str] = {}
+    for m in idx.modules.values():
+        for name, ctor in m.module_locks.items():
+            lock_kinds[f"{m.rel}:{name}"] = ctor
+        for cls in m.classes.values():
+            for attr, ctor in cls.lock_attrs.items():
+                lock_kinds[f"{cls.name}.{attr}"] = ctor
+    findings: List[Finding] = []
+    for _mod, _c, meth in idx.meths():
+        for ws in meth.waits:
+            tok = idx.resolve_lock_token(ws.token)
+            if tok is None or lock_kinds.get(tok) != "Condition":
+                continue  # Event.wait etc: the event IS the predicate
+            if ws.wait_for or ws.looped:
+                continue
+            findings.append(Finding(
+                rule="ITS-R004", file=meth.file, line=ws.line,
+                message=(
+                    f"bare {tok}.wait() outside a while loop: condition "
+                    "waits can wake spuriously (and on broadcast) — loop on "
+                    "the predicate (`while not pred: cv.wait(...)`) or use "
+                    "wait_for"
+                ),
+                key=f"ITS-R004:{meth.file}:{meth.qual}:{tok}",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R005: docs/design.md concurrency-model lockstep.
+# ---------------------------------------------------------------------------
+
+def concurrency_model_lines(ctx: Context,
+                            package_rel: str = PACKAGE_REL,
+                            idx: Optional[PackageIndex] = None) -> List[str]:
+    """The generated concurrency-model table for docs/design.md: one row
+    per declared guard, `| Class.attr | lock | mode | file |`, sorted.
+    ITS-R005 fails when docs/design.md's table and this list disagree —
+    so the doc paragraph naming which locks guard what can never drift
+    from the annotations ITS-R001 enforces."""
+    idx = idx or PackageIndex(ctx, package_rel)
+    rows: List[str] = []
+    for rel in sorted(idx.modules):
+        mod = idx.modules[rel]
+        for cname in sorted(mod.classes):
+            cls = mod.classes[cname]
+            for attr in sorted(cls.guards):
+                lock, mode = cls.guards[attr]
+                mode_h = {
+                    "full": "all accesses", "writes": "writes (lock-free reads)",
+                    "single_writer": "single writer",
+                }[mode]
+                lk = f"`{lock}`" if mode != "single_writer" else "—"
+                rows.append(
+                    f"| `{cname}.{attr}` | {lk} | {mode_h} | `{rel}` |"
+                )
+    return rows
+
+
+def check_r005(ctx: Context, idx: PackageIndex,
+               package_rel: str = PACKAGE_REL) -> List[Finding]:
+    if not ctx.exists(DESIGN_DOC_REL):
+        return [Finding(
+            rule="ITS-R005", file=DESIGN_DOC_REL, line=0,
+            message="docs/design.md missing: the concurrency-model section "
+                    "is generated from the guard registry",
+            key="ITS-R005:docs-missing",
+        )]
+    doc = ctx.read(DESIGN_DOC_REL)
+    findings: List[Finding] = []
+    expected = concurrency_model_lines(ctx, package_rel, idx=idx)
+    doc_rows = {
+        ln.strip() for ln in doc.splitlines()
+        if ln.strip().startswith("| `") and ln.strip().endswith("` |")
+    }
+    for row in expected:
+        if row not in doc_rows:
+            attr = row.split("|")[1].strip()
+            findings.append(Finding(
+                rule="ITS-R005", file=DESIGN_DOC_REL, line=0,
+                message=(
+                    f"guard registry row missing from the concurrency-model "
+                    f"table: {row} (regenerate with "
+                    "`python -m tools.analysis.races`)"
+                ),
+                key=f"ITS-R005:missing:{attr}",
+            ))
+    expected_set = set(expected)
+    for row in sorted(doc_rows):
+        if row.startswith("| `") and "|" in row[2:] and row not in expected_set:
+            # Only rows shaped like registry rows (4 columns ending in .py)
+            if row.count("|") == 5 and ".py` |" in row:
+                attr = row.split("|")[1].strip()
+                findings.append(Finding(
+                    rule="ITS-R005", file=DESIGN_DOC_REL, line=0,
+                    message=(
+                        f"stale concurrency-model row (no matching guard "
+                        f"annotation): {row}"
+                    ),
+                    key=f"ITS-R005:stale:{attr}",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Entry point.
+# ---------------------------------------------------------------------------
+
+def scan(ctx: Context, package_rel: str = PACKAGE_REL,
+         docs: bool = True) -> List[Finding]:
+    idx = PackageIndex(ctx, package_rel)
+    registry = build_registry(ctx, package_rel, idx=idx)
+    findings = []
+    findings += check_r001(ctx, registry, idx)
+    findings += check_r002(ctx, idx)
+    findings += check_r003(ctx, idx)
+    findings += check_r004(ctx, idx)
+    if docs:
+        findings += check_r005(ctx, idx, package_rel)
+    return findings
+
+
+@register("races",
+          "cross-thread shared-state guard/lock-order/journal/cv discipline (ITS-R*)",
+          rule_prefix="ITS-R")
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)
+
+
+if __name__ == "__main__":  # pragma: no cover - docs helper
+    # Print the generated concurrency-model table for docs/design.md.
+    print("| guarded state | lock | discipline | module |")
+    print("| --- | --- | --- | --- |")
+    for line in concurrency_model_lines(Context()):
+        print(line)
