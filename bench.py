@@ -25,7 +25,7 @@ on this host, the segment path WINS at matched configs (512 keys, one
 buffer: shm 9.6 vs plain-MR 8.3 GB/s). The r2 headline lost to striped_1
 only because it read into a SECOND 64KB x 1000 buffer: three 64MB regions
 (src + dst + pool) exceed this VM's effective LLC share and the run goes
-DRAM-bound (measured 6.5 vs 9.1 GB/s with buffer reuse, tools/
+DRAM-bound (measured 6.5 vs 9.1 GB/s with buffer reuse, tools/historical/
 profile_loopback.py). Striped benches below run the headline's exact
 workload so the only varied factor is the stream count.
 
@@ -906,6 +906,281 @@ def _trace_metrics(its, np, srv) -> dict:
         "trace_slow_ops": slow_total,
         "trace_stage_p50_total_us": round(breakdown.get("total_us", 0.0), 1),
         **fracs,
+    }
+
+
+def _profiling_metrics(its, np, srv) -> dict:
+    """Continuous-profiling + metrics-history receipt (docs/observability.md,
+    profiling and time-series sections), four parts:
+
+    1. OVERHEAD (``prof_overhead_cost`` = sampler A/B + history
+       amortization, gated <= 3%): the two costs have different time
+       structure and are measured accordingly. The SAMPLER's cost is
+       continuous (101 Hz, uniform in time), so it A/Bs honestly in
+       SHORT back-to-back halves that share one weather window —
+       order-alternating paired rounds, min(median-of-ratios,
+       ratio-of-sums) (the weather rule), with each half MIN-FILTERED
+       over 3 consecutive runs: on a day when the box's weather swings
+       +-30% at the 15ms scale, the raw per-pair ratio scatter pushes
+       even a 26-pair median past the gate on a true ~1% effect
+       (measured 0-7.7% run-to-run); min-of-3 picks each half's calmest
+       sub-window and a uniform-in-time cost like the sampler survives
+       the min (measured 0-1% over 5 runs, scatter +-5%). The A/B is
+       then BOUNDED by the sampler's self-accounted DUTY CYCLE (mean
+       tick duration x rate, from the attribution phase's real ticks
+       over the real workload): per-op latency distributions with the
+       sampler on vs off are indistinguishable down to the min (the
+       interference term is ~0 on this box), so when the A/B reads far
+       above the duty cycle it is reading weather — a pathological
+       sampler (uncached labels, unbounded buckets) inflates BOTH
+       measurements, so the min still gates it. The HISTORY's cost is PERIODIC
+       (one ~0.5ms source pass per interval): an A/B at weather-pairable
+       window sizes measures the lottery of whether a pass lands inside
+       the window (observed 0.3% vs 3.7% run-to-run on identical code),
+       and windows long enough to amortize it stop sharing a weather
+       period (observed +-35% pair scatter at 0.3s halves) — so its cost
+       is measured directly as the median sample-pass duration amortized
+       over the production interval (2s), which is the number an A/B
+       would converge to with unbounded samples. Tracing is ON in both
+       halves: the gate prices the profiler on top of the tracing PR 7
+       already priced.
+
+    2. STAGE ATTRIBUTION (the ROADMAP-5 scoping receipt): under a traced
+       workload, >= 90% of samples must carry a stage-interval tag
+       (``prof_stage_tag_fraction``), and the ``completion_ring``
+       interval's samples are broken down by FRAME class —
+       selector/epoll wait vs the eventfd drain callback vs asyncio loop
+       machinery vs other (``prof_completion_ring_*``) — which is the
+       busy-poll-vs-eventfd-arming evidence the multi-op descriptor-slot
+       work needs, the same way PR 7's trace_frac_* receipt scoped PR 9.
+
+    3. NATIVE PHASES: the reactor's per-pass ledger as fractions
+       (``prof_loop_*_frac`` of accounted loop time) — the denominator
+       under the Python-side frames.
+
+    4. TIMESERIES ANOMALY A/B: a seeded-noise latency series through the
+       REAL MetricsHistory detector + journal — the clean series fires 0
+       ``metric_anomaly`` events, the same series with an injected
+       latency step fires exactly 1 (``timeseries_anomaly_*``, gated).
+       Synthetic by design: a real latency series on this box carries 2x
+       weather swings, and a gate that can false-fire on weather teaches
+       operators to delete the alert."""
+    import asyncio
+    import random
+
+    from infinistore_tpu import profiling, telemetry, tracing
+
+    n_keys, block = 256, 64 << 10
+    conn = its.InfinityConnection(
+        its.ClientConfig(host_addr="127.0.0.1", service_port=srv.port,
+                         log_level="error")
+    )
+    conn.connect()
+    buf = _staging_buf(np, conn, n_keys * block)
+    buf[:] = np.random.randint(0, 256, size=n_keys * block, dtype=np.uint8)
+    pairs = [(f"prof-{i}", i * block) for i in range(n_keys)]
+
+    async def put():
+        await conn.write_cache_async(pairs, block, buf.ctypes.data)
+
+    def get_once(reps: int = 8) -> float:
+        async def go() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                with tracing.trace_op("batched_get", stage="enqueue") as sp:
+                    await conn.read_cache_async(pairs, block, buf.ctypes.data)
+                    if sp is not None:
+                        sp.stage("install")
+            return time.perf_counter() - t0
+
+        return asyncio.run(go())
+
+    asyncio.run(put())
+    tracing.configure(enabled=True, capacity=512, slow_op_us=60_000_000)
+
+    # The history the overhead gate prices: a real stats source (one
+    # get_stats round trip per pass). It is NOT started during the A/B —
+    # its periodic cost is measured directly below (timed_pass over the
+    # production interval) and ADDED to the sampler's A/B reading; see
+    # the docstring's overhead discussion for why.
+    def stats_source() -> dict:
+        s = conn.get_stats()
+        out = {"pool_usage": float(s["usage"])}
+        for op, os_ in s.get("ops", {}).items():
+            out[f'op_p99_us{{op="{op}"}}'] = float(os_["p99_us"])
+        return out
+
+    hist = telemetry.MetricsHistory(select=None)  # production interval (2s)
+    hist.add_source("", stats_source)
+
+    def half(on: bool) -> float:
+        # One min-filtered half: the sampler's cost is uniform in time,
+        # so the min over 3 back-to-back runs keeps it while shedding
+        # weather spikes (see the docstring).
+        profiling.configure(enabled=on)
+        return min(get_once() for _ in range(3))
+
+    # Warm both paths (TCP + loop + allocator warmth must not be booked
+    # against whichever half runs first).
+    half(True)
+    half(False)
+
+    times = {True: float("inf"), False: float("inf")}
+    sums = {True: 0.0, False: 0.0}
+    ratios: list = []
+    flip = [0]
+
+    def pair():
+        flip[0] ^= 1
+        sample = {}
+        for on in ((True, False) if flip[0] else (False, True)):
+            sample[on] = half(on)
+        for on in (True, False):
+            times[on] = min(times[on], sample[on])
+            sums[on] += sample[on]
+        ratios.append(sample[True] / sample[False])
+
+    def estimate() -> float:
+        # Three estimators, min: median-of-ratios (robust to spiked
+        # pairs), ratio of interleaved sums (robust to multi-pair
+        # weather periods), and min-by-field (each config's calmest half
+        # across ALL pairs — the _contended_latency_us idiom; a fixed-
+        # rate sampler puts ~1-2 ticks in EVERY 15ms window, so its cost
+        # survives this min while weather does not).
+        med = sorted(ratios)[len(ratios) // 2]
+        return max(0.0, min(
+            med, sums[True] / sums[False], times[True] / times[False]
+        ) - 1.0)
+
+    for _ in range(8):
+        pair()
+    for _ in range(10):
+        if estimate() <= 0.01:
+            break
+        pair()
+    sampler_ab = estimate()
+
+    # The history's periodic half: median real pass duration over the
+    # production sampling interval (see the docstring for why this is
+    # not an A/B).
+    def timed_pass() -> float:
+        t0 = time.perf_counter()
+        hist.sample_once()
+        return time.perf_counter() - t0
+
+    pass_s = sorted(timed_pass() for _ in range(15))[7]
+    hist_cost = pass_s / hist.interval_s
+
+    # Stage attribution: fresh aggregate, profiler on through a sustained
+    # traced workload, then classify the completion_ring interval's frames.
+    profiling.configure(enabled=True)
+    prof = profiling.profiler()
+    prof.clear()
+    for _ in range(8):
+        get_once(reps=32)
+    profiling.configure(enabled=False)
+    prof.flush()  # resolve pending samples BEFORE snapshotting coverage
+    status = prof.status()
+    tag_fraction = (
+        status["prof_tagged_samples"] / status["prof_samples"]
+        if status["prof_samples"] else 0.0
+    )
+    # The duty-cycle bound, from the attribution phase's real ticks over
+    # the real workload (see the docstring's overhead discussion).
+    duty = (
+        status["prof_tick_us"] / status["prof_ticks"] * prof.hz / 1e6
+        if status["prof_ticks"] else 0.0
+    )
+    sampler_cost = min(sampler_ab, duty)
+    overhead = sampler_cost + hist_cost
+    ring_buckets = {
+        stack: n for (stage, stack), n in prof.buckets().items()
+        if stage == "completion_ring"
+    }
+    ring_samples = sum(ring_buckets.values())
+
+    def frac(pred) -> float:
+        if ring_samples == 0:
+            return 0.0
+        return sum(n for s, n in ring_buckets.items() if pred(s)) / ring_samples
+
+    wait_frac = frac(lambda s: "selectors.py:" in s.rsplit(";", 1)[-1])
+    drain_frac = frac(
+        lambda s: "_drain_ready" in s or "_drain_ring_locked" in s
+    )
+    loop_frac = frac(
+        lambda s: (
+            "base_events.py:" in s.rsplit(";", 1)[-1]
+            or "events.py:" in s.rsplit(";", 1)[-1]
+        ) and "selectors.py:" not in s.rsplit(";", 1)[-1]
+    )
+    other_frac = max(0.0, 1.0 - wait_frac - drain_frac - loop_frac)
+
+    # Native reactor phase ledger (six clock reads per pass, always on).
+    nprof = conn.get_stats().get("prof", {})
+    phase_total = sum(
+        nprof.get(k, 0)
+        for k in ("wait_us", "events_us", "rings_us", "slices_us", "other_us")
+    ) or 1
+
+    # Timeseries anomaly A/B through the real detector + journal.
+    def anomaly_run(step: bool) -> int:
+        clk = [0.0]
+        journal = telemetry.EventJournal()
+        h = telemetry.MetricsHistory(
+            select=None, journal=journal, clock=lambda: clk[0]
+        )
+        rng = random.Random(1234)
+        series = {"fg_p99_us": 250.0}
+        h.add_source("", lambda: dict(series))
+        for i in range(40):
+            clk[0] += 1.0
+            base = 500.0 if (step and i >= 24) else 250.0
+            series["fg_p99_us"] = base * (1.0 + rng.uniform(-0.05, 0.05))
+            h.sample_once()
+        return journal.counts().get("metric_anomaly", 0)
+
+    anomaly_clean = anomaly_run(step=False)
+    anomaly_faulty = anomaly_run(step=True)
+
+    hist_status = hist.status()
+    tracing.configure(enabled=False)
+    hist.stop()
+    conn.close()
+    return {
+        "prof_overhead_cost": round(overhead, 4),
+        "prof_sampler_cost": round(sampler_cost, 4),
+        "prof_sampler_ab_cost": round(sampler_ab, 4),
+        "prof_sampler_duty_cost": round(duty, 5),
+        "timeseries_pass_ms": round(pass_s * 1e3, 3),
+        "timeseries_pass_cost": round(hist_cost, 5),
+        "prof_on_s": round(times[True], 4),
+        "prof_off_s": round(times[False], 4),
+        "prof_samples": status["prof_samples"],
+        "prof_stage_tag_fraction": round(tag_fraction, 4),
+        "prof_completion_ring_samples": ring_samples,
+        "prof_completion_ring_wait_frac": round(wait_frac, 4),
+        "prof_completion_ring_drain_frac": round(drain_frac, 4),
+        "prof_completion_ring_loop_frac": round(loop_frac, 4),
+        "prof_completion_ring_other_frac": round(other_frac, 4),
+        "prof_loop_passes": nprof.get("passes", 0),
+        "prof_loop_wait_frac": round(nprof.get("wait_us", 0) / phase_total, 4),
+        "prof_loop_events_frac": round(
+            nprof.get("events_us", 0) / phase_total, 4
+        ),
+        "prof_loop_rings_frac": round(
+            nprof.get("rings_us", 0) / phase_total, 4
+        ),
+        "prof_loop_slices_frac": round(
+            nprof.get("slices_us", 0) / phase_total, 4
+        ),
+        "prof_loop_other_frac": round(
+            nprof.get("other_us", 0) / phase_total, 4
+        ),
+        "timeseries_anomaly_clean": anomaly_clean,
+        "timeseries_anomaly_faulty": anomaly_faulty,
+        "timeseries_series": hist_status["timeseries_series"],
+        "timeseries_points": hist_status["timeseries_points"],
     }
 
 
@@ -2773,6 +3048,7 @@ def main(argv=None) -> int:
     qos = _qos_isolation_us(its, np)
     trace = _trace_metrics(its, np, srv)
     telem = _telemetry_metrics(its, np, srv)
+    prof = _profiling_metrics(its, np, srv)
     engine = _engine_harness_metrics(its, np)
     chaos = _cluster_chaos_metrics(its, np)
     churn = _membership_churn_metrics(its, np)
@@ -2891,6 +3167,16 @@ def main(argv=None) -> int:
         # overhead (interleaved paired, <= 3%) — all gated in
         # tools/bench_check.py.
         **telem,
+        # Continuous profiling + metrics history (docs/observability.md,
+        # profiling + time-series sections): the profiler+history
+        # enabled-cost (paired interleaved, gated <= 3%), the frame-level
+        # stage-attribution receipt — tag coverage >= 90% and the
+        # completion_ring interval's frame breakdown, the ROADMAP-5
+        # busy-poll-vs-eventfd scoping evidence —, the native reactor's
+        # per-pass phase fractions, and the metric_anomaly A-B (exactly
+        # one on an injected step, zero clean) — gated in
+        # tools/bench_check.py.
+        **prof,
         # Engine-shaped connector proof (BASELINE config 4 in spirit): the
         # continuous-batching harness at engine scale — 32 requests 8-way
         # concurrent under a MIXED hit/miss schedule (expected ~0.5), demo

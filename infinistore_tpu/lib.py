@@ -1335,6 +1335,13 @@ class InfinityConnection:
           counters and ``entries``, each ``{trace_id, parent_id, op,
           prio, ok, recv_us, first_slice_us, last_slice_us, done_us,
           bytes}`` — the ticks ``GET /trace`` joins to client spans;
+        - ``prof``: reactor loop-pass phase accounting
+          (docs/observability.md, profiling section) — ``passes`` plus
+          cumulative per-phase microseconds: ``wait_us`` (blocked in
+          epoll), ``events_us`` (socket event dispatch), ``rings_us``
+          (descriptor-ring drain), ``slices_us`` (cont slices + their
+          QoS scheduling decisions), ``other_us`` (park/doorbell arming
+          and bookkeeping) — exported as ``infinistore_prof_*``;
         - ``ops``: per-opcode ``count``, ``errors``, ``bytes_in``,
           ``bytes_out``, ``total_us``, ``p50_us``, ``p99_us``, and
           ``hist_us`` — sparse ``[le_us, count]`` latency buckets

@@ -21,13 +21,15 @@ import argparse
 import asyncio
 import dataclasses
 import json
+import math
+import os
 import signal
 import sys
 import threading
 import urllib.parse
 
 from . import lib as _lib
-from . import telemetry, tracing
+from . import profiling, telemetry, tracing
 from .config import ServerConfig
 from .lib import Logger, register_server, unregister_server
 
@@ -92,9 +94,23 @@ def _http_response(status: int, payload: dict) -> bytes:
     ).encode() + body
 
 
+def _text_response(status: int, text: str,
+                   ctype: str = "text/plain; charset=utf-8") -> bytes:
+    """Non-JSON response (the folded-stack /profile body)."""
+    body = text.encode()
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(status, "OK")
+    return (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: {ctype}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"Connection: close\r\n\r\n"
+    ).encode() + body
+
+
 def _prometheus_text(stats: dict, membership_status: dict = None,
                      slo_status: dict = None, event_counts: dict = None,
                      gossip_status: dict = None, tier_status: dict = None,
+                     prof_status: dict = None, timeseries_status: dict = None,
                      exemplars: bool = False) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
     reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
@@ -191,6 +207,23 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
             "# TYPE infinistore_ring_pending gauge",
             f"infinistore_ring_pending {ring['pending']}",
         ]
+    # Reactor loop-pass phase accounting (docs/observability.md,
+    # profiling section): per-phase cumulative microseconds plus the pass
+    # count — rate() over infinistore_prof_loop_us gives per-phase
+    # utilization, the native denominator under the /profile sampler's
+    # Python-side frames.
+    nprof = stats.get("prof")
+    if nprof is not None:
+        lines += [
+            "# TYPE infinistore_prof_loop_passes counter",
+            f"infinistore_prof_loop_passes {nprof['passes']}",
+            "# TYPE infinistore_prof_loop_us counter",
+            f'infinistore_prof_loop_us{{phase="wait"}} {nprof["wait_us"]}',
+            f'infinistore_prof_loop_us{{phase="events"}} {nprof["events_us"]}',
+            f'infinistore_prof_loop_us{{phase="rings"}} {nprof["rings_us"]}',
+            f'infinistore_prof_loop_us{{phase="slices"}} {nprof["slices_us"]}',
+            f'infinistore_prof_loop_us{{phase="other"}} {nprof["other_us"]}',
+        ]
     # Tracing surfaces (docs/observability.md): the client flight
     # recorder's counters (span volume + the slow-op watchdog) and the
     # server-side trace tick ring's coverage counters. The spans/ticks
@@ -280,6 +313,10 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
         lines += _tier_prometheus_lines(tier_status)
     if slo_status is not None:
         lines += _slo_prometheus_lines(slo_status)
+    if prof_status is not None:
+        lines += _prof_prometheus_lines(prof_status)
+    if timeseries_status is not None:
+        lines += _timeseries_prometheus_lines(timeseries_status)
     if event_counts is not None:
         lines += _events_prometheus_lines(event_counts)
     # Exemplar syntax is ILLEGAL in the plain 0.0.4 text format (a scraper
@@ -472,6 +509,69 @@ def _tier_prometheus_lines(ts: dict) -> list:
     ]
 
 
+def _prof_prometheus_lines(ps: dict) -> list:
+    """Sampling-profiler gauge families for /metrics, from the flat
+    ``profiling.SamplingProfiler.status`` snapshot. The counters checker
+    (ITS-C008, tools/analysis/counters.py) holds this exporter to the
+    ``prof_*`` status vocabulary both ways — a profiler whose coverage
+    dashboards cannot see is observability drift
+    (docs/observability.md, profiling section)."""
+    return [
+        "# TYPE infinistore_prof_samples counter",
+        f"infinistore_prof_samples {ps['prof_samples']}",
+        "# TYPE infinistore_prof_tagged_samples counter",
+        f"infinistore_prof_tagged_samples {ps['prof_tagged_samples']}",
+        "# TYPE infinistore_prof_threads gauge",
+        f"infinistore_prof_threads {ps['prof_threads']}",
+        "# TYPE infinistore_prof_buckets gauge",
+        f"infinistore_prof_buckets {ps['prof_buckets']}",
+        "# TYPE infinistore_prof_bucket_drops counter",
+        f"infinistore_prof_bucket_drops {ps['prof_bucket_drops']}",
+        "# TYPE infinistore_prof_pending gauge",
+        f"infinistore_prof_pending {ps['prof_pending']}",
+        "# TYPE infinistore_prof_pending_drops counter",
+        f"infinistore_prof_pending_drops {ps['prof_pending_drops']}",
+        "# TYPE infinistore_prof_snapshots gauge",
+        f"infinistore_prof_snapshots {ps['prof_snapshots']}",
+        "# TYPE infinistore_prof_hz gauge",
+        f"infinistore_prof_hz {ps['prof_hz']}",
+        "# TYPE infinistore_prof_ticks counter",
+        f"infinistore_prof_ticks {ps['prof_ticks']}",
+        "# TYPE infinistore_prof_tick_us counter",
+        f"infinistore_prof_tick_us {ps['prof_tick_us']}",
+    ]
+
+
+def _timeseries_prometheus_lines(ts: dict) -> list:
+    """Metrics-history gauge families for /metrics, from the flat
+    ``telemetry.MetricsHistory.status`` snapshot (the same dict
+    ``GET /timeseries`` serves alongside the series index). Held to the
+    ``timeseries_*`` vocabulary both ways by ITS-C008
+    (docs/observability.md, time-series section)."""
+    return [
+        "# TYPE infinistore_timeseries_series gauge",
+        f"infinistore_timeseries_series {ts['timeseries_series']}",
+        "# TYPE infinistore_timeseries_points gauge",
+        f"infinistore_timeseries_points {ts['timeseries_points']}",
+        "# TYPE infinistore_timeseries_samples counter",
+        f"infinistore_timeseries_samples {ts['timeseries_samples']}",
+        "# TYPE infinistore_timeseries_sources gauge",
+        f"infinistore_timeseries_sources {ts['timeseries_sources']}",
+        "# TYPE infinistore_timeseries_source_failures counter",
+        f"infinistore_timeseries_source_failures {ts['timeseries_source_failures']}",
+        "# TYPE infinistore_timeseries_dropped_series counter",
+        f"infinistore_timeseries_dropped_series {ts['timeseries_dropped_series']}",
+        "# TYPE infinistore_timeseries_anomalies counter",
+        f"infinistore_timeseries_anomalies {ts['timeseries_anomalies']}",
+        "# TYPE infinistore_timeseries_interval_s gauge",
+        f"infinistore_timeseries_interval_s {ts['timeseries_interval_s']}",
+        "# TYPE infinistore_timeseries_capacity gauge",
+        f"infinistore_timeseries_capacity {ts['timeseries_capacity']}",
+        "# TYPE infinistore_timeseries_last_pass_ms gauge",
+        f"infinistore_timeseries_last_pass_ms {ts['timeseries_last_pass_ms']}",
+    ]
+
+
 def _slo_prometheus_lines(slo: dict) -> list:
     """SLO gauge families for /metrics, from the flat ``SloEngine.status``
     snapshot (the same dict ``GET /slo`` serves). The counters checker
@@ -609,9 +709,17 @@ class ManageServer:
     join/leave churn never accumulates native connections."""
 
     def __init__(self, config: ServerConfig, cluster=None, scraper=None,
-                 gossip=None):
+                 gossip=None, history=None):
         self.config = config
         self.cluster = cluster
+        # Metrics history (docs/observability.md, time-series section): an
+        # attached ``telemetry.MetricsHistory`` lights up ``GET
+        # /timeseries`` (sparkline/trend queries) and its
+        # ``infinistore_timeseries_*`` /metrics families. ``GET /profile``
+        # needs no attachment — it serves the process-wide sampling
+        # profiler (``profiling.profiler()``), which exists whenever
+        # profiling was enabled.
+        self.history = history
         # Fleet telemetry (docs/observability.md): an attached
         # ``telemetry.FleetScraper`` lights up ``GET /trace?scope=cluster``
         # (cluster-joined traces) and the per-member rows of ``GET /slo``.
@@ -703,6 +811,12 @@ class ManageServer:
                 params = urllib.parse.parse_qs(query)
                 slo = telemetry.slo_engine().status()
                 counts = telemetry.get_journal().counts()
+                prof = profiling.profiler()
+                ps = prof.status() if prof is not None else None
+                hs = (
+                    self.history.status()
+                    if self.history is not None else None
+                )
                 try:
                     stats = await asyncio.to_thread(_lib.get_server_stats)
                 except Exception:
@@ -717,6 +831,9 @@ class ManageServer:
                         + (_gossip_prometheus_lines(gs) if gs is not None else [])
                         + (_tier_prometheus_lines(ts) if ts is not None else [])
                         + _slo_prometheus_lines(slo)
+                        + (_prof_prometheus_lines(ps) if ps is not None else [])
+                        + (_timeseries_prometheus_lines(hs)
+                           if hs is not None else [])
                         + _events_prometheus_lines(counts)
                     )
                     body = ("\n".join(lines) + "\n").encode()
@@ -729,6 +846,7 @@ class ManageServer:
                 return _prometheus_text(
                     stats, membership_status=ms, slo_status=slo,
                     event_counts=counts, gossip_status=gs, tier_status=ts,
+                    prof_status=ps, timeseries_status=hs,
                     exemplars=params.get("exemplars") == ["1"],
                 )
             if path == "/health" and method == "GET":
@@ -788,6 +906,20 @@ class ManageServer:
                     await asyncio.to_thread(self.scraper.scrape_once)
                     member_spans = self.scraper.member_spans()
                 return _trace_payload(stats, fmt, member_spans=member_spans)
+            if path == "/profile" and method == "GET":
+                # The continuous sampling profiler (docs/observability.md,
+                # profiling section): folded-stack text by default (any
+                # flamegraph tool; the stage is the root frame), ?fmt=chrome
+                # for a Perfetto sampling track on the same CLOCK_MONOTONIC
+                # timeline as /trace, ?save=<name> to store a diff base,
+                # ?diff=<name> for a differential profile against one.
+                # Off-loop: the read side force-resolves pending samples.
+                return await self._profile_get(query)
+            if path == "/timeseries" and method == "GET":
+                # The metrics history (docs/observability.md, time-series
+                # section): no params = the series index + timeseries_*
+                # status; ?metric=<series>&window=<seconds> = the points.
+                return await self._timeseries_get(query)
             if path == "/selftest" and method == "GET":
                 return _http_response(200, await asyncio.to_thread(self._selftest))
             if path == "/tiers" and method == "GET":
@@ -823,7 +955,8 @@ class ManageServer:
                 return await self._bootstrap_get(query)
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
                         "/selftest", "/health", "/trace", "/membership",
-                        "/slo", "/events", "/gossip", "/bootstrap", "/tiers"):
+                        "/slo", "/events", "/gossip", "/bootstrap", "/tiers",
+                        "/profile", "/timeseries"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request
@@ -857,6 +990,107 @@ class ManageServer:
                 conn.close()
             except Exception:
                 pass
+
+    async def _profile_get(self, query: str) -> bytes:
+        """GET /profile: the process sampling profiler's aggregate.
+
+        Default: folded-stack text (``stage;frame;...;leaf count``) —
+        pipe into flamegraph.pl / speedscope / Perfetto's folded importer
+        for per-stage flames. ``?fmt=chrome``: Chrome trace-event JSON —
+        a sampling track on the same monotonic timeline as ``GET
+        /trace``, so spans and stacks line up when both files load in
+        one Perfetto session. ``?save=<name>`` stores the current
+        aggregate as a named diff base (bounded); ``?diff=<name>``
+        returns the differential profile against it. 200 with
+        ``enabled: false`` when profiling was never configured (the
+        /tiers discipline); reads run off-loop — the read side
+        force-resolves pending samples."""
+        prof = profiling.profiler()
+        if prof is None:
+            return _http_response(200, {
+                "enabled": False,
+                "error": "profiling off (INFINISTORE_TPU_PROFILE=1 or "
+                         "profiling.configure(enabled=True))",
+            })
+        params = urllib.parse.parse_qs(query)
+        save = params.get("save", [None])[0]
+        diff = params.get("diff", [None])[0]
+        if save:
+            saved = await asyncio.to_thread(prof.snapshot_save, save)
+            return _http_response(200, {
+                "enabled": profiling.enabled(), "saved": saved,
+                "snapshots": prof.snapshot_names(),
+            })
+        if diff:
+            delta = await asyncio.to_thread(prof.diff, diff)
+            if delta is None:
+                return _http_response(404, {
+                    "error": f"no saved snapshot {diff!r}",
+                    "snapshots": prof.snapshot_names(),
+                })
+            return _http_response(200, {
+                "enabled": profiling.enabled(), **delta,
+            })
+        if params.get("fmt") == ["chrome"]:
+            events = await asyncio.to_thread(prof.chrome_events)
+            return _http_response(200, {
+                "traceEvents": events, "displayTimeUnit": "ms",
+            })
+        folded = await asyncio.to_thread(prof.folded)
+        return _text_response(200, folded + ("\n" if folded else ""))
+
+    async def _timeseries_get(self, query: str) -> bytes:
+        """GET /timeseries: the metrics history's trend surface. Without
+        params: the series index plus the flat ``timeseries_*`` status
+        (the vocabulary /metrics exports as ``infinistore_timeseries_*``,
+        ITS-C008). ``?metric=<series>[&window=<seconds>]``: the retained
+        ``[t_s, value]`` points (monotonic-clock seconds); REPEATED
+        ``metric`` params return every known series' points in one
+        response under ``metrics`` (the ``tools.top`` sparkline fetch —
+        one request per frame, not one per series; repeated params
+        rather than a comma list because label values may contain
+        commas). 404 for an unknown single series, 400 for a bad
+        (non-finite) window."""
+        if self.history is None:
+            return _http_response(200, {
+                "enabled": False, "error": "no metrics history attached",
+            })
+        params = urllib.parse.parse_qs(query)
+        metrics = params.get("metric", [])
+        if not metrics:
+            return _http_response(200, {
+                "enabled": True,
+                "series": self.history.series_names(),
+                **self.history.status(),
+            })
+        try:
+            window = params.get("window", [None])[0]
+            window_s = float(window) if window is not None else None
+        except ValueError:
+            return _http_response(400, {"error": "bad window"})
+        if window_s is not None and not math.isfinite(window_s):
+            # float('nan')/'inf' parse fine but nan poisons the horizon
+            # compare and serializes as bare NaN — invalid JSON.
+            return _http_response(400, {"error": "bad window"})
+        if len(metrics) > 1:
+            known = set(self.history.series_names())
+            return _http_response(200, {
+                "window_s": window_s,
+                "metrics": {
+                    m: self.history.points(m, window_s=window_s)
+                    for m in metrics if m in known
+                },
+            })
+        metric = metrics[0]
+        if metric not in self.history.series_names():
+            return _http_response(404, {
+                "error": f"unknown series {metric!r}",
+            })
+        return _http_response(200, {
+            "metric": metric,
+            "window_s": window_s,
+            "points": self.history.points(metric, window_s=window_s),
+        })
 
     def _membership_get(self) -> bytes:
         """GET /membership: the epoch-stamped view (per-member states) plus
@@ -1078,8 +1312,28 @@ async def serve(config: ServerConfig) -> None:
     register_server(None, config)
     # /proc write = file IO; keep it off the event loop (ITS-L002).
     await asyncio.to_thread(prevent_oom)
-    manage = ManageServer(config)
+    # Standing metrics history (docs/observability.md, time-series
+    # section): the CLI server trends its own /metrics families so
+    # GET /timeseries and the tools.top sparklines work out of the box —
+    # one bounded source pass per interval (~0.5ms each; the bench's
+    # timeseries_pass_cost receipt). INFINISTORE_TPU_HISTORY=0 opts out.
+    history = None
+    if os.environ.get("INFINISTORE_TPU_HISTORY", "1") not in ("", "0"):
+        history = telemetry.MetricsHistory()
+        # The manage plane binds config.host: loopback only reaches it on
+        # a wildcard bind — a specific-interface bind must be scraped at
+        # that address or the self-source fails every pass forever.
+        self_host = (
+            "127.0.0.1" if config.host in ("", "0.0.0.0", "::")
+            else config.host
+        )
+        history.add_source("", telemetry.metrics_http_source(
+            self_host, config.manage_port
+        ))
+    manage = ManageServer(config, history=history)
     await manage.start()
+    if history is not None:
+        history.start()
     tasks = []
     if config.evict_enabled:
         tasks.append(asyncio.create_task(periodic_evict(config)))
@@ -1094,6 +1348,8 @@ async def serve(config: ServerConfig) -> None:
     finally:
         for t in tasks:
             t.cancel()
+        if history is not None:
+            await asyncio.to_thread(history.stop)
         await manage.stop()
         unregister_server()
 

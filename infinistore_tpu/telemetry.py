@@ -70,6 +70,7 @@ EVENT_KINDS = (
     "client_restart",     # a crashed client replayed its durable journal
     "tier_demotion",      # an idle root's copy shipped to the pooled cold tier
     "tier_promotion",     # a reused cold root copied back to its serving owner
+    "metric_anomaly",     # metrics-history change-point detector fired
 )
 
 _DEFAULT_JOURNAL_CAPACITY = 512
@@ -1011,6 +1012,320 @@ class GossipAgent:
             "gossip_last_epoch_seen": self.last_epoch_seen,
             "gossip_last_round_ms": self.last_round_ms,
         }
+
+
+# ---------------------------------------------------------------------------
+# Metrics history: bounded time series + change-point anomaly journal.
+# ---------------------------------------------------------------------------
+
+# Families the history samples by default: the small high-signal set the
+# dashboards trend (op tails, occupancy, queue depths, SLO burn, tier and
+# prof planes). Bounded on purpose — history is a ring per series, and an
+# unselected family is one `startswith` miss per pass, not a leak.
+DEFAULT_HISTORY_SELECT: Tuple[str, ...] = (
+    "infinistore_op_p50_latency_us",
+    "infinistore_op_p99_latency_us",
+    "infinistore_pool_usage_ratio",
+    "infinistore_kvmap_entries",
+    "infinistore_qos_queued",
+    "infinistore_dataplane_suspended_ops",
+    "infinistore_ring_sq_depth",
+    "infinistore_slo_",
+    "infinistore_tier_cold_read_p99_us",
+    "infinistore_prof_",
+    "member_",
+)
+
+
+def parse_metrics_text(text: str) -> Dict[str, float]:
+    """Flat ``name{labels} -> value`` map from Prometheus exposition text
+    (comments/TYPE lines skipped, exemplar suffixes stripped) — the
+    history's input shape. ``tools.top`` keeps its own copy of this
+    parse (``_metric_families``) by design: tools/ stays stdlib-only
+    with no package import; a format change must touch both."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        line = line.split(" # ", 1)[0]
+        parts = line.rsplit(" ", 1)
+        if len(parts) != 2:
+            continue
+        try:
+            out[parts[0]] = float(parts[1])
+        except ValueError:
+            continue
+    return out
+
+
+def metrics_http_source(host: str, manage_port: int,
+                        timeout_s: float = 2.0) -> Callable[[], Dict[str, float]]:
+    """A history source over a manage plane's ``GET /metrics`` (the local
+    process's own plane, or any fleet member's)."""
+    url = f"http://{host}:{manage_port}/metrics"
+
+    def fetch() -> Dict[str, float]:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return parse_metrics_text(resp.read(4 << 20).decode())
+
+    return fetch
+
+
+def scraper_source(scraper: "FleetScraper") -> Callable[[], Dict[str, float]]:
+    """A history source over the fleet scraper's per-member health rows:
+    ``member_ops_per_s{member}`` / ``member_queue_depth{member}`` series,
+    so per-member throughput and queue depth trend without a second
+    scrape of anyone's manage plane."""
+
+    def fetch() -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for m in scraper.status()["members"]:
+            out[f'member_ops_per_s{{member="{m["member"]}"}}'] = m["ops_per_s"]
+            out[f'member_queue_depth{{member="{m["member"]}"}}'] = float(
+                m["queue_depth"]
+            )
+        return out
+
+    return fetch
+
+
+class MetricsHistory:
+    """Bounded ring of sampled ``/metrics`` families + change-point journal.
+
+    The one-shot ``/metrics`` snapshot answers "what is the p99 NOW"; the
+    SLO engine answers "is the budget burning"; neither answers "when did
+    it move, and what moved with it". This ring does (docs/observability.md,
+    time-series section): every ``interval_s`` it pulls each registered
+    source (a callable returning a flat ``name -> value`` map — the local
+    manage plane via :func:`metrics_http_source`, the fleet via
+    :func:`scraper_source`), keeps the last ``capacity`` points per
+    selected series, serves them at ``GET /timeseries``, drives the
+    ``tools.top`` sparkline columns, and runs a rolling-window
+    change-point detector per series that journals a ``metric_anomaly``
+    event on each detected step (edge-triggered with hysteresis — a
+    sustained shift is one event, and the journal stamps the active
+    trace id like every other kind).
+
+    Detection is deliberately simple and parameter-light: the probe
+    window's mean against the preceding baseline window's mean, fired
+    when the step exceeds BOTH ``detect_sigma`` baseline standard
+    deviations AND ``detect_min_rel`` of the baseline magnitude (the
+    relative floor keeps a flat series' zero-sigma from firing on
+    float dust, and sigma keeps a noisy series' normal scatter from
+    firing on weather). Clock-injectable, nothing sleeps in the math —
+    the properties are tested with a fake clock, the bench A/B gates
+    exactly-one-on-a-step / zero-on-clean (``timeseries_anomaly``).
+    """
+
+    def __init__(self, interval_s: float = 2.0,
+                 capacity: int = 256,
+                 max_series: int = 128,
+                 select: Optional[Tuple[str, ...]] = DEFAULT_HISTORY_SELECT,
+                 journal: Optional[EventJournal] = None,
+                 clock=time.monotonic,
+                 detect_base_n: int = 12,
+                 detect_probe_n: int = 4,
+                 detect_sigma: float = 4.0,
+                 detect_min_rel: float = 0.25):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2")
+        self.interval_s = interval_s
+        self.capacity = capacity
+        self.max_series = max_series
+        self.select = tuple(select) if select is not None else None
+        self.journal = journal if journal is not None else get_journal()
+        self._clock = clock
+        self.detect_base_n = detect_base_n
+        self.detect_probe_n = detect_probe_n
+        self.detect_sigma = detect_sigma
+        self.detect_min_rel = detect_min_rel
+        self._lock = threading.Lock()
+        # its: guard[_sources, _series, _armed: _lock]
+        self._sources: List[Tuple[str, Callable[[], Dict[str, float]]]] = []
+        self._series: Dict[str, deque] = {}  # name -> deque[(t_s, value)]
+        self._armed: Dict[str, bool] = {}    # per-series detector edge state
+        # its: guard[samples_total, source_failures, dropped_series, anomalies_total, last_pass_ms: _lock]
+        self.samples_total = 0
+        self.source_failures = 0
+        self.dropped_series = 0
+        self.anomalies_total = 0
+        self.last_pass_ms = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def add_source(self, name: str, fn: Callable[[], Dict[str, float]]):
+        """Register a source; ``name`` prefixes its keys (``"name:key"``)
+        so two sources exporting the same family cannot collide. The
+        empty name is the local process (keys unprefixed)."""
+        with self._lock:
+            self._sources.append((name, fn))
+
+    def _selected(self, key: str) -> bool:
+        if self.select is None:
+            return True
+        return any(key.startswith(p) for p in self.select)
+
+    # -- one sample pass -----------------------------------------------------
+
+    def _detect_locked(self, name: str, dq: deque) -> Optional[dict]:
+        # its: requires[_lock]
+        need = self.detect_base_n + self.detect_probe_n
+        if len(dq) < need:
+            return None
+        vals = [v for _, v in list(dq)[-need:]]
+        base = vals[: self.detect_base_n]
+        probe = vals[self.detect_base_n:]
+        base_mean = sum(base) / len(base)
+        var = sum((v - base_mean) ** 2 for v in base) / len(base)
+        std = var ** 0.5
+        probe_mean = sum(probe) / len(probe)
+        delta = abs(probe_mean - base_mean)
+        threshold = max(
+            self.detect_sigma * std,
+            self.detect_min_rel * max(abs(base_mean), 1e-9),
+        )
+        armed = self._armed.get(name, True)
+        if armed and delta > threshold:
+            self._armed[name] = False
+            self.anomalies_total += 1
+            return {
+                "metric": name,
+                "baseline": round(base_mean, 6),
+                "current": round(probe_mean, 6),
+                "delta": round(probe_mean - base_mean, 6),
+                "threshold": round(threshold, 6),
+            }
+        if not armed and delta < 0.5 * threshold:
+            # Hysteresis re-arm: the series settled (at either level) for
+            # long enough that the probe/baseline windows agree again.
+            self._armed[name] = True
+        return None
+
+    def sample_once(self) -> dict:
+        """One pass over every source (blocking HTTP for HTTP sources —
+        callers keep this off the event loop; the background thread and
+        tests drive it). Returns ``{"series", "anomalies"}``; journal
+        emits happen OUTSIDE the lock (the ITS-R003 discipline)."""
+        t0 = self._clock()
+        with self._lock:
+            sources = list(self._sources)
+        fired: List[dict] = []
+        updated = 0
+        for name, fn in sources:
+            try:
+                values = fn()
+            except Exception:
+                # A dead source costs one failure count per pass, never
+                # the pass itself (the scraper discipline).
+                with self._lock:
+                    self.source_failures += 1
+                continue
+            now = self._clock()
+            with self._lock:
+                for key, value in values.items():
+                    full = f"{name}:{key}" if name else key
+                    if not self._selected(key):
+                        continue
+                    dq = self._series.get(full)
+                    if dq is None:
+                        if len(self._series) >= self.max_series:
+                            self.dropped_series += 1
+                            continue
+                        dq = self._series[full] = deque(maxlen=self.capacity)
+                    dq.append((now, float(value)))
+                    updated += 1
+                    anomaly = self._detect_locked(full, dq)
+                    if anomaly is not None:
+                        fired.append(anomaly)
+        for anomaly in fired:
+            self.journal.emit("metric_anomaly", **anomaly)
+        with self._lock:
+            self.samples_total += 1
+            self.last_pass_ms = round((self._clock() - t0) * 1e3, 3)
+            n_series = len(self._series)
+        return {"series": n_series, "updated": updated,
+                "anomalies": len(fired)}
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self):
+        """Sample every ``interval_s`` on a daemon thread, immediately on
+        entry (the scraper discipline: no empty first interval)."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="its-metrics-history", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                self.sample_once()
+            except Exception:
+                # Per-source failures are already counted inside the pass;
+                # this guards the pass machinery itself.
+                with self._lock:
+                    self.source_failures += 1
+            if self._stop.wait(self.interval_s):
+                return
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    # -- read side -----------------------------------------------------------
+
+    def series_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def points(self, metric: str,
+               window_s: Optional[float] = None) -> List[List[float]]:
+        """``[[t_s, value], ...]`` oldest-first for one series, clipped to
+        the trailing ``window_s`` (monotonic-clock seconds — deltas are
+        meaningful, absolutes are process-relative)."""
+        now = self._clock()
+        with self._lock:
+            dq = self._series.get(metric)
+            pts = list(dq) if dq is not None else []
+        if window_s is not None:
+            horizon = now - window_s
+            pts = [p for p in pts if p[0] >= horizon]
+        return [[round(t, 3), v] for t, v in pts]
+
+    def status(self) -> dict:
+        """Flat ``timeseries_*`` snapshot for ``GET /timeseries`` and the
+        ``infinistore_timeseries_*`` /metrics families — held in lockstep
+        with ``server._timeseries_prometheus_lines`` and
+        docs/observability.md by ITS-C008.
+
+        Keys: ``timeseries_series`` (live series), ``timeseries_points``
+        (retained points), ``timeseries_samples`` (passes),
+        ``timeseries_sources``, ``timeseries_source_failures``,
+        ``timeseries_dropped_series`` (series past the cap),
+        ``timeseries_anomalies`` (change-points journaled),
+        ``timeseries_interval_s``, ``timeseries_capacity``,
+        ``timeseries_last_pass_ms``."""
+        with self._lock:
+            return {
+                "timeseries_series": len(self._series),
+                "timeseries_points": sum(
+                    len(dq) for dq in self._series.values()
+                ),
+                "timeseries_samples": self.samples_total,
+                "timeseries_sources": len(self._sources),
+                "timeseries_source_failures": self.source_failures,
+                "timeseries_dropped_series": self.dropped_series,
+                "timeseries_anomalies": self.anomalies_total,
+                "timeseries_interval_s": self.interval_s,
+                "timeseries_capacity": self.capacity,
+                "timeseries_last_pass_ms": self.last_pass_ms,
+            }
 
 
 # ---------------------------------------------------------------------------

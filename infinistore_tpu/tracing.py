@@ -292,6 +292,32 @@ def set_slow_op_hook(cb) -> None:
     _slow_op_hook = cb
 
 
+# Span-bind listener (profiling.py registers the sampling profiler's
+# thread->span map feed here). Same single-slot pattern as the slow-op
+# hook and for the same reason: profiling imports tracing, not the
+# reverse. Called with the NEW active span (or None) after every bind/
+# unbind on the calling thread; with no profiler the cost is one None
+# check per bind — and binds only happen on traced ops.
+_bind_hook = None
+
+
+def set_bind_hook(cb) -> None:
+    """Register ``cb(span_or_none)`` to observe active-span changes on
+    whatever thread performs them (``None`` unregisters). Exceptions are
+    swallowed — an observer cannot fail the traced op."""
+    global _bind_hook
+    _bind_hook = cb
+
+
+def _notify_bind():
+    hook = _bind_hook
+    if hook is not None:
+        try:
+            hook(_current.get())
+        except Exception:
+            pass
+
+
 def configure(enabled: Optional[bool] = None,
               capacity: Optional[int] = None,
               slow_op_us: Optional[int] = None) -> Optional[FlightRecorder]:
@@ -370,10 +396,12 @@ def use_span(span: Optional[Span]):
         yield None
         return
     token = _current.set(span)
+    _notify_bind()
     try:
         yield span
     finally:
         _current.reset(token)
+        _notify_bind()
 
 
 @contextlib.contextmanager
@@ -387,10 +415,12 @@ def override_span(span: Optional[Span]):
         yield span
         return
     token = _current.set(span)
+    _notify_bind()
     try:
         yield span
     finally:
         _current.reset(token)
+        _notify_bind()
 
 
 def bind_span(span: Optional[Span]):
@@ -400,12 +430,15 @@ def bind_span(span: Optional[Span]):
     an untraced op)."""
     if span is None:
         return None
-    return _current.set(span)
+    token = _current.set(span)
+    _notify_bind()
+    return token
 
 
 def unbind_span(token):
     if token is not None:
         _current.reset(token)
+        _notify_bind()
 
 
 @contextlib.contextmanager
@@ -422,6 +455,7 @@ def trace_op(name: str, stage: Optional[str] = None):
     if stage is not None:
         span.stage(stage)
     token = _current.set(span)
+    _notify_bind()
     try:
         yield span
     except BaseException as e:
@@ -429,6 +463,7 @@ def trace_op(name: str, stage: Optional[str] = None):
         raise
     finally:
         _current.reset(token)
+        _notify_bind()
         span.finish()
 
 

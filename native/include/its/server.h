@@ -301,6 +301,25 @@ class Server {
     // adaptive slice budget (see run_cont_slice).
     int idle_streak_ = 0;
 
+    // Reactor loop-pass phase accounting (docs/observability.md,
+    // profiling section): cumulative CLOCK_MONOTONIC microseconds per
+    // pass phase — the epoll wait itself, socket event dispatch,
+    // descriptor-ring drain, the sliced-cont pass (slice execution plus
+    // its QoS scheduling decisions), and everything else (ring
+    // park/doorbell arming, timeout bookkeeping, graveyard). Exported
+    // through stats_json()["prof"] -> /metrics infinistore_prof_*; the
+    // cost is six vDSO clock reads per pass, amortized against the real
+    // work a non-idle pass does (an idle reactor blocks 200ms per pass).
+    // Reactor-thread-only, read via call() like every other counter.
+    struct ProfCounters {
+        uint64_t passes = 0;
+        uint64_t wait_us = 0;    // blocked in epoll_wait
+        uint64_t events_us = 0;  // accept/readable/writable dispatch
+        uint64_t rings_us = 0;   // drain_rings descriptor consumption
+        uint64_t slices_us = 0;  // run_cont_pass (slices + QoS decisions)
+        uint64_t other_us = 0;   // park/doorbell arming, bookkeeping
+    } prof_;
+
     // Trace tick ring (docs/observability.md): server_recv/first_slice/
     // last_slice/done stamps for ops that carried a wire trace context.
     // Reactor-thread-only (stats_json reads it via call()); untraced ops

@@ -429,6 +429,17 @@ std::string Server::stats_json() {
                                  rc->ring->pending_bg.size();
                   return std::to_string(pending);
               }() + "}" +
+              // Reactor loop-pass phase accounting (docs/observability.md,
+              // profiling section): where each pass's wall time went —
+              // the native half of the continuous-profiling plane, the
+              // per-phase denominator the /profile sampler's Python-side
+              // frames do not see.
+              ",\"prof\":{\"passes\":" + std::to_string(prof_.passes) +
+              ",\"wait_us\":" + std::to_string(prof_.wait_us) +
+              ",\"events_us\":" + std::to_string(prof_.events_us) +
+              ",\"rings_us\":" + std::to_string(prof_.rings_us) +
+              ",\"slices_us\":" + std::to_string(prof_.slices_us) +
+              ",\"other_us\":" + std::to_string(prof_.other_us) + "}" +
               // Server-side trace tick ring (docs/observability.md): the
               // manage plane's /trace endpoint joins these to client spans
               // by trace id; recorded/dropped size the ring's coverage.
@@ -492,6 +503,7 @@ void Server::loop() {
     // run_cont_pass for how the streak boosts a lone suspended op).
     int idle_streak = 0;
     while (!stop_requested_.load(std::memory_order_relaxed)) {
+        uint64_t pass_t0 = now_us();
         // Pending sliced ops: poll without blocking so their next slice runs
         // right after any ready events (fairness: events first, then
         // slices). Exception: when the ONLY pending work is background
@@ -526,11 +538,21 @@ void Server::loop() {
                 for (Conn* rc : ring_conns_)
                     ring_flag_clear(&rc->ring->view.ctrl->srv_waiting);
         }
+        uint64_t wait_t0 = now_us();
         int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
+        uint64_t wait_t1 = now_us();
         for (Conn* rc : ring_conns_)
             ring_flag_clear(&rc->ring->view.ctrl->srv_waiting);
         if (n < 0) {
-            if (errno == EINTR) continue;
+            if (errno == EINTR) {
+                // The interrupted pass still blocked in epoll — book it,
+                // or a signal-heavy host undercounts the wait fraction
+                // the busy-poll-vs-eventfd receipt reads.
+                prof_.passes++;
+                prof_.wait_us += wait_t1 - wait_t0;
+                prof_.other_us += wait_t0 - pass_t0;
+                continue;
+            }
             ITS_LOG_ERROR("epoll_wait: %s", strerror(errno));
             break;
         }
@@ -561,9 +583,22 @@ void Server::loop() {
                 if (!c->dead && (events[i].events & EPOLLIN)) conn_readable(c);
             }
         }
+        uint64_t events_t1 = now_us();
         drain_rings();
+        uint64_t rings_t1 = now_us();
         run_cont_pass(n, &idle_streak);
+        uint64_t slices_t1 = now_us();
         graveyard_.clear();
+        // Phase ledger (docs/observability.md): the pass's wall time
+        // attributed to wait / event dispatch / ring drain / cont slices,
+        // with the pre-wait bookkeeping (timeout calc, ring park) and the
+        // graveyard sweep under "other".
+        prof_.passes++;
+        prof_.wait_us += wait_t1 - wait_t0;
+        prof_.events_us += events_t1 - wait_t1;
+        prof_.rings_us += rings_t1 - events_t1;
+        prof_.slices_us += slices_t1 - rings_t1;
+        prof_.other_us += (wait_t0 - pass_t0) + (now_us() - slices_t1);
     }
     // Drain control closures posted during shutdown so no caller hangs.
     {

@@ -219,3 +219,52 @@ def test_tiering_cold_floor_and_mechanism_gates(tmp_path):
         "tiering_misses": 0,
     }))
     assert bench_check.main([str(p)]) == 1
+
+
+def _prof_receipt(**over):
+    """A healthy profiling/timeseries receipt slice; override to break."""
+    doc = {
+        "prof_overhead_cost": 0.004,
+        "prof_stage_tag_fraction": 0.97,
+        "prof_completion_ring_samples": 41,
+        "timeseries_anomaly_faulty": 1,
+        "timeseries_anomaly_clean": 0,
+    }
+    doc.update(over)
+    return doc
+
+
+def test_profiling_gates_pass_on_healthy_receipt(tmp_path):
+    p = tmp_path / "prof.json"
+    p.write_text(json.dumps(_prof_receipt()))
+    assert bench_check.main([str(p)]) == 0
+
+
+def test_prof_overhead_gate(tmp_path):
+    # A sampler whose frame walks eat >3% of op wall time is too heavy
+    # for an always-on production instrument.
+    p = tmp_path / "prof.json"
+    p.write_text(json.dumps(_prof_receipt(prof_overhead_cost=0.06)))
+    assert bench_check.main([str(p)]) == 1
+
+
+def test_prof_stage_attribution_gate(tmp_path):
+    # Untagged samples mean the thread->span feed broke; a completion_ring
+    # interval with no samples means the ROADMAP-5 receipt is empty.
+    p = tmp_path / "prof.json"
+    p.write_text(json.dumps(_prof_receipt(prof_stage_tag_fraction=0.5)))
+    assert bench_check.main([str(p)]) == 1
+    p.write_text(json.dumps(_prof_receipt(prof_completion_ring_samples=0)))
+    assert bench_check.main([str(p)]) == 1
+
+
+def test_timeseries_anomaly_gate(tmp_path):
+    # The step must fire exactly once (edge-triggering) and never on the
+    # clean run (a false positive teaches operators to delete the alert).
+    p = tmp_path / "prof.json"
+    p.write_text(json.dumps(_prof_receipt(timeseries_anomaly_faulty=0)))
+    assert bench_check.main([str(p)]) == 1
+    p.write_text(json.dumps(_prof_receipt(timeseries_anomaly_faulty=3)))
+    assert bench_check.main([str(p)]) == 1
+    p.write_text(json.dumps(_prof_receipt(timeseries_anomaly_clean=1)))
+    assert bench_check.main([str(p)]) == 1

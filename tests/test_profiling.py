@@ -1,0 +1,668 @@
+"""Continuous profiling + metrics history (docs/observability.md).
+
+Covers the contracts the profiling PR established:
+
+- the sampler resolves stage attribution by DESTINATION (a sample between
+  two stamps tags the boundary it was traveling toward), retrospectively,
+  with untagged/overflow/pending all counted;
+- the thread->span map is fed from the tracing bind hook and cleared on
+  unbind; samples of an untraced thread count as untagged;
+- aggregation is bounded: collapsed-stack buckets overflow into a counted
+  `~overflow` bucket, the pending queue force-resolves at capacity;
+- ``GET /profile`` over real HTTP: folded output non-empty under load,
+  ``?fmt=chrome`` is valid trace-event JSON whose sampling track shares
+  the CLOCK_MONOTONIC timeline with ``/trace`` spans for the same traced
+  op, ``?save=``/``?diff=`` round-trip a well-formed differential;
+- ``telemetry.MetricsHistory``: bounded rings, bounded series, source
+  failures survive the pass, the change-point detector fires EXACTLY ONE
+  journaled ``metric_anomaly`` on a step and zero on clean (with
+  hysteresis re-arm), ``GET /timeseries`` serves index and points;
+- ``/metrics`` exports the ``infinistore_prof_*`` (sampler + native
+  reactor phases) and ``infinistore_timeseries_*`` families;
+- ``tools.top`` renders sparkline trends in both the unicode and the
+  plain-ASCII fallback modes.
+"""
+
+import asyncio
+import json
+import random
+import threading
+import time
+import urllib.parse
+
+import numpy as np
+import pytest
+
+import infinistore_tpu as its
+from infinistore_tpu import lib as its_lib
+from infinistore_tpu import profiling, telemetry, tracing
+from infinistore_tpu.profiling import SamplingProfiler
+from infinistore_tpu.server import ManageServer
+
+
+@pytest.fixture()
+def profiled():
+    """Process profiling enabled with a fresh profiler; module state
+    restored afterwards."""
+    old = profiling._profiler
+    profiling._profiler = None
+    tracing.configure(enabled=True, capacity=256, slow_op_us=0)
+    prof = profiling.configure(enabled=True, hz=500.0)
+    yield prof
+    profiling.configure(enabled=False)
+    profiling._profiler = old
+    tracing.configure(enabled=False)
+
+
+@pytest.fixture(autouse=True)
+def _off_after():
+    yield
+    profiling.configure(enabled=False)
+    tracing.configure(enabled=False)
+
+
+def _span(stages):
+    """A Span with the given [(stage, t_us)] stamps, without touching the
+    recorder (identity fields only matter for the tests that read them)."""
+    sp = tracing.Span("t")
+    sp.stages = list(stages)
+    return sp
+
+
+# ---------------------------------------------------------------------------
+# Stage resolution semantics (destination naming, retrospective).
+# ---------------------------------------------------------------------------
+
+
+class TestStageResolution:
+    def test_sample_between_stamps_tags_destination(self):
+        p = SamplingProfiler()
+        sp = _span([("submit", 100), ("completion_ring", 200)])
+        assert p._stage_of(sp, 150, force=False) == "completion_ring"
+        assert p._stage_of(sp, 50, force=False) == "submit"
+
+    def test_sample_past_last_stamp_waits_until_finished(self):
+        p = SamplingProfiler()
+        sp = _span([("submit", 100)])
+        assert p._stage_of(sp, 150, force=False) is None  # still open
+        sp.status = "ok"
+        assert p._stage_of(sp, 150, force=False) == "submit"
+
+    def test_force_resolves_trailing_interval(self):
+        p = SamplingProfiler()
+        sp = _span([("install", 100)])
+        assert p._stage_of(sp, 150, force=True) == "install"
+
+    def test_no_span_is_untagged(self):
+        p = SamplingProfiler()
+        assert p._stage_of(None, 1, force=False) == profiling._UNTAGGED
+
+    def test_pending_resolves_when_span_finishes(self):
+        p = SamplingProfiler()
+        sp = _span([("submit", 100)])
+        with p._lock:
+            p._pending.append((150, 1, sp, "a;b"))
+            p._resolve_locked(now_us=150)
+        assert p.status()["prof_pending"] == 1  # open span, young sample
+        sp.stages.append(("completion_ring", 200))
+        p.flush()
+        st = p.status()
+        assert st["prof_pending"] == 0
+        assert p.stage_counts() == {"completion_ring": 1}
+
+    def test_bucket_overflow_is_bounded_and_counted(self):
+        p = SamplingProfiler(max_buckets=2)
+        with p._lock:
+            for i in range(5):
+                p._pending.append((10, 1, None, f"stack{i}"))
+        p.flush()
+        st = p.status()
+        assert st["prof_buckets"] <= 3  # 2 + the overflow bucket
+        assert st["prof_bucket_drops"] == 3
+        assert (profiling._UNTAGGED, "~overflow") in p.buckets()
+
+    def test_pending_capacity_force_resolves_oldest(self):
+        p = SamplingProfiler(pending_capacity=2)
+        now = tracing._now_us()
+        sp = _span([("submit", now)])  # open span: samples cannot resolve
+        with p._lock:
+            p._pending.append((now + 1, 1, sp, "a"))
+            p._pending.append((now + 2, 1, sp, "a"))
+        # Next sample pass must force-resolve the oldest instead of growing.
+        p.track_thread()  # ensure a tracked thread exists
+
+        def spin():
+            t0 = time.time()
+            while time.time() - t0 < 0.05:
+                pass
+
+        t = threading.Thread(target=spin)
+        t.start()
+        p.track_thread(ident=t.ident)
+        p.sample_once()
+        t.join()
+        assert len(p._pending) <= 2
+        assert p.status()["prof_pending_drops"] >= 1
+        # buckets() flushes, which must NOT force-resolve the remaining
+        # young open-span samples — only the capacity overflow guessed.
+        forced = {
+            (stage, stack): n for (stage, stack), n in p.buckets().items()
+            if stage == "submit"
+        }
+        assert sum(forced.values()) == p.status()["prof_pending_drops"]
+
+    def test_flush_never_guesses_an_open_spans_young_sample(self):
+        """GET /profile mid-workload must not book an in-flight sample one
+        boundary early: flush resolves finished spans and aged samples
+        only (the review-confirmed destination-naming contract)."""
+        p = SamplingProfiler()
+        sp = _span([("submit", 100)])  # open, no later stamp yet
+        with p._lock:
+            p._pending.append((tracing._now_us(), 1, sp, "a"))
+        p.flush()
+        assert p.status()["prof_pending"] == 1  # still undecided
+        sp.stages.append(("completion_ring", tracing._now_us() + 1))
+        p.flush()
+        assert p.stage_counts() == {"completion_ring": 1}
+
+
+# ---------------------------------------------------------------------------
+# Sampling real threads + the tracing bind hook.
+# ---------------------------------------------------------------------------
+
+
+class TestSampling:
+    def test_samples_tracked_thread_frames(self):
+        p = SamplingProfiler()
+        stop = threading.Event()
+
+        def busy_worker_fn():
+            while not stop.is_set():
+                sum(i for i in range(100))
+
+        t = threading.Thread(target=busy_worker_fn, daemon=True)
+        t.start()
+        p.track_thread(ident=t.ident, name="w")
+        try:
+            for _ in range(5):
+                p.sample_once()
+        finally:
+            stop.set()
+            t.join()
+        p.flush()
+        assert p.status()["prof_samples"] >= 1
+        assert "busy_worker_fn" in p.folded()
+
+    def test_bind_hook_feeds_thread_span_map(self, profiled):
+        tid = threading.get_ident()
+        with tracing.trace_op("op", stage="enqueue") as sp:
+            assert profiled._thread_spans.get(tid) is sp
+        assert profiled._thread_spans.get(tid) is None
+
+    def test_worker_thread_samples_carry_trace_id(self, profiled):
+        """A traced op running on a worker thread tags that thread's
+        samples with its span — the whole thread->span feed, end to end,
+        driven deterministically from the test thread."""
+        release = threading.Event()
+        seen = {}
+
+        def traced_worker():
+            with tracing.trace_op("slow", stage="enqueue") as sp:
+                seen["trace_id"] = sp.trace_id
+                release.wait(2.0)
+                sp.stage("install")
+
+        t = threading.Thread(target=traced_worker, daemon=True)
+        t.start()
+        for _ in range(200):
+            if seen.get("trace_id"):
+                break
+            time.sleep(0.001)
+        for _ in range(5):
+            profiled.sample_once()
+        release.set()
+        t.join()
+        profiled.flush()
+        samples = [
+            s for s in profiled.recent_samples()
+            if s["trace_id"] == seen["trace_id"]
+        ]
+        assert samples, "no sample carried the worker op's trace id"
+        # Destination naming: mid-op samples travel toward `install`.
+        assert {s["stage"] for s in samples} <= {"install", "enqueue"}
+
+    def test_disable_keeps_data_for_postmortem(self, profiled):
+        profiled.track_thread()
+        with profiled._lock:
+            profiled._pending.append((1, 2, None, "x"))
+        profiling.configure(enabled=False)
+        assert not profiling.enabled()
+        assert profiling.profiler() is profiled
+        profiled.flush()
+        assert profiling.profiler().status()["prof_samples"] == 1
+
+    def test_clear_resets_aggregates(self):
+        p = SamplingProfiler()
+        with p._lock:
+            p._pending.append((1, 2, None, "x"))
+        p.flush()
+        assert p.status()["prof_samples"] == 1
+        p.clear()
+        st = p.status()
+        assert st["prof_samples"] == 0 and st["prof_buckets"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Snapshots, diffs, chrome export.
+# ---------------------------------------------------------------------------
+
+
+class TestExport:
+    def _prof_with(self, stacks):
+        p = SamplingProfiler()
+        with p._lock:
+            for s in stacks:
+                p._pending.append((10, 1, None, s))
+        p.flush()
+        return p
+
+    def test_folded_format(self):
+        p = self._prof_with(["a;b", "a;b", "a;c"])
+        lines = set(p.folded().splitlines())
+        assert f"{profiling._UNTAGGED};a;b 2" in lines
+        assert f"{profiling._UNTAGGED};a;c 1" in lines
+
+    def test_diff_is_well_formed(self):
+        p = self._prof_with(["a;b"])
+        p.snapshot_save("base")
+        with p._lock:
+            p._pending.append((11, 1, None, "a;b"))
+            p._pending.append((11, 1, None, "new;stack"))
+        p.flush()
+        d = p.diff("base")
+        assert d["base"] == "base" and d["samples_delta"] == 2
+        lines = set(d["folded_delta"].splitlines())
+        assert f"{profiling._UNTAGGED};a;b 1" in lines
+        assert f"{profiling._UNTAGGED};new;stack 1" in lines
+        assert p.diff("missing") is None
+
+    def test_snapshots_bounded(self):
+        p = self._prof_with(["a"])
+        p.max_snapshots = 2
+        for name in ("s1", "s2", "s3"):
+            p.snapshot_save(name)
+        assert p.snapshot_names() == ["s2", "s3"]
+
+    def test_chrome_events_schema(self):
+        p = self._prof_with(["a;b"])
+        events = p.chrome_events()
+        assert events[0]["ph"] == "M"  # process_name metadata
+        sample = events[1]
+        assert sample["ph"] == "i" and sample["pid"] == 2
+        assert sample["name"] == "b"
+        assert sample["args"]["stack"] == "a;b"
+
+
+# ---------------------------------------------------------------------------
+# MetricsHistory: rings, bounds, detection, journal.
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsHistory:
+    def _history(self, journal=None, **kw):
+        clk = [0.0]
+        kw.setdefault("select", None)
+        h = telemetry.MetricsHistory(
+            journal=journal or telemetry.EventJournal(),
+            clock=lambda: clk[0], **kw
+        )
+        return h, clk
+
+    def test_ring_and_window(self):
+        h, clk = self._history(capacity=4)
+        vals = {"m": 0.0}
+        h.add_source("", lambda: dict(vals))
+        for i in range(10):
+            clk[0] += 1.0
+            vals["m"] = float(i)
+            h.sample_once()
+        pts = h.points("m")
+        assert len(pts) == 4 and pts[-1][1] == 9.0  # ring-bounded
+        # window horizon is inclusive: now=10, window 1.5 -> t in {9, 10}
+        assert len(h.points("m", window_s=1.5)) == 2
+
+    def test_max_series_bounded_and_counted(self):
+        h, clk = self._history(max_series=2)
+        h.add_source("", lambda: {"a": 1.0, "b": 2.0, "c": 3.0, "d": 4.0})
+        clk[0] = 1.0
+        h.sample_once()
+        st = h.status()
+        assert st["timeseries_series"] == 2
+        assert st["timeseries_dropped_series"] == 2
+
+    def test_source_failure_survives_pass(self):
+        h, clk = self._history()
+
+        def bad():
+            raise RuntimeError("down")
+
+        h.add_source("bad", bad)
+        h.add_source("good", lambda: {"m": 1.0})
+        clk[0] = 1.0
+        out = h.sample_once()
+        assert out["series"] == 1
+        assert h.status()["timeseries_source_failures"] == 1
+        assert h.points("good:m")
+
+    def test_select_prefixes_filter(self):
+        h, clk = self._history(select=("keep_",))
+        h.add_source("", lambda: {"keep_x": 1.0, "drop_y": 2.0})
+        clk[0] = 1.0
+        h.sample_once()
+        assert h.series_names() == ["keep_x"]
+
+    def test_step_fires_exactly_one_anomaly_and_rearms(self):
+        journal = telemetry.EventJournal()
+        h, clk = self._history(journal=journal, detect_base_n=6,
+                               detect_probe_n=2)
+        rng = random.Random(7)
+        vals = {"m": 10.0}
+        h.add_source("", lambda: dict(vals))
+
+        def run(n, level):
+            for _ in range(n):
+                clk[0] += 1.0
+                vals["m"] = level * (1.0 + rng.uniform(-0.02, 0.02))
+                h.sample_once()
+
+        run(20, 10.0)  # clean
+        assert h.status()["timeseries_anomalies"] == 0
+        run(12, 25.0)  # step: one edge, then quiet at the new level
+        assert h.status()["timeseries_anomalies"] == 1
+        events = [e for e in journal.snapshot()
+                  if e["kind"] == "metric_anomaly"]
+        assert len(events) == 1
+        attrs = events[0]["attrs"]
+        assert attrs["metric"] == "m"
+        assert attrs["current"] > attrs["baseline"]
+        run(12, 50.0)  # re-armed: a second step fires a second edge
+        assert h.status()["timeseries_anomalies"] == 2
+
+    def test_flat_series_never_fires(self):
+        h, clk = self._history()
+        h.add_source("", lambda: {"m": 5.0})
+        for _ in range(40):
+            clk[0] += 1.0
+            h.sample_once()
+        assert h.status()["timeseries_anomalies"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Manage plane over real HTTP: /profile, /timeseries, /metrics.
+# ---------------------------------------------------------------------------
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, body = raw.split(b"\r\n\r\n", 1)
+    status = int(head.split(b" ", 2)[1])
+    return status, head.decode("latin-1"), body
+
+
+class TestManagePlane:
+    @pytest.fixture()
+    def profiled_server(self, server, profiled):
+        """A live store + manage plane + history, with traced load driven
+        through a real connection so the profiler holds samples."""
+        conn = its.InfinityConnection(its.ClientConfig(
+            host_addr="127.0.0.1", service_port=server["port"],
+            log_level="error",
+        ))
+        conn.connect()
+        n, block = 64, 16 << 10
+        buf = np.random.randint(0, 256, size=n * block, dtype=np.uint8)
+        conn.register_mr(buf)
+        pairs = [(f"prof-{i}", i * block) for i in range(n)]
+
+        def drive(reads=20):
+            async def go():
+                await conn.write_cache_async(pairs, block, buf.ctypes.data)
+                for _ in range(reads):
+                    with tracing.trace_op("batched_get", stage="enqueue") as sp:
+                        await conn.read_cache_async(
+                            pairs, block, buf.ctypes.data
+                        )
+                        if sp is not None:
+                            sp.stage("install")
+            asyncio.run(go())
+
+        hist = telemetry.MetricsHistory(select=None)
+        hist.add_source("", lambda: {"probe_metric": 1.0})
+        old = its_lib._server_handle
+        its_lib._server_handle = server["handle"]
+        yield {"drive": drive, "hist": hist, "config": server["config"],
+               "prof": profiled}
+        its_lib._server_handle = old
+        conn.close()
+
+    def _with_manage(self, ps, coro):
+        async def main():
+            manage = ManageServer(ps["config"], history=ps["hist"])
+            manage._server = await asyncio.start_server(
+                manage._handle, host="127.0.0.1", port=0
+            )
+            port = manage._server.sockets[0].getsockname()[1]
+            try:
+                return await coro(port)
+            finally:
+                manage._server.close()
+                await manage._server.wait_closed()
+
+        return asyncio.run(main())
+
+    def test_profile_folded_nonempty_under_load(self, profiled_server):
+        ps = profiled_server
+        for _ in range(10):
+            ps["drive"]()
+            ps["prof"].flush()
+            if ps["prof"].status()["prof_samples"]:
+                break
+
+        async def check(port):
+            status, head, body = await _get(port, "/profile")
+            assert status == 200
+            assert "text/plain" in head
+            return body.decode()
+
+        folded = self._with_manage(ps, check)
+        assert folded.strip(), "folded /profile body empty under load"
+        for line in folded.strip().splitlines():
+            stack, count = line.rsplit(" ", 1)
+            assert int(count) >= 1 and stack
+
+    def test_profile_chrome_shares_timeline_with_trace(self, profiled_server):
+        """The acceptance criterion: /profile?fmt=chrome samples for a
+        traced op land inside that op's /trace span window, on the same
+        CLOCK_MONOTONIC timeline."""
+        ps = profiled_server
+        tagged = []
+        for _ in range(20):
+            ps["drive"]()
+            ps["prof"].flush()
+            tagged = [
+                s for s in ps["prof"].recent_samples() if s["trace_id"]
+            ]
+            if tagged:
+                break
+        assert tagged, "no sample carried a trace id under traced load"
+
+        async def check(port):
+            s1, _, body1 = await _get(port, "/profile?fmt=chrome")
+            s2, _, body2 = await _get(port, "/trace")
+            assert s1 == 200 and s2 == 200
+            return json.loads(body1), json.loads(body2)
+
+        chrome, trace = self._with_manage(ps, check)
+        events = chrome["traceEvents"]
+        assert all("ph" in e and "ts" in e and "pid" in e for e in events)
+        samples = [e for e in events if e.get("cat") == "sample"]
+        assert samples
+        spans = {s["trace_id"]: s for s in trace["spans"]
+                 if s["name"] == "batched_get"}
+        aligned = 0
+        for e in samples:
+            tid = int(e["args"]["trace_id"], 16)
+            span = spans.get(tid)
+            if span is None:
+                continue
+            assert span["start_us"] <= e["ts"] <= span["end_us"], (
+                "sample outside its op's span window"
+            )
+            aligned += 1
+        assert aligned >= 1, "no sample joined a recorded span's timeline"
+
+    def test_profile_save_and_diff(self, profiled_server):
+        ps = profiled_server
+        ps["drive"]()
+
+        async def check(port):
+            s, _, body = await _get(port, "/profile?save=base")
+            assert s == 200
+            saved = json.loads(body)
+            assert saved["saved"]["name"] == "base"
+            await asyncio.to_thread(ps["drive"])
+            ps["prof"].flush()
+            s, _, body = await _get(port, "/profile?diff=base")
+            assert s == 200
+            diff = json.loads(body)
+            assert diff["base"] == "base"
+            assert diff["samples"] >= diff["base_samples"]
+            assert "folded_delta" in diff
+            s, _, body = await _get(port, "/profile?diff=nope")
+            assert s == 404
+            assert "snapshots" in json.loads(body)
+
+        self._with_manage(ps, check)
+
+    def test_profile_disabled_reports_off(self, server):
+        old = profiling._profiler
+        profiling._profiler = None
+        try:
+            async def check(port):
+                s, _, body = await _get(port, "/profile")
+                doc = json.loads(body)
+                assert s == 200 and doc["enabled"] is False
+
+            self._with_manage(
+                {"config": server["config"], "hist": None}, check
+            )
+        finally:
+            profiling._profiler = old
+
+    def test_timeseries_index_points_and_errors(self, profiled_server):
+        ps = profiled_server
+        ps["hist"].sample_once()
+        ps["hist"].sample_once()
+
+        async def check(port):
+            s, _, body = await _get(port, "/timeseries")
+            index = json.loads(body)
+            assert s == 200 and index["enabled"]
+            assert "probe_metric" in index["series"]
+            assert index["timeseries_samples"] >= 2
+            metric = urllib.parse.quote("probe_metric")
+            s, _, body = await _get(
+                port, f"/timeseries?metric={metric}&window=3600"
+            )
+            doc = json.loads(body)
+            assert s == 200 and len(doc["points"]) == 2
+            assert all(len(p) == 2 for p in doc["points"])
+            s, _, _ = await _get(port, "/timeseries?metric=unknown")
+            assert s == 404
+            s, _, _ = await _get(
+                port, f"/timeseries?metric={metric}&window=zzz"
+            )
+            assert s == 400
+            # Non-finite windows parse as floats but would poison the
+            # horizon compare and serialize as bare NaN (invalid JSON).
+            s, _, _ = await _get(
+                port, f"/timeseries?metric={metric}&window=nan"
+            )
+            assert s == 400
+            # Batch form (repeated params — the tools.top frame fetch):
+            # one response, unknown names omitted rather than 404.
+            s, _, body = await _get(
+                port, f"/timeseries?metric={metric}&metric=unknown&window=60"
+            )
+            doc = json.loads(body)
+            assert s == 200 and list(doc["metrics"]) == ["probe_metric"]
+            assert len(doc["metrics"]["probe_metric"]) == 2
+
+        self._with_manage(ps, check)
+
+    def test_metrics_exports_prof_and_timeseries_families(
+            self, profiled_server):
+        ps = profiled_server
+        ps["drive"]()
+        ps["hist"].sample_once()
+
+        async def check(port):
+            s, _, body = await _get(port, "/metrics")
+            assert s == 200
+            return body.decode()
+
+        text = self._with_manage(ps, check)
+        assert "infinistore_prof_samples " in text
+        assert "infinistore_prof_tick_us " in text
+        assert 'infinistore_prof_loop_us{phase="wait"}' in text
+        assert "infinistore_prof_loop_passes " in text
+        assert "infinistore_timeseries_series " in text
+        assert "infinistore_timeseries_anomalies " in text
+
+
+# ---------------------------------------------------------------------------
+# tools.top sparkline rendering, both modes.
+# ---------------------------------------------------------------------------
+
+
+class TestTopSparklines:
+    def _frame(self):
+        return {
+            "t": "00:00:00", "base": "x", "error": None,
+            "slo": {"verdict": "ok"},
+            "events": {"events": [], "emitted": 0},
+            "metrics": {}, "membership": {},
+            "trends": {
+                'infinistore_op_p99_latency_us{op="G"}':
+                    [1.0, 2.0, 8.0, 4.0, 2.0],
+            },
+        }
+
+    def test_unicode_mode_renders_blocks(self):
+        from tools.top import render
+
+        lines = render(self._frame(), ascii_only=False)
+        assert any("TRENDS" in line for line in lines)
+        assert any(any(c in line for c in "▁▂▃▄▅▆▇█") for line in lines)
+
+    def test_ascii_mode_is_pure_ascii(self):
+        from tools.top import render
+
+        lines = render(self._frame(), ascii_only=True)
+        assert any("TRENDS" in line for line in lines)
+        assert all(ord(c) < 128 for line in lines for c in line)
+        trend = next(line for line in lines if "p99" in line)
+        assert any(c in trend for c in "._-=+*#@")
+
+    def test_sparkline_edge_cases(self):
+        from tools.top import sparkline
+
+        assert sparkline([], width=8, ascii_only=True) == " " * 8
+        flat = sparkline([5.0] * 4, width=8, ascii_only=True)
+        assert len(flat) == 8 and flat.strip()
+        ramp = sparkline([1.0, 2.0, 3.0], width=3, ascii_only=False)
+        assert ramp[0] != ramp[2]

@@ -1109,6 +1109,119 @@ class TestCountersTiering:
 
 
 # ---------------------------------------------------------------------------
+# counters ITS-C008: continuous-profiling / metrics-history lockstep
+# ---------------------------------------------------------------------------
+
+C008_PROFILING = '''\
+class SamplingProfiler:
+    def status(self):
+        return {"prof_samples": 0, "prof_tagged_samples": 0, "prof_hz": 101.0}
+'''
+
+C008_TELEMETRY = '''\
+class MetricsHistory:
+    def status(self):
+        return {"timeseries_series": 0, "timeseries_anomalies": 0}
+'''
+
+C008_MANAGE_OK = '''\
+def _prof_prometheus_lines(ps):
+    return [
+        f"a {ps['prof_samples']}",
+        f"b {ps['prof_tagged_samples']}",
+        f"c {ps['prof_hz']}",
+    ]
+
+
+def _timeseries_prometheus_lines(ts):
+    return [
+        f"a {ts['timeseries_series']}",
+        f"b {ts['timeseries_anomalies']}",
+    ]
+
+routes = ("/profile", "/timeseries")   # profiling + history surfaces
+'''
+
+C008_DOCS = (
+    "| prof_samples | prof_tagged_samples | prof_hz | "
+    "timeseries_series | timeseries_anomalies |\n"
+)
+
+
+class TestCountersProfiling:
+    def scan(self, tmp_path, manage_src=C008_MANAGE_OK,
+             profiling_src=C008_PROFILING, telemetry_src=C008_TELEMETRY,
+             docs=C008_DOCS):
+        ctx = make_tree(tmp_path, {
+            "manage.py": manage_src,
+            "profiling.py": profiling_src,
+            "telemetry.py": telemetry_src,
+            "docs/observability.md": docs,
+        })
+        return counters._scan_profiling(
+            ctx, "manage.py", profiling_rel="profiling.py",
+            telemetry_rel="telemetry.py", docs_rel="docs/observability.md",
+        )
+
+    def test_complete_vocabulary_is_clean(self, tmp_path):
+        assert self.scan(tmp_path) == []
+
+    def test_unexported_prof_key_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace(
+            "        f\"b {ps['prof_tagged_samples']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.rule == "ITS-C008"
+            and f.key.endswith("prof:prof_tagged_samples")
+            for f in found
+        )
+
+    def test_stale_prof_exporter_key_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace("prof_tagged_samples", "prof_gone")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("prof-stale:prof_gone") for k in keys)
+        assert any(k.endswith("prof:prof_tagged_samples") for k in keys)
+
+    def test_unexported_timeseries_key_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace(
+            "        f\"b {ts['timeseries_anomalies']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.key.endswith("timeseries:timeseries_anomalies") for f in found
+        )
+
+    def test_stale_timeseries_exporter_key_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace("timeseries_anomalies",
+                                        "timeseries_gone")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("timeseries-stale:timeseries_gone")
+                   for k in keys)
+
+    def test_undocumented_keys_fire(self, tmp_path):
+        docs = C008_DOCS.replace("prof_hz", "").replace(
+            "timeseries_series", "")
+        keys = {f.key for f in self.scan(tmp_path, docs=docs)}
+        assert any(k.endswith("undocumented:prof_hz") for k in keys)
+        assert any(k.endswith("undocumented:timeseries_series") for k in keys)
+
+    def test_missing_profile_route_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace('"/profile"', '"/nope"')
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("profile-route") for f in found)
+
+    def test_missing_timeseries_route_fires(self, tmp_path):
+        manage = C008_MANAGE_OK.replace('"/timeseries"', '"/nope"').replace(
+            "history", "nothing")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("timeseries-route") for f in found)
+
+    def test_real_profiling_vocabulary_is_clean(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in counters.scan(ctx) if f.rule == "ITS-C008"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
 # trace_stages (ITS-T*)
 # ---------------------------------------------------------------------------
 

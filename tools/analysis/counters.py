@@ -44,6 +44,15 @@ and cross-checks them:
   docs/tiering.md — and the exporter must not consume keys the snapshot
   no longer emits; the manage plane must keep serving ``GET /tiers``
   from the TierManager status.
+- ITS-C008 continuous-profiling / metrics-history vocabulary drift
+  (docs/observability.md): every ``prof_*`` key of
+  ``profiling.SamplingProfiler.status`` must be consumed by the /metrics
+  profiler exporter (``server.py _prof_prometheus_lines``) and every
+  ``timeseries_*`` key of ``telemetry.MetricsHistory.status`` by the
+  /metrics history exporter (``_timeseries_prometheus_lines``), both
+  directions, and both vocabularies documented; the manage plane must
+  keep serving ``GET /profile`` from the process profiler and ``GET
+  /timeseries`` from the metrics history.
 
 Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
 both sides.
@@ -79,6 +88,8 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/telemetry.py", "GossipAgent.status"),
     ("infinistore_tpu/tiering.py", "TierManager.__init__"),
     ("infinistore_tpu/tiering.py", "TierManager.status"),
+    ("infinistore_tpu/profiling.py", "SamplingProfiler.status"),
+    ("infinistore_tpu/telemetry.py", "MetricsHistory.status"),
 ]
 
 # The elastic-membership status snapshot (ITS-C005): the dict-literal
@@ -116,6 +127,17 @@ TIERING_REL = "infinistore_tpu/tiering.py"
 TIERING_LEDGERS = ["TierManager.__init__", "TierManager.status"]
 TIER_EXPORT_FN = "_tier_prometheus_lines"
 TIERING_DOCS_REL = "docs/tiering.md"
+
+# The continuous-profiling + metrics-history plane (ITS-C008,
+# docs/observability.md): the sampling profiler's ``prof_*`` and the
+# metrics history's ``timeseries_*`` status vocabularies must reach their
+# /metrics exporters both ways, be documented, and keep the ``/profile``
+# and ``/timeseries`` manage routes.
+PROFILING_REL = "infinistore_tpu/profiling.py"
+PROFILING_LEDGERS = ["SamplingProfiler.status"]
+PROF_EXPORT_FN = "_prof_prometheus_lines"
+TIMESERIES_LEDGERS = ["MetricsHistory.status"]
+TIMESERIES_EXPORT_FN = "_timeseries_prometheus_lines"
 
 # Trace-surface exporters (docs/observability.md): the /trace payload
 # builder consumes the native ring's counters from the stats snapshot, and
@@ -433,6 +455,105 @@ def scan(
     findings += _scan_membership(ctx, manage_rel, MEMBERSHIP_REL)
     findings += _scan_telemetry(ctx, manage_rel)
     findings += _scan_tiering(ctx, manage_rel)
+    findings += _scan_profiling(ctx, manage_rel)
+    return findings
+
+
+def _scan_profiling(
+    ctx: Context,
+    manage_rel: str = MANAGE_REL,
+    profiling_rel: str = PROFILING_REL,
+    telemetry_rel: str = TELEMETRY_REL,
+    docs_rel: str = TELEMETRY_DOCS_REL,
+) -> List[Finding]:
+    """ITS-C008: the continuous-profiling + metrics-history vocabulary in
+    lockstep — ``prof_*`` status keys vs the /metrics profiler exporter,
+    ``timeseries_*`` status keys vs the /metrics history exporter (both
+    directions each), the observability docs, and the ``/profile`` +
+    ``/timeseries`` manage routes (docs/observability.md)."""
+    findings: List[Finding] = []
+    if not ctx.exists(profiling_rel):
+        return findings
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+
+    def vocabulary(rel: str, ledgers: List[str], prefix: str):
+        keys: Set[str] = set()
+        line = 1
+        for dotted in ledgers:
+            got, ln = ledger_keys(ctx, rel, dotted)
+            keys |= {k.rsplit(".", 1)[-1] for k in got}
+            line = ln or line
+        return {k for k in keys if k.startswith(prefix)}, line
+
+    def lockstep(keys: Set[str], line: int, rel: str, export_fn: str,
+                 prefix: str, tag: str):
+        consumed = {
+            k for k in metrics_consumed_keys(ctx, manage_rel,
+                                             fn_name=export_fn)
+            if k.startswith(prefix)
+        }
+        for key in sorted(keys - consumed):
+            findings.append(Finding(
+                rule="ITS-C008", file=manage_rel, line=1,
+                message=f"{tag} status key {key!r} is not exported by the "
+                        f"/metrics exporter ({export_fn}) — profiling "
+                        "coverage dashboards cannot see is observability "
+                        "drift (docs/observability.md)",
+                key=f"ITS-C008:{manage_rel}:{tag}:{key}",
+            ))
+        for key in sorted(consumed - keys):
+            findings.append(Finding(
+                rule="ITS-C008", file=manage_rel, line=1,
+                message=f"/metrics exporter {export_fn} consumes key "
+                        f"{key!r} which the {tag} status snapshot no "
+                        "longer emits (KeyError at scrape time)",
+                key=f"ITS-C008:{manage_rel}:{tag}-stale:{key}",
+            ))
+        for key in sorted(keys):
+            if key not in doc_words:
+                findings.append(Finding(
+                    rule="ITS-C008", file=rel, line=line,
+                    message=f"{tag} status key {key!r} is undocumented in "
+                            f"{docs_rel} — the {tag} vocabulary table must "
+                            "enumerate it",
+                    key=f"ITS-C008:{rel}:undocumented:{key}",
+                ))
+
+    prof_keys, prof_line = vocabulary(profiling_rel, PROFILING_LEDGERS,
+                                      "prof_")
+    lockstep(prof_keys, prof_line, profiling_rel, PROF_EXPORT_FN,
+             "prof_", "prof")
+    if ctx.exists(telemetry_rel):
+        ts_keys, ts_line = vocabulary(telemetry_rel, TIMESERIES_LEDGERS,
+                                      "timeseries_")
+        if ts_keys:
+            lockstep(ts_keys, ts_line, telemetry_rel, TIMESERIES_EXPORT_FN,
+                     "timeseries_", "timeseries")
+
+    manage_src = ctx.read(manage_rel)
+    if (
+        not re.search(r'[\'"]/profile[\'"]', manage_src)
+        or "profiling" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C008", file=manage_rel, line=1,
+            message="manage plane must serve GET /profile from the process "
+                    "sampling profiler — the frame-level attribution "
+                    "surface (docs/observability.md)",
+            key=f"ITS-C008:{manage_rel}:profile-route",
+        ))
+    if (
+        not re.search(r'[\'"]/timeseries[\'"]', manage_src)
+        or "history" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C008", file=manage_rel, line=1,
+            message="manage plane must serve GET /timeseries from the "
+                    "metrics history — the trend/sparkline surface "
+                    "(docs/observability.md)",
+            key=f"ITS-C008:{manage_rel}:timeseries-route",
+        ))
     return findings
 
 

@@ -63,10 +63,14 @@ SURFACE = [
     ]),
     ("infinistore_tpu.telemetry", [
         "EventJournal", "SloObjective", "SloEngine", "FleetScraper",
-        "GossipAgent",
+        "GossipAgent", "MetricsHistory",
         "default_objectives", "cluster_spans", "cluster_chrome_events",
         "get_journal", "emit", "slo_engine", "configure_slo",
-        "note_qos_aged",
+        "note_qos_aged", "metrics_http_source", "scraper_source",
+        "parse_metrics_text",
+    ]),
+    ("infinistore_tpu.profiling", [
+        "SamplingProfiler", "configure", "enabled", "profiler",
     ]),
     ("infinistore_tpu.vllm_v1", [
         "KVConnectorRole",

@@ -1,8 +1,17 @@
 #!/usr/bin/env python
-"""Experiment matrix for the loopback throughput inversion (VERDICT r2 weak #3).
+"""HISTORICAL: experiment matrix for the r2 loopback throughput inversion
+(VERDICT r2 weak #3). Varies data plane (shm segment vs plain registered
+memory), key count, and src/dst buffer reuse; prints GB/s per cell. Its
+finding (the second 64MB buffer pushing the run DRAM-bound) is recorded
+in bench.py's working-set note.
 
-Varies: data plane (shm segment vs plain registered memory), key count,
-and src/dst buffer reuse. Prints GB/s for each cell.
+Kept for re-running if platform memory behavior shifts; it reproduces the
+OLD pipeline shape. For profiling the CURRENT code paths use the
+continuous sampling profiler instead — ``INFINISTORE_TPU_PROFILE=1``,
+then ``GET /profile`` on the manage plane (folded flamegraph stacks with
+per-stage attribution, ``?fmt=chrome`` for a Perfetto sampling track on
+the ``/trace`` timeline, ``?diff=`` for differentials) — see
+docs/observability.md, profiling section.
 """
 import asyncio
 import time

@@ -21,7 +21,47 @@ import json
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
+
+# Sparkline ramps (8 levels). The unicode blocks need a UTF-8-capable
+# terminal; the ASCII ramp is the fallback when the encoding (or curses)
+# cannot carry them — same data, coarser glyphs.
+_SPARK_UTF8 = " ▁▂▃▄▅▆▇█"
+_SPARK_ASCII = " ._-=+*#@"
+
+# Series worth a sparkline column, in display priority order (prefix
+# match against the /timeseries index; bounded — a dashboard is not a
+# TSDB).
+_TREND_PREFIXES = (
+    "infinistore_op_p99_latency_us",
+    "infinistore_slo_burn_rate_max",
+    "infinistore_pool_usage_ratio",
+    "infinistore_qos_queued",
+    "member_ops_per_s",
+)
+_TREND_MAX_SERIES = 6
+_TREND_WINDOW_S = 120.0
+
+
+def sparkline(values, width: int = 24, ascii_only: bool = False) -> str:
+    """Render ``values`` (oldest first) as a fixed-width sparkline,
+    min-max normalized; a flat series renders at mid-level so presence
+    is still visible. Empty input -> all-blank bar."""
+    ramp = _SPARK_ASCII if ascii_only else _SPARK_UTF8
+    if not values:
+        return ramp[0] * width
+    tail = values[-width:]
+    lo, hi = min(tail), max(tail)
+    span = hi - lo
+    out = []
+    for v in tail:
+        if span <= 0:
+            idx = (len(ramp) - 1) // 2
+        else:
+            idx = 1 + int((v - lo) / span * (len(ramp) - 2))
+        out.append(ramp[min(idx, len(ramp) - 1)])
+    return "".join(out).rjust(width, ramp[0])
 
 
 def _get(base: str, path: str, timeout: float):
@@ -38,7 +78,9 @@ def _get(base: str, path: str, timeout: float):
 
 def _metric_families(text: str) -> dict:
     """Flat ``name{labels} -> value`` map from Prometheus exposition text
-    (exemplar suffixes, comments and TYPE lines skipped)."""
+    (exemplar suffixes, comments and TYPE lines skipped). Deliberate twin
+    of ``telemetry.parse_metrics_text`` — tools/ stays stdlib-only with
+    no package import; a format change must touch both."""
     out = {}
     if not isinstance(text, str):
         return out
@@ -58,6 +100,39 @@ def _metric_families(text: str) -> dict:
     return out
 
 
+def _trend_series(base: str, timeout: float) -> dict:
+    """``series name -> [values]`` for the sparkline rows, from the manage
+    plane's metrics history (``GET /timeseries``; empty when none is
+    attached). Bounded: prefix-selected, at most ``_TREND_MAX_SERIES``;
+    fetched as ONE batch request (repeated ``metric`` params) so a frame
+    costs two /timeseries round trips total, not one per series."""
+    index, _ = _get(base, "/timeseries", timeout)
+    if not isinstance(index, dict) or not index.get("enabled"):
+        return {}
+    picked = []
+    for prefix in _TREND_PREFIXES:
+        picked += [
+            n for n in index.get("series", []) if n.startswith(prefix)
+        ]
+    picked = picked[:_TREND_MAX_SERIES]
+    if not picked:
+        return {}
+    query = "&".join(
+        f"metric={urllib.parse.quote(name)}" for name in picked
+    )
+    doc, _ = _get(
+        base,
+        f"/timeseries?{query}&window={_TREND_WINDOW_S:g}",
+        timeout,
+    )
+    if not isinstance(doc, dict):
+        return {}
+    return {
+        name: [v for _, v in doc.get("metrics", {}).get(name, [])]
+        for name in picked
+    }
+
+
 def snapshot(base: str, timeout: float = 2.0) -> dict:
     """One dashboard frame's raw data."""
     slo, slo_err = _get(base, "/slo", timeout)
@@ -72,17 +147,26 @@ def snapshot(base: str, timeout: float = 2.0) -> dict:
         "events": events if isinstance(events, dict) else {},
         "metrics": _metric_families(metrics),
         "membership": membership if isinstance(membership, dict) else {},
+        "trends": _trend_series(base, timeout),
     }
 
 
-def render(frame: dict, width: int = 100) -> list:
+def render(frame: dict, width: int = 100, ascii_only=None) -> list:
     """Plain-text lines for one frame (shared by --plain/--once and the
-    curses loop)."""
+    curses loop). ``ascii_only=None`` auto-detects from the stdout
+    encoding: a terminal that cannot carry the unicode sparkline blocks
+    gets the ASCII ramp instead of mojibake."""
+    if ascii_only is None:
+        ascii_only = not (
+            (getattr(sys.stdout, "encoding", "") or "").lower()
+            .replace("-", "").startswith("utf")
+        )
     lines = []
     slo = frame["slo"]
     verdict = slo.get("verdict", "?")
+    sep = " | " if ascii_only else " · "
     lines.append(
-        f"infinistore top · {frame['base']} · {frame['t']} · "
+        f"infinistore top{sep}{frame['base']}{sep}{frame['t']}{sep}"
         f"verdict={verdict.upper()}"
     )
     if frame["error"]:
@@ -203,6 +287,18 @@ def render(frame: dict, width: int = 100) -> list:
                 f"descs/db={coalesce}  bad={bad:.0f} torn={torn:.0f}"
             )
 
+    # Metrics-history sparklines (docs/observability.md, time-series
+    # section): last-2-minutes trend per selected series, burn-rate
+    # included — the "when did it move" column the one-shot gauges above
+    # cannot show. Absent (no history attached) the section is omitted.
+    trends = frame.get("trends", {})
+    if trends:
+        lines.append(f"TRENDS (last {_TREND_WINDOW_S:.0f}s)")
+        for name, values in trends.items():
+            spark = sparkline(values, width=24, ascii_only=ascii_only)
+            last = f"{values[-1]:.6g}" if values else "-"
+            lines.append(f"  {name[:52]:<52} {spark} {last}"[:width])
+
     # Event journal tail.
     events = frame["events"].get("events", [])
     lines.append("-" * min(width, 100))
@@ -220,7 +316,7 @@ def render(frame: dict, width: int = 100) -> list:
     return lines
 
 
-def _curses_loop(base: str, interval: float):
+def _curses_loop(base: str, interval: float, ascii_only=None):
     import curses
 
     def loop(stdscr):
@@ -230,13 +326,16 @@ def _curses_loop(base: str, interval: float):
             frame = snapshot(base)
             stdscr.erase()
             h, w = stdscr.getmaxyx()
-            for i, line in enumerate(render(frame, width=w - 1)[: h - 1]):
+            for i, line in enumerate(
+                render(frame, width=w - 1, ascii_only=ascii_only)[: h - 1]
+            ):
                 try:
                     stdscr.addstr(i, 0, line[: w - 1])
                 except curses.error:
                     pass
+            footer_sep = " | " if ascii_only else " · "
             stdscr.addstr(
-                h - 1, 0, "q to quit · refresh every "
+                h - 1, 0, f"q to quit{footer_sep}refresh every "
                 f"{interval:g}s"[: w - 1]
             )
             stdscr.refresh()
@@ -265,24 +364,37 @@ def main(argv=None) -> int:
                         help="print one plain-text frame and exit")
     parser.add_argument("--plain", action="store_true",
                         help="plain-text loop (no curses)")
+    parser.add_argument("--ascii", action="store_true",
+                        help="force the ASCII sparkline ramp (default: "
+                             "auto-detect from the stdout encoding)")
     args = parser.parse_args(argv)
+    ascii_only = True if args.ascii else None
 
     if args.once:
-        print("\n".join(render(snapshot(args.manage))))
+        print("\n".join(render(snapshot(args.manage), ascii_only=ascii_only)))
         return 0
     if args.plain or not sys.stdout.isatty():
         try:
             while True:
-                print("\n".join(render(snapshot(args.manage))), flush=True)
+                print(
+                    "\n".join(
+                        render(snapshot(args.manage), ascii_only=ascii_only)
+                    ),
+                    flush=True,
+                )
                 print()
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return 0
     try:
-        _curses_loop(args.manage, args.interval)
+        _curses_loop(args.manage, args.interval, ascii_only=ascii_only)
     except ImportError:
-        print("curses unavailable; falling back to --plain", file=sys.stderr)
-        return main([*(argv or sys.argv[1:]), "--plain"])
+        # No curses on this host: the plain loop renders the same frames
+        # — with the ASCII ramp, since a curses-less environment rarely
+        # guarantees a UTF-8-capable terminal either.
+        print("curses unavailable; falling back to --plain --ascii",
+              file=sys.stderr)
+        return main([*(argv or sys.argv[1:]), "--plain", "--ascii"])
     return 0
 
 
