@@ -111,15 +111,34 @@ def _loopback_throughput(its, np, conn) -> float:
         await conn.write_cache_async(pairs, BLOCK, buf.ctypes.data)
         await conn.read_cache_async(pairs, BLOCK, buf.ctypes.data)
 
+    async def pass_(iters):
+        # Depth-2 pipeline: keep one op queued behind the one in flight.
+        # The server runs one continuation per connection at a time (FIFO),
+        # so ops never interleave — the queued descriptor just eliminates
+        # the client-side turnaround gap (~0.4ms of submit bookkeeping per
+        # op) between back-to-back copies, which a throughput number should
+        # not bill to the transport.
+        pending = []
+        for _ in range(iters):
+            for op in (conn.write_cache_async, conn.read_cache_async):
+                pending.append(
+                    asyncio.ensure_future(op(pairs, BLOCK, buf.ctypes.data))
+                )
+                if len(pending) >= 2:
+                    await pending.pop(0)
+        for f in pending:
+            await f
+
     asyncio.run(once())  # warmup
     # Best-of-3 passes of 5 iterations each: the box shares one core with
-    # everything else, so min-wall-clock is the least noisy estimator.
+    # everything else, so min-wall-clock is the least noisy estimator. One
+    # event loop per PASS, not per iteration — asyncio.run() setup/teardown
+    # costs ~0.7ms on this host and was being billed to the transport.
     iters = 5
     best_dt = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            asyncio.run(once())
+        asyncio.run(pass_(iters))
         best_dt = min(best_dt, time.perf_counter() - t0)
     moved = 2 * N_KEYS * BLOCK * iters  # write + read
     return moved / best_dt / (1 << 30)
@@ -313,7 +332,38 @@ def _ring_vs_socket(its, np, port: int) -> dict:
     speedup = estimate()
 
     moved = 2 * n_keys * block * reps
+
+    # Batch-window phase: K concurrent small ops per event-loop tick — the
+    # FetchCoalescer flush shape — on the ring connection. The whole tick
+    # must coalesce into ONE multi-op batch slot (K ops, 1 descriptor), and
+    # every op must be accounted: posted on the ring or a COUNTED fallback
+    # (the ring_batch gate in tools/bench_check.py pins both).
+    k_ops = 16
+    batch_rounds = 8
+    bconn, bbuf = conns[True], bufs[True]
+    base = bconn.ring_stats()
+
+    async def batch_flush():
+        bconn.ring_batch_window()
+        await asyncio.gather(*[
+            bconn.write_cache_async([(f"abb-{i}", i * block)], block,
+                                    bbuf.ctypes.data)
+            for i in range(k_ops)
+        ])
+
+    for _ in range(batch_rounds):
+        asyncio.run(batch_flush())
+
     rs = conns[True].ring_stats()
+    cs = conns[True].completion_stats()
+    srv_ring = conns[True].get_stats().get("ring", {})
+    d_slots = rs["ring_batch_slots"] - base["ring_batch_slots"]
+    d_bops = rs["ring_batch_ops"] - base["ring_batch_ops"]
+    d_posted = rs["ring_posted"] - base["ring_posted"]
+    d_falls = (
+        rs["ring_full_fallbacks"] - base["ring_full_fallbacks"]
+        + rs["ring_meta_fallbacks"] - base["ring_meta_fallbacks"]
+    )
     off = conns[False].ring_stats()
     assert off["ring_posted"] == 0, "socket-config connection posted to a ring"
     for c in conns.values():
@@ -331,6 +381,23 @@ def _ring_vs_socket(its, np, port: int) -> dict:
         "ring_full_fallbacks": rs["ring_full_fallbacks"],
         "ring_meta_fallbacks": rs["ring_meta_fallbacks"],
         "ring_doorbell_ratio": round(rs["ring_doorbell_ratio"], 2),
+        # Batch-window phase receipts (deltas over that phase alone).
+        "ring_batch_slots": d_slots,
+        "ring_batch_ops": d_bops,
+        "ring_batch_ops_per_slot": round(d_bops / d_slots, 2) if d_slots else 0.0,
+        # Ops neither posted nor counted as a fallback would be silent
+        # drops — must be zero.
+        "ring_batch_uncounted": k_ops * batch_rounds - d_posted - d_falls,
+        # Adaptive poll-then-park across all three layers (client reactor,
+        # asyncio bridge, server loop): hits found completions inside the
+        # busy-poll budget, arms fell through to eventfd/epoll parking.
+        "ring_poll_hits": rs["ring_poll_hits"],
+        "ring_poll_arms": rs["ring_poll_arms"],
+        "ring_bridge_poll_hits": cs["bridge_poll_hits"],
+        "ring_bridge_poll_arms": cs["bridge_poll_arms"],
+        "ring_srv_poll_hits": srv_ring.get("poll_hits", 0),
+        "ring_srv_poll_arms": srv_ring.get("poll_arms", 0),
+        "ring_doorbell_elided": srv_ring.get("doorbell_elided", 0),
     }
 
 
@@ -1120,7 +1187,8 @@ def _profiling_metrics(its, np, srv) -> dict:
     nprof = conn.get_stats().get("prof", {})
     phase_total = sum(
         nprof.get(k, 0)
-        for k in ("wait_us", "events_us", "rings_us", "slices_us", "other_us")
+        for k in ("wait_us", "events_us", "rings_us", "slices_us", "poll_us",
+                  "other_us")
     ) or 1
 
     # Timeseries anomaly A/B through the real detector + journal.
@@ -1173,6 +1241,9 @@ def _profiling_metrics(its, np, srv) -> dict:
         ),
         "prof_loop_slices_frac": round(
             nprof.get("slices_us", 0) / phase_total, 4
+        ),
+        "prof_loop_poll_frac": round(
+            nprof.get("poll_us", 0) / phase_total, 4
         ),
         "prof_loop_other_frac": round(
             nprof.get("other_us", 0) / phase_total, 4
@@ -3115,7 +3186,7 @@ def main(argv=None) -> int:
         # headline leg above already rides the ring (enable_ring defaults
         # on); ring_ceiling_fraction restates its value against the SAME
         # round's memcpy ceiling under the key the ROADMAP-2 target gates
-        # on (>= 0.75 in tools/bench_check.py). ring_vs_socket_* is the A/B
+        # on (>= 0.90 in tools/bench_check.py). ring_vs_socket_* is the A/B
         # leg: order-alternating paired interleaved sampling,
         # min(median-of-ratios, ratio-of-sums) — the ring must never lose
         # to the socket path it replaces.

@@ -119,6 +119,16 @@ lib.its_conn_ring_counters.argtypes = [
     c_void_p, POINTER(c_uint64), POINTER(c_uint64), POINTER(c_uint64),
     POINTER(c_uint64), POINTER(c_uint64),
 ]
+# PR 16 mechanism ledger: batch slots, batch ops, reactor poll hits, poll
+# arms (its_conn_ring_counters keeps its 5-value shape for stability).
+lib.its_conn_ring_poll_counters.argtypes = [
+    c_void_p, POINTER(c_uint64), POINTER(c_uint64), POINTER(c_uint64),
+    POINTER(c_uint64),
+]
+# Multi-op batch grouping: bracket one event-loop tick's ring posts so a
+# coalesced flush publishes as one batch slot (docs/descriptor_ring.md).
+lib.its_conn_ring_group_begin.argtypes = [c_void_p]
+lib.its_conn_ring_group_end.argtypes = [c_void_p]
 lib.its_conn_close.argtypes = [c_void_p]
 lib.its_conn_destroy.argtypes = [c_void_p]
 lib.its_conn_connected.argtypes = [c_void_p]

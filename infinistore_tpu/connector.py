@@ -169,6 +169,7 @@ class FetchCoalescer:
         self.calls = 0  # batched store calls issued
         self.submissions = 0  # logical submits merged into them
         self.max_batch = 0
+        self.ring_windows = 0  # flushes that opened a ring batch window
 
     def submit(self, blocks, priority: int = 0) -> "asyncio.Future":
         """Queue one logical read (list of (key, offset-from-base) pairs);
@@ -222,6 +223,16 @@ class FetchCoalescer:
         self._flush_scheduled = False
         if not batch:
             return
+        # Eagerly open this tick's ring batch window (no-op off-ring or on
+        # a pre-ring connection stand-in): the gathered merged calls — and
+        # any per-stripe grandchild tasks a StripedConnection spawns before
+        # the window's call_soon flush runs — then publish their ring posts
+        # as ONE multi-op batch slot instead of one slot + doorbell each
+        # (docs/descriptor_ring.md, batch-slot section).
+        window = getattr(self.conn, "ring_batch_window", None)
+        if callable(window):
+            window()
+            self.ring_windows += 1
         await asyncio.gather(*(self._issue(g, p) for p, g in self._group(batch)))
 
     async def _issue(self, batch, priority: int = 0):

@@ -165,6 +165,31 @@ _HAS_EVENTFD = hasattr(os, "eventfd")
 _DRAIN_CAP = 256
 _NULL_CB = ctypes.cast(None, COMPLETION_CB)  # ring-mode submits pass no callback
 
+# Adaptive bridge poll budget (seconds) — the Python twin of the native
+# kRingPoll* constants (native/include/its/ring.h): a ring-mode waiter spins
+# draining the completion ring for min(2 x gap-EWMA, cap) before parking on
+# the eventfd; an EWMA beyond the cap means completions are slow enough that
+# the wakeup latency is noise, so park immediately (budget 0) and burn no CPU.
+_POLL_CAP_S = 200e-6
+_POLL_MIN_S = 5e-6
+_POLL_DEFAULT_S = 50e-6
+
+# Distinct (keys, offsets) layouts kept per connection by the descriptor
+# marshalling cache (_marshal_batch) — a handful covers the steady-state
+# reuse pattern (same block table resubmitted op after op) while bounding
+# memory to ~tens of KB per layout.
+_MARSHAL_CACHE_CAP = 8
+
+
+def _poll_budget_s(ewma_gap_s: float) -> float:
+    """min(2 x EWMA, cap), clamped up to the floor; default with no samples;
+    0 (park immediately) when the EWMA says completions arrive slowly."""
+    if ewma_gap_s == 0.0:
+        return _POLL_DEFAULT_S
+    if ewma_gap_s > _POLL_CAP_S:
+        return 0.0
+    return min(max(2.0 * ewma_gap_s, _POLL_MIN_S), _POLL_CAP_S)
+
 # ---------------------------------------------------------------------------
 # Process-wide QoS foreground gate. On a shared host every byte of a
 # BACKGROUND op costs CPU (its submitter's Python/asyncio work, its reactor
@@ -424,6 +449,24 @@ class InfinityConnection:
         # the matching push/signal counters — completion_stats()).
         self._drain_wakeups = 0
         self._drain_completed = 0
+        # Per-tick ring batch window (docs/descriptor_ring.md): the first
+        # ring-mode async submit of an event-loop iteration opens a native
+        # post group and schedules _group_flush via call_soon — asyncio's
+        # _run_once snapshots its ready queue at iteration start, so the
+        # flush is guaranteed to run AFTER every same-tick submit, turning
+        # a FetchCoalescer flush's K ops into one multi-op batch slot.
+        self._group_open = False
+        self._batch_windows = 0  # ring_batch_window() calls (eager opens)
+        # Adaptive bridge poll (the Python twin of the reactor's
+        # poll-then-park): EWMA of inter-completion gaps decides how long a
+        # ring-mode waiter spins draining the completion ring before falling
+        # back to the eventfd wakeup. Loop-thread-only state, like the
+        # native reactor's unguarded ring_gap_ewma_us_.
+        self._comp_gap_ewma = 0.0
+        self._comp_last_ts = 0.0
+        self._bridge_poll_hits = 0  # poll window caught the completion
+        self._bridge_poll_arms = 0  # budget expired (or 0) -> eventfd park
+        self._bridge_poll_drained = 0  # completions dispatched by poll drains
         # Called after a successful reconnect() — e.g. a StripedConnection
         # invalidating sibling stripes' aliases of this connection's shm
         # segments (which the reconnect just unmapped).
@@ -431,6 +474,18 @@ class InfinityConnection:
         # get_match_last_index encode cache (chains are append-only). One
         # tuple, swapped atomically — sync ops run from concurrent threads.
         self._match_cache: Tuple[list, bytes] = ([], b"")
+        # Batched-op descriptor marshalling cache (_marshal_batch): steady-
+        # state KV traffic (paged-attention block reuse, save/restore loops)
+        # resubmits the SAME (keys, offsets) layout op after op, and
+        # re-deriving the keys blob + ctypes offset array burns ~0.3ms of
+        # client CPU per 1000-key batch — CPU that, on a shared or single
+        # core, is stolen from the server's copy slices mid-op. Keyed by the
+        # value-hashable (keys, offsets) tuple pair (CPython caches str
+        # hashes, so a warm probe is tens of microseconds); bounded FIFO.
+        # Entries are immutable and dict ops are GIL-atomic, so a race
+        # between sync-op threads costs a redundant encode, never a wrong
+        # blob.
+        self._marshal_cache: dict = {}
         # Per-class batched-op counters [foreground, background] — the
         # client half of the QoS ledger (qos_stats()); the server half is
         # get_stats()["qos"]. _bg_deferred/_bg_aged count this connection's
@@ -547,6 +602,7 @@ class InfinityConnection:
                 leftovers += self._drain_ring_locked(self._handle)
                 lib.its_conn_destroy(self._handle)
                 self._handle = None
+                self._group_open = False  # pending _group_flush no-ops on None
                 self._shm_bufs.clear()  # views die once the segment unmaps
                 self._plain_mrs.clear()
                 self._segment_aliases.clear()
@@ -621,6 +677,10 @@ class InfinityConnection:
             # Swap: from here every new op uses the fresh connection.
             old = self._handle
             self._handle = new_handle
+            # A tick group open on the old handle died with it (its close
+            # failed the captured ops); don't leave the window marked open
+            # or the new handle would never batch again.
+            self._group_open = False
             self._dead_shm_ranges += [
                 (b.ctypes.data, b.nbytes) for b in self._shm_bufs
             ] + list(self._segment_aliases)
@@ -790,6 +850,17 @@ class InfinityConnection:
         call_soon_threadsafe each (rare: cross-loop/teardown cases only)."""
         if not pairs:
             return
+        # Inter-completion gap EWMA (alpha = 1/8, the reactor's constant)
+        # feeding _poll_budget_s. Loop-thread-only state; a rare foreign-loop
+        # dispatch writing it too just perturbs the heuristic, not safety.
+        now = time.monotonic()
+        if self._comp_last_ts:
+            gap = now - self._comp_last_ts
+            self._comp_gap_ewma = (
+                gap if self._comp_gap_ewma == 0.0
+                else (self._comp_gap_ewma * 7.0 + gap) / 8.0
+            )
+        self._comp_last_ts = now
         try:
             current = asyncio.get_running_loop()
         except RuntimeError:
@@ -834,6 +905,82 @@ class InfinityConnection:
             self._dispatch_completions(pairs)
             if n < _DRAIN_CAP:
                 return
+
+    def _group_join(self, loop):
+        """Join this event-loop iteration's ring post group, opening it on
+        the first call of the tick. The native side captures every
+        callback-free ring post made by this thread until _group_flush runs
+        — scheduled via call_soon, which asyncio's _run_once snapshot
+        semantics guarantee executes only after every callback already
+        ready this iteration (i.e. after every same-tick submit), so a
+        coalesced flush's K ops publish as one multi-op batch slot."""
+        if self._group_open or self._handle is None:
+            return
+        self._group_open = True
+        lib.its_conn_ring_group_begin(self._handle)
+        loop.call_soon(self._group_flush)
+
+    def _group_flush(self):
+        """End of the tick's batch window: publish the captured posts as
+        batch slot(s) + at most one doorbell. Safe if the connection died
+        mid-tick — the native close already failed the captured ops."""
+        self._group_open = False
+        if self._handle is not None:
+            lib.its_conn_ring_group_end(self._handle)
+
+    def ring_batch_window(self):
+        """Eagerly open this event-loop tick's ring batch window (no-op
+        without a running loop or the ring plane). Submit-side coalescers
+        (connector.FetchCoalescer) call this before fanning a flush out
+        into per-op tasks: the window is then already open when those tasks
+        submit — even grandchild tasks a StripedConnection spawns — so the
+        whole flush rides one batch slot (docs/descriptor_ring.md)."""
+        if self._efd is None or self._handle is None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        self._batch_windows += 1
+        self._group_join(loop)
+
+    async def _ring_await(self, future):
+        """Adaptive poll-then-park for a ring-mode completion: spin draining
+        the native completion ring for a budget calibrated from the
+        inter-completion gap EWMA (min(2 x EWMA, 200us) — 0 when gaps are
+        long, so slow traffic parks immediately), yielding the GIL and the
+        core each iteration; only when the budget expires fall back to the
+        eventfd -> add_reader wakeup chain and its scheduler latency."""
+        budget = _poll_budget_s(self._comp_gap_ewma)
+        if budget > 0.0 and not future.done():
+            deadline = time.monotonic() + budget
+            while True:
+                with self._lock:
+                    if self._handle is None:
+                        break
+                    n = lib.its_conn_drain_completions(
+                        self._handle, self._drain_tokens, self._drain_codes,
+                        _DRAIN_CAP,
+                    )
+                    pairs = [
+                        (self._drain_tokens[i], self._drain_codes[i])
+                        for i in range(n)
+                    ]
+                if n:
+                    self._bridge_poll_drained += n
+                    self._dispatch_completions(pairs)
+                if future.done():
+                    self._bridge_poll_hits += 1
+                    return await future
+                if time.monotonic() >= deadline:
+                    break
+                # Let same-tick siblings run (their flush may not have
+                # happened yet) and give the core to the native threads
+                # actually moving bytes — mandatory on shared cores.
+                await asyncio.sleep(0)
+                os.sched_yield()
+        self._bridge_poll_arms += 1
+        return await future
 
     def _bg_subbatches(self, blocks, block_size: int):
         """Split a BACKGROUND batch into bounded sub-batches: half the
@@ -886,14 +1033,34 @@ class InfinityConnection:
         finally:
             _fg_gate_exit()
 
+    def _marshal_batch(self, blocks):
+        """(keys, keys_blob, offsets_array) for a batched op, memoized on
+        the layout value (see _marshal_cache). The native submit copies
+        both buffers into its own request/slot storage before returning —
+        the pre-cache code already freed them while ops were in flight —
+        so sharing one immutable entry across submits is safe."""
+        keys, offsets = zip(*blocks)
+        ent = self._marshal_cache.get((keys, offsets))
+        if ent is None:
+            if len(self._marshal_cache) >= _MARSHAL_CACHE_CAP:
+                try:
+                    self._marshal_cache.pop(
+                        next(iter(self._marshal_cache)), None)
+                except (StopIteration, RuntimeError):
+                    pass  # concurrent sync-op thread beat us to the evict
+            ent = (
+                wire.encode_keys_blob(keys),
+                (ctypes.c_uint64 * len(offsets))(*offsets),
+            )
+            self._marshal_cache[(keys, offsets)] = ent
+        return keys, ent[0], ent[1]
+
     async def _batch_op_once(
         self, native_fn, blocks, block_size: int, ptr: int, op_name: str, priority: int
     ):
         self._require()
-        keys, offsets = zip(*blocks)
-        keys_blob = wire.encode_keys_blob(list(keys))
+        keys, keys_blob, offs = self._marshal_batch(blocks)
         n = len(keys)
-        offs = (ctypes.c_uint64 * n)(*offsets)
 
         loop = asyncio.get_running_loop()
         sem = self._semaphore(loop)
@@ -937,6 +1104,9 @@ class InfinityConnection:
         use_ring = self._efd is not None
         if use_ring:
             self._ensure_reader(loop)
+            # Join the tick's batch window: every ring post until the
+            # call_soon'd flush publishes in one multi-op batch slot.
+            self._group_join(loop)
         _completions[token] = (loop, future, on_done)
         rc = native_fn(
             self._handle,
@@ -959,6 +1129,8 @@ class InfinityConnection:
                 f"{op_name}: submit failed (not connected, or base pointer "
                 "not inside a registered region — call register_mr first)"
             )
+        if use_ring:
+            return await self._ring_await(future)
         return await future
 
     async def rdma_write_cache_async(
@@ -1038,10 +1210,8 @@ class InfinityConnection:
         self, native_fn, blocks, block_size: int, ptr: int, op_name: str, priority: int
     ):
         self._require()
-        keys, offsets = zip(*blocks)
-        keys_blob = wire.encode_keys_blob(list(keys))
+        keys, keys_blob, offs = self._marshal_batch(blocks)
         n = len(keys)
-        offs = (ctypes.c_uint64 * n)(*offsets)
         # Sync path trace stamps: submit before the blocking native call,
         # completion_ring right after it returns (the calling thread IS the
         # completion wait — there is no ring drain to stamp separately).
@@ -1230,7 +1400,14 @@ class InfinityConnection:
         on it), and the loop-side ``loop_wakeups``/``loop_drained`` drain
         counts. ``completion_batch_size`` = completions / signals: 1.0
         means every op paid its own wakeup; higher means pipelined ops
-        shared them (the bench's ``completion_batch_size`` key)."""
+        shared them (the bench's ``completion_batch_size`` key).
+
+        The adaptive bridge poll adds ``bridge_poll_hits`` /
+        ``bridge_poll_arms`` — ring-mode waits resolved inside the
+        calibrated pre-park poll window vs parked on the eventfd — and
+        ``bridge_poll_drained``, completions those poll windows drained
+        (they skip the wakeup chain entirely; docs/descriptor_ring.md,
+        poll-then-park section)."""
         pushed = ctypes.c_uint64()
         signalled = ctypes.c_uint64()
         with self._lock:
@@ -1247,6 +1424,12 @@ class InfinityConnection:
             "completion_batch_size": (
                 pushed.value / signalled.value if signalled.value else 0.0
             ),
+            # Adaptive bridge poll (_ring_await): waits resolved inside the
+            # poll window vs parked on the eventfd, and completions the poll
+            # drains dispatched (those never pay the wakeup chain at all).
+            "bridge_poll_hits": self._bridge_poll_hits,
+            "bridge_poll_arms": self._bridge_poll_arms,
+            "bridge_poll_drained": self._bridge_poll_drained,
         }
 
     def ring_stats(self) -> dict:
@@ -1260,18 +1443,35 @@ class InfinityConnection:
         ``ring_meta_fallbacks`` ops that rode the socket path instead
         (ring-full backpressure / descriptor body over the slot stride —
         counted, never an error), and ``ring_completions`` consumed from
-        the completion ring."""
+        the completion ring.
+
+        PR 16 mechanism counters ride along: ``ring_batch_slots`` multi-op
+        batch slots published / ``ring_batch_ops`` ops they carried
+        (``ring_batch_ops_per_slot`` = ops / slots, the flush-coalescing
+        ratio — ops in plain slots count in neither), ``ring_poll_hits`` /
+        ``ring_poll_arms`` reactor pre-park CQ poll windows that caught a
+        completion vs expired into the epoll park, and
+        ``ring_batch_windows`` eager ring_batch_window() opens."""
         posted = ctypes.c_uint64()
         doorbells = ctypes.c_uint64()
         full = ctypes.c_uint64()
         meta = ctypes.c_uint64()
         completions = ctypes.c_uint64()
+        batch_slots = ctypes.c_uint64()
+        batch_ops = ctypes.c_uint64()
+        poll_hits = ctypes.c_uint64()
+        poll_arms = ctypes.c_uint64()
         with self._lock:
             if self._handle is not None:
                 lib.its_conn_ring_counters(
                     self._handle, ctypes.byref(posted), ctypes.byref(doorbells),
                     ctypes.byref(full), ctypes.byref(meta),
                     ctypes.byref(completions),
+                )
+                lib.its_conn_ring_poll_counters(
+                    self._handle, ctypes.byref(batch_slots),
+                    ctypes.byref(batch_ops), ctypes.byref(poll_hits),
+                    ctypes.byref(poll_arms),
                 )
         return {
             "ring_posted": posted.value,
@@ -1282,6 +1482,14 @@ class InfinityConnection:
             "ring_doorbell_ratio": (
                 posted.value / doorbells.value if doorbells.value else 0.0
             ),
+            "ring_batch_slots": batch_slots.value,
+            "ring_batch_ops": batch_ops.value,
+            "ring_batch_ops_per_slot": (
+                batch_ops.value / batch_slots.value if batch_slots.value else 0.0
+            ),
+            "ring_poll_hits": poll_hits.value,
+            "ring_poll_arms": poll_arms.value,
+            "ring_batch_windows": self._batch_windows,
         }
 
     def qos_stats(self) -> dict:
@@ -1328,8 +1536,13 @@ class InfinityConnection:
           ``descriptors``: the doze/wake coalescing ratio),
           ``completions`` CQEs published, ``bad_descriptors`` rejected
           per-descriptor (400 CQE), ``torn_descriptors`` generation-tag
-          mismatches (fatal), and the live ``sq_depth`` /``pending``
-          queue depths;
+          mismatches (fatal), the live ``sq_depth`` /``pending`` queue
+          depths, ``batch_slots``/``batch_ops`` multi-op batch slots
+          consumed and the ops they carried, ``poll_hits``/``poll_arms``
+          adaptive pre-park SQ poll windows that caught work vs expired
+          into the epoll doze, and ``doorbell_elided`` completion
+          doorbells skipped because the client reactor was already awake
+          polling its CQ;
         - ``trace``: the server-side trace tick ring
           (docs/observability.md) — ``recorded``/``dropped`` ring
           counters and ``entries``, each ``{trace_id, parent_id, op,
@@ -1340,7 +1553,8 @@ class InfinityConnection:
           cumulative per-phase microseconds: ``wait_us`` (blocked in
           epoll), ``events_us`` (socket event dispatch), ``rings_us``
           (descriptor-ring drain), ``slices_us`` (cont slices + their
-          QoS scheduling decisions), ``other_us`` (park/doorbell arming
+          QoS scheduling decisions), ``poll_us`` (the adaptive pre-park
+          SQ busy-poll window), ``other_us`` (park/doorbell arming
           and bookkeeping) — exported as ``infinistore_prof_*``;
         - ``ops``: per-opcode ``count``, ``errors``, ``bytes_in``,
           ``bytes_out``, ``total_us``, ``p50_us``, ``p99_us``, and
@@ -1553,6 +1767,11 @@ class StripedConnection:
             "ring_full_fallbacks": 0,
             "ring_meta_fallbacks": 0,
             "ring_completions": 0,
+            "ring_batch_slots": 0,
+            "ring_batch_ops": 0,
+            "ring_poll_hits": 0,
+            "ring_poll_arms": 0,
+            "ring_batch_windows": 0,
         }
         for c in self.conns:
             st = c.ring_stats()
@@ -1563,7 +1782,19 @@ class StripedConnection:
             if out["ring_doorbells"]
             else 0.0
         )
+        out["ring_batch_ops_per_slot"] = (
+            out["ring_batch_ops"] / out["ring_batch_slots"]
+            if out["ring_batch_slots"]
+            else 0.0
+        )
         return out
+
+    def ring_batch_window(self):
+        """Open every stripe's current-tick ring batch window (see
+        InfinityConnection.ring_batch_window). Same-host collapse routes
+        batched ops to stripe 0, but a flush's ops may fan out — open all."""
+        for c in self.conns:
+            c.ring_batch_window()
 
     # -- memory registration (fan out: a batch may land on any stripe) -------
 
@@ -2090,6 +2321,9 @@ class StripedConnection:
             "wakeups_signalled": 0,
             "loop_wakeups": 0,
             "loop_drained": 0,
+            "bridge_poll_hits": 0,
+            "bridge_poll_arms": 0,
+            "bridge_poll_drained": 0,
         }
         for c in self.conns:
             st = c.completion_stats()

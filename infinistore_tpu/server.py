@@ -206,6 +206,20 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
             f"infinistore_ring_sq_depth {ring['sq_depth']}",
             "# TYPE infinistore_ring_pending gauge",
             f"infinistore_ring_pending {ring['pending']}",
+            # PR 16 mechanism counters: multi-op batch slots (one slot per
+            # coalesced flush) and the adaptive poll-then-park windows —
+            # hits completed without parking, arms fell back to the epoll
+            # doze, elided doorbells found the client already awake.
+            "# TYPE infinistore_ring_batch_slots counter",
+            f"infinistore_ring_batch_slots {ring['batch_slots']}",
+            "# TYPE infinistore_ring_batch_ops counter",
+            f"infinistore_ring_batch_ops {ring['batch_ops']}",
+            "# TYPE infinistore_ring_poll_hits counter",
+            f"infinistore_ring_poll_hits {ring['poll_hits']}",
+            "# TYPE infinistore_ring_poll_arms counter",
+            f"infinistore_ring_poll_arms {ring['poll_arms']}",
+            "# TYPE infinistore_ring_doorbell_elided counter",
+            f"infinistore_ring_doorbell_elided {ring['doorbell_elided']}",
         ]
     # Reactor loop-pass phase accounting (docs/observability.md,
     # profiling section): per-phase cumulative microseconds plus the pass
@@ -222,6 +236,7 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
             f'infinistore_prof_loop_us{{phase="events"}} {nprof["events_us"]}',
             f'infinistore_prof_loop_us{{phase="rings"}} {nprof["rings_us"]}',
             f'infinistore_prof_loop_us{{phase="slices"}} {nprof["slices_us"]}',
+            f'infinistore_prof_loop_us{{phase="poll"}} {nprof["poll_us"]}',
             f'infinistore_prof_loop_us{{phase="other"}} {nprof["other_us"]}',
         ]
     # Tracing surfaces (docs/observability.md): the client flight

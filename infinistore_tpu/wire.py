@@ -77,6 +77,15 @@ RING_CTRL_SPAN = 4096  # RingCtrl's reserved span at the segment head
 _RING_CTRL = struct.Struct("<IIIIIIIIQQQQII")  # 72 bytes
 _RING_SLOT = struct.Struct("<QQIBBH")  # 24 bytes
 _RING_CQE = struct.Struct("<QQQII")  # 32 bytes
+_RING_BATCH_HDR = struct.Struct("<HH")  # 4 bytes
+_RING_BATCH_ENTRY = struct.Struct("<IBBH")  # 8 bytes
+
+# Multi-op batch slots: a slot with RING_SLOT_FLAG_BATCH in its flags packs
+# a whole coalesced flush into its meta arena — RingBatchHdr, then count x
+# (RingBatchEntry + that op's SegBatchMeta bytes). The slot token is the
+# base of a contiguous token group; op i completes under token base+i.
+RING_SLOT_FLAG_BATCH = 0x1
+RING_BATCH_MAX_OPS = 64
 
 # Named-field twins of the native ring structs. Same-width field swaps are
 # invisible to a width-sequence diff (ITS-W004) but fatal for shared memory
@@ -114,7 +123,32 @@ RING_LAYOUTS = {
         ("status", "u32"),
         ("flags", "u32"),
     ),
+    "RingBatchHdr": (
+        ("count", "u16"),
+        ("reserved", "u16"),
+    ),
+    "RingBatchEntry": (
+        ("meta_len", "u32"),
+        ("op", "u8"),
+        ("flags", "u8"),
+        ("reserved", "u16"),
+    ),
 }
+
+
+def ring_batch_encode(ops) -> bytes:
+    """Pack a batch slot's meta-arena bytes: RingBatchHdr + per-op
+    (RingBatchEntry + SegBatchMeta body). ``ops`` is a sequence of
+    (op_code, body_bytes) pairs — the reference encoding the native
+    client's ring_group_end mirrors, byte for byte (pinned by
+    tests/test_ring.py's batch-layout golden)."""
+    if not 1 <= len(ops) <= RING_BATCH_MAX_OPS:
+        raise ValueError("batch op count out of range")
+    parts = [_RING_BATCH_HDR.pack(len(ops), 0)]
+    for op_code, body in ops:
+        parts.append(_RING_BATCH_ENTRY.pack(len(body), op_code, 0, 0))
+        parts.append(bytes(body))
+    return b"".join(parts)
 
 
 def _ring_align64(v: int) -> int:

@@ -166,6 +166,25 @@ class Connection {
     // meta-too-big socket fallbacks, completions consumed from the CQ.
     void ring_counters(uint64_t* posted, uint64_t* doorbells, uint64_t* full_fallbacks,
                        uint64_t* meta_fallbacks, uint64_t* completions) const;
+    // PR 16 mechanism ledger: multi-op batch slots published / ops packed
+    // into them (batch_ops / batch_slots = mean flush size the bench gates
+    // on), and the reactor's adaptive poll-then-park outcome counts —
+    // poll_hits (a completion landed inside the busy-poll window: no park,
+    // no doorbell) vs poll_arms (window expired with ops still in flight;
+    // the reactor parked and armed the doorbell).
+    void ring_poll_counters(uint64_t* batch_slots, uint64_t* batch_ops,
+                            uint64_t* poll_hits, uint64_t* poll_arms) const;
+
+    // Multi-op batch grouping (docs/descriptor_ring.md). Between begin and
+    // end, async batched segment ops posted by the SAME thread accumulate
+    // instead of publishing one slot each; end() greedily packs the group
+    // into kRingSlotFlagBatch slots (one per meta-arena-load), publishes
+    // them with ONE tail store + at most one doorbell, and routes whatever
+    // does not fit (ring full / in-flight cap) to the socket path, counted
+    // as the usual fallbacks. Sync ops and other threads bypass an open
+    // group entirely. No-ops when the ring is down; never errors.
+    void ring_group_begin();
+    void ring_group_end();
 
     // Event-fd completion ring (the low-fixed-cost asyncio bridge). When a
     // completion fd is set, async batched ops submitted with cb == nullptr
@@ -234,6 +253,10 @@ class Connection {
     // the caller must fall back to the socket path (ring full / in-flight
     // cap / descriptor body exceeds meta_stride — counted).
     int try_ring_post(std::unique_ptr<Request>* req);
+    // Publish one plain (single-op) slot. Caller holds dring_mu_ and has
+    // verified space + body fit. Returns whether the server needs a doorbell.
+    bool ring_publish_one_locked(std::unique_ptr<Request> req)
+        ITS_REQUIRES(dring_mu_);
     // Reactor-side: drain the completion ring, completing parked requests.
     // Returns false on a corrupt ring (fails the connection).
     bool drain_cq();
@@ -336,6 +359,23 @@ class Connection {
     std::atomic<uint64_t> ring_full_fallbacks_{0};
     std::atomic<uint64_t> ring_meta_fallbacks_{0};
     std::atomic<uint64_t> ring_completions_{0};
+
+    // Multi-op batch grouping (ring_group_begin/end). Owned by the thread
+    // that opened the group; posts from other threads (and all sync ops)
+    // bypass the group and take the plain path.
+    bool group_active_ ITS_GUARDED_BY(dring_mu_) = false;
+    std::thread::id group_owner_ ITS_GUARDED_BY(dring_mu_);
+    std::vector<std::unique_ptr<Request>> group_reqs_ ITS_GUARDED_BY(dring_mu_);
+    // PR 16 ledger (ring_poll_counters).
+    std::atomic<uint64_t> ring_batch_slots_{0};
+    std::atomic<uint64_t> ring_batch_ops_{0};
+    std::atomic<uint64_t> ring_poll_hits_{0};
+    std::atomic<uint64_t> ring_poll_arms_{0};
+    // Adaptive poll state: EWMA of inter-CQE gaps + last CQE timestamp.
+    // Reactor-only (updated in drain_cq, read before parking) — unguarded
+    // by design, like ring_cq_seq_.
+    uint64_t ring_gap_ewma_us_ = 0;
+    uint64_t ring_last_cqe_us_ = 0;
 };
 
 }  // namespace its

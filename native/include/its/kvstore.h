@@ -72,6 +72,24 @@ class KVStore {
     BlockRef get(const std::string& key);
     bool exists(const std::string& key) const;
 
+    // Re-put fast path for the sliced put engine (server.cpp): when `key`
+    // is RAM-resident, exactly `size` bytes, and this store holds the ONLY
+    // reference (no in-flight GET pins the block), return the block after
+    // an LRU touch so the caller can copy the new payload straight into it
+    // — skipping the alloc + commit + old-block-free cycle of a re-put.
+    // Returns nullptr otherwise (caller takes the legacy path). The
+    // returned reference briefly raises use_count to 2; the caller must
+    // finish the copy and drop it within the same reactor slice so
+    // snapshot isolation for concurrently pinned readers holds (nothing
+    // else runs inside a slice on the single-threaded reactor).
+    BlockRef overwrite_slot(const std::string& key, size_t size);
+    // Const eligibility probe for overwrite_slot (no LRU touch, no ref
+    // taken): the put alloc phase uses it to skip pre-allocating blocks
+    // for keys the copy phase expects to overwrite in place. Advisory
+    // only — eligibility can lapse between slices (eviction, a reader
+    // pinning the block), so the copy phase re-checks via overwrite_slot.
+    bool overwrite_eligible(const std::string& key, size_t size) const;
+
     // Remove listed keys; returns how many were present.
     size_t remove(const std::vector<std::string>& keys);
     // Drop everything; returns prior count.

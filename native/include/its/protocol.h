@@ -134,6 +134,16 @@ constexpr uint32_t kRingSqSlots = 64;
 // encoding (~1700 64-char keys + offsets). Bigger bodies fall back to the
 // socket path (counted, never an error).
 constexpr uint32_t kRingMetaStride = 128u << 10;
+// Multi-op batch slots (docs/descriptor_ring.md): a slot whose flags carry
+// kRingSlotFlagBatch packs a whole coalesced flush into its meta arena —
+// RingBatchHdr, then count x (RingBatchEntry + that op's SegBatchMeta
+// bytes). The slot's token is the BASE of a contiguous token group: op i
+// completes with its own RingCqe under token base+i, so the CQE format and
+// the client's completion matching are unchanged. kRingBatchMaxOps bounds
+// the per-slot op count on both sides (a header claiming more is a bad
+// descriptor, answered with error CQEs for the whole group).
+constexpr uint8_t kRingSlotFlagBatch = 0x1;
+constexpr uint16_t kRingBatchMaxOps = 64;
 // RingCtrl's reserved span at the segment head (page-sized so the slot
 // arrays start page-aligned).
 constexpr uint32_t kRingCtrlSpan = 4096;
@@ -191,6 +201,20 @@ struct RingCqe {
     uint32_t status;    // HTTP-like op status
     uint32_t flags;     // reserved (0)
 };
+// Batch-slot meta-arena header: first bytes of a kRingSlotFlagBatch slot's
+// meta region. Followed by ``count`` RingBatchEntry records, each
+// immediately trailed by its op's SegBatchMeta encoding.
+struct RingBatchHdr {
+    uint16_t count;     // ops packed in this slot (1..kRingBatchMaxOps)
+    uint16_t reserved;  // reserved (0)
+};
+// One op inside a batch slot. Op i's completion token is slot token + i.
+struct RingBatchEntry {
+    uint32_t meta_len;  // SegBatchMeta bytes following this entry
+    uint8_t op;         // kOpPutFrom or kOpGetInto
+    uint8_t flags;      // reserved (0)
+    uint16_t reserved;  // reserved (0)
+};
 #pragma pack(pop)
 
 static_assert(sizeof(ReqHeader) == 9, "wire header must stay packed");
@@ -198,6 +222,8 @@ static_assert(sizeof(RespHeader) == 16, "wire resp header must stay packed");
 static_assert(sizeof(RingCtrl) == 72, "ring control block layout is shared state");
 static_assert(sizeof(RingSlot) == 24, "ring slot layout is shared state");
 static_assert(sizeof(RingCqe) == 32, "ring cqe layout is shared state");
+static_assert(sizeof(RingBatchHdr) == 4, "batch header layout is shared state");
+static_assert(sizeof(RingBatchEntry) == 8, "batch entry layout is shared state");
 
 // ---------------------------------------------------------------------------
 // Encoding helpers. Little-endian, length-prefixed. Python mirror: wire.py.

@@ -105,6 +105,44 @@ struct RingView {
     }
 };
 
+// -- adaptive poll-then-park (shared by client reactor and server loop) -----
+//
+// Before arming its doorbell (parking the *_waiting flag and blocking in
+// epoll), a consumer busy-polls its ring for a short budget derived from an
+// EWMA of recent inter-arrival gaps: when completions/descriptors are
+// landing back-to-back the next one is caught without any syscall or
+// doorbell; when the cadence is slow — or the ring idle — the budget is
+// zero and the consumer parks immediately, so a quiet connection costs no
+// CPU. The poll loop must yield each spin (std::this_thread::yield) so a
+// same-core peer can make the progress being polled for.
+
+constexpr uint64_t kRingPollCapUs = 200;      // hard busy-poll bound
+constexpr uint64_t kRingPollMinUs = 5;        // floor once polling at all
+constexpr uint64_t kRingPollDefaultUs = 50;   // optimistic budget before samples
+// Server-side gate: poll only while a descriptor arrived this recently.
+constexpr uint64_t kRingPollRecentUs = 1000;
+
+// Poll budget for the observed cadence: ~2x the smoothed gap, clamped to
+// [kRingPollMinUs, kRingPollCapUs]; gaps beyond the cap are not worth
+// spinning for (park immediately, the doorbell path handles it).
+inline uint64_t ring_poll_budget(uint64_t ewma_gap_us) {
+    if (ewma_gap_us == 0) return kRingPollDefaultUs;
+    if (ewma_gap_us > kRingPollCapUs) return 0;
+    uint64_t b = 2 * ewma_gap_us;
+    if (b < kRingPollMinUs) return kRingPollMinUs;
+    return b < kRingPollCapUs ? b : kRingPollCapUs;
+}
+
+// Fold one arrival timestamp into the gap EWMA (alpha = 1/8). Both fields
+// are owned by the consuming reactor thread — no atomics needed.
+inline void ring_gap_note(uint64_t* ewma_us, uint64_t* last_us, uint64_t now_us) {
+    if (*last_us != 0 && now_us >= *last_us) {
+        uint64_t gap = now_us - *last_us;
+        *ewma_us = (*ewma_us == 0) ? gap : (*ewma_us * 7 + gap) / 8;
+    }
+    *last_us = now_us;
+}
+
 // Build a view over mapped memory, validating the control block against
 // this build's struct sizes and the mapped span. Returns false (view
 // untouched) on any mismatch — the caller must fall back to the socket

@@ -290,16 +290,31 @@ class Server {
     std::vector<Conn*> ring_conns_;
     struct RingCounters {
         uint64_t attached = 0;         // lifetime successful attaches
-        uint64_t descriptors = 0;      // descriptors consumed from SQs
+        uint64_t descriptors = 0;      // descriptors (ops) consumed from SQs
         uint64_t doorbells_rx = 0;     // client->server doorbell frames
         uint64_t cq_doorbells_tx = 0;  // server->client doorbell frames
         uint64_t completions = 0;      // CQEs published
         uint64_t bad_descriptors = 0;  // rejected per-descriptor (CQE 400)
         uint64_t torn_descriptors = 0; // generation-tag mismatches (fatal)
+        // PR 16 mechanism ledger (docs/descriptor_ring.md): multi-op batch
+        // slots consumed / ops unpacked from them, the adaptive pre-park
+        // poll outcomes (hit = a descriptor landed inside the busy-poll
+        // window, arm = the window expired and the park proceeded), and
+        // CQEs published while the client reactor was awake — no doorbell
+        // frame needed (the elision the small-op path banks on).
+        uint64_t batch_slots = 0;      // kRingSlotFlagBatch slots consumed
+        uint64_t batch_ops = 0;        // ops unpacked from batch slots
+        uint64_t poll_hits = 0;        // poll window caught a descriptor
+        uint64_t poll_arms = 0;        // poll window expired; parked
+        uint64_t doorbell_elided = 0;  // CQE published to an awake client
     } ring_counters_;
     // Mirror of run_cont_pass's idle streak for the ring copy engine's
     // adaptive slice budget (see run_cont_slice).
     int idle_streak_ = 0;
+    // Adaptive pre-park poll state (ring.h ring_poll_budget): EWMA of
+    // descriptor inter-arrival gaps + last-arrival stamp. Reactor-only.
+    uint64_t ring_gap_ewma_us_ = 0;
+    uint64_t ring_last_desc_us_ = 0;
 
     // Reactor loop-pass phase accounting (docs/observability.md,
     // profiling section): cumulative CLOCK_MONOTONIC microseconds per
@@ -317,6 +332,7 @@ class Server {
         uint64_t events_us = 0;  // accept/readable/writable dispatch
         uint64_t rings_us = 0;   // drain_rings descriptor consumption
         uint64_t slices_us = 0;  // run_cont_pass (slices + QoS decisions)
+        uint64_t poll_us = 0;    // adaptive pre-park SQ busy-poll window
         uint64_t other_us = 0;   // park/doorbell arming, bookkeeping
     } prof_;
 

@@ -149,6 +149,22 @@ void its_conn_ring_counters(void* c, uint64_t* posted, uint64_t* doorbells,
     static_cast<Connection*>(c)->ring_counters(posted, doorbells, full_fallbacks,
                                                meta_fallbacks, completions);
 }
+// PR 16 mechanism counters: batch slots published / ops packed into them,
+// reactor poll-window hits vs doorbell arms (lib.ring_stats extension —
+// its_conn_ring_counters keeps its 5-value signature for ABI stability).
+void its_conn_ring_poll_counters(void* c, uint64_t* batch_slots, uint64_t* batch_ops,
+                                 uint64_t* poll_hits, uint64_t* poll_arms) {
+    static_cast<Connection*>(c)->ring_poll_counters(batch_slots, batch_ops, poll_hits,
+                                                    poll_arms);
+}
+// Multi-op batch grouping: the asyncio bridge brackets one event-loop
+// tick's ring posts between begin/end so a whole FetchCoalescer flush
+// publishes as one batch slot (docs/descriptor_ring.md). Safe no-ops when
+// the ring is down.
+void its_conn_ring_group_begin(void* c) {
+    static_cast<Connection*>(c)->ring_group_begin();
+}
+void its_conn_ring_group_end(void* c) { static_cast<Connection*>(c)->ring_group_end(); }
 void its_conn_close(void* c) { static_cast<Connection*>(c)->close(); }
 void its_conn_destroy(void* c) { delete static_cast<Connection*>(c); }
 int its_conn_connected(void* c) { return static_cast<Connection*>(c)->connected() ? 1 : 0; }

@@ -64,6 +64,12 @@ struct Connection::SyncState {
 // RAII bracket for reactor regions that touch caller memory: io_seq_ odd
 // while inside. Paired with SyncState::abandoned (see client.h io_seq_).
 namespace {
+uint64_t now_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return static_cast<uint64_t>(ts.tv_sec) * 1000000ull + ts.tv_nsec / 1000;
+}
+
 struct IoSection {
     std::atomic<uint64_t>& seq;
     explicit IoSection(std::atomic<uint64_t>& s) : seq(s) { seq.fetch_add(1); }
@@ -343,6 +349,18 @@ int Connection::try_ring_post(std::unique_ptr<Request>* reqp) {
             ring_meta_fallbacks_.fetch_add(1, std::memory_order_relaxed);
             return -1;
         }
+        // Open batch group, posted by its owning thread: capture instead of
+        // publishing — ring_group_end packs the whole flush into batch
+        // slots. Sync ops never join (their waiter blocks before the group
+        // could flush); an op too big to share a slot with even the batch
+        // header takes the plain single-op slot below.
+        if (group_active_ && req->sync == nullptr &&
+            group_owner_ == std::this_thread::get_id() &&
+            sizeof(RingBatchHdr) + sizeof(RingBatchEntry) + req->body.size() <=
+                v.meta_stride) {
+            group_reqs_.push_back(std::move(*reqp));
+            return 0;
+        }
         uint64_t head = ring_load_acq(&v.ctrl->sq_head);
         if (ring_sq_seq_ - head >= v.sq_slots ||
             ring_inflight_.size() >= v.cq_slots) {
@@ -352,22 +370,7 @@ int Connection::try_ring_post(std::unique_ptr<Request>* reqp) {
             ring_full_fallbacks_.fetch_add(1, std::memory_order_relaxed);
             return -1;
         }
-        uint64_t seq = ring_sq_seq_;
-        uint64_t token = ring_next_token_++;
-        memcpy(v.meta_at(seq), req->body.data(), req->body.size());
-        RingSlot* s = v.slot(seq);
-        s->token = token;
-        s->meta_len = static_cast<uint32_t>(req->body.size());
-        s->op = req->op;
-        s->flags = 0;
-        s->reserved = 0;
-        ring_store_rel(&s->gen, seq + 1);
-        ring_inflight_.emplace(token, std::move(*reqp));
-        ring_sq_seq_ = seq + 1;
-        ring_store_rel(&v.ctrl->sq_tail, seq + 1);
-        ring_posted_.fetch_add(1, std::memory_order_relaxed);
-        ring_fence();
-        doorbell = ring_flag_take(&v.ctrl->srv_waiting);
+        doorbell = ring_publish_one_locked(std::move(*reqp));
     }
     if (doorbell) {
         // The server parked in epoll: wake it with one 9-byte frame. While
@@ -379,6 +382,160 @@ int Connection::try_ring_post(std::unique_ptr<Request>* reqp) {
         submit(std::move(db));
     }
     return 0;
+}
+
+bool Connection::ring_publish_one_locked(std::unique_ptr<Request> req) {
+    RingView& v = dring_->view;
+    uint64_t seq = ring_sq_seq_;
+    uint64_t token = ring_next_token_++;
+    memcpy(v.meta_at(seq), req->body.data(), req->body.size());
+    RingSlot* s = v.slot(seq);
+    s->token = token;
+    s->meta_len = static_cast<uint32_t>(req->body.size());
+    s->op = req->op;
+    s->flags = 0;
+    s->reserved = 0;
+    ring_store_rel(&s->gen, seq + 1);
+    ring_inflight_.emplace(token, std::move(req));
+    ring_sq_seq_ = seq + 1;
+    ring_store_rel(&v.ctrl->sq_tail, seq + 1);
+    ring_posted_.fetch_add(1, std::memory_order_relaxed);
+    ring_fence();
+    return ring_flag_take(&v.ctrl->srv_waiting);
+}
+
+void Connection::ring_group_begin() {
+    std::lock_guard<std::mutex> lock(dring_mu_);
+    if (group_active_) return;  // first opener wins; others post plain
+    group_active_ = true;
+    group_owner_ = std::this_thread::get_id();
+}
+
+void Connection::ring_group_end() {
+    std::vector<std::unique_ptr<Request>> overflow;
+    bool doorbell = false;
+    {
+        std::lock_guard<std::mutex> lock(dring_mu_);
+        if (!group_active_) return;
+        group_active_ = false;
+        if (group_reqs_.empty()) return;
+        std::vector<std::unique_ptr<Request>> reqs = std::move(group_reqs_);
+        group_reqs_.clear();
+        if (dring_ == nullptr || !connected_.load()) {
+            overflow = std::move(reqs);
+        } else {
+            RingView& v = dring_->view;
+            size_t i = 0;
+            while (i < reqs.size()) {
+                uint64_t head = ring_load_acq(&v.ctrl->sq_head);
+                if (ring_sq_seq_ - head >= v.sq_slots) break;
+                // Greedy pack: how many of the remaining ops share this slot
+                // (meta-arena capacity, per-slot op bound, CQ in-flight cap
+                // — each packed op consumes one completion entry).
+                size_t fit = 0;
+                size_t off = sizeof(RingBatchHdr);
+                while (i + fit < reqs.size() && fit < kRingBatchMaxOps &&
+                       ring_inflight_.size() + fit < v.cq_slots) {
+                    size_t need = sizeof(RingBatchEntry) + reqs[i + fit]->body.size();
+                    if (off + need > v.meta_stride) break;
+                    off += need;
+                    fit++;
+                }
+                if (fit == 0) break;  // in-flight cap (bodies fit by capture check)
+                if (fit == 1) {
+                    // A lone op posts in the plain single-op format — batch
+                    // framing buys nothing and the server skips a decode hop.
+                    doorbell |= ring_publish_one_locked(std::move(reqs[i]));
+                    i++;
+                    continue;
+                }
+                uint64_t seq = ring_sq_seq_;
+                char* arena = v.meta_at(seq);
+                uint64_t base = ring_next_token_;
+                RingBatchHdr hdr{static_cast<uint16_t>(fit), 0};
+                memcpy(arena, &hdr, sizeof(hdr));
+                size_t w = sizeof(RingBatchHdr);
+                for (size_t k = 0; k < fit; k++) {
+                    Request* rq = reqs[i + k].get();
+                    RingBatchEntry ent{static_cast<uint32_t>(rq->body.size()), rq->op,
+                                       0, 0};
+                    memcpy(arena + w, &ent, sizeof(ent));
+                    memcpy(arena + w + sizeof(ent), rq->body.data(), rq->body.size());
+                    w += sizeof(ent) + rq->body.size();
+                }
+                RingSlot* s = v.slot(seq);
+                s->token = base;  // op k completes under token base + k
+                s->meta_len = static_cast<uint32_t>(w);
+                s->op = 0;
+                s->flags = kRingSlotFlagBatch;
+                s->reserved = 0;
+                ring_store_rel(&s->gen, seq + 1);
+                for (size_t k = 0; k < fit; k++)
+                    ring_inflight_.emplace(base + k, std::move(reqs[i + k]));
+                ring_next_token_ += fit;
+                ring_sq_seq_ = seq + 1;
+                ring_store_rel(&v.ctrl->sq_tail, seq + 1);
+                ring_posted_.fetch_add(fit, std::memory_order_relaxed);
+                ring_batch_slots_.fetch_add(1, std::memory_order_relaxed);
+                ring_batch_ops_.fetch_add(fit, std::memory_order_relaxed);
+                ring_fence();
+                doorbell |= ring_flag_take(&v.ctrl->srv_waiting);
+                i += fit;
+            }
+            // Whatever did not fit rides the socket path — the same counted
+            // ring-full backpressure as the plain path, never an error.
+            for (; i < reqs.size(); i++) {
+                ring_full_fallbacks_.fetch_add(1, std::memory_order_relaxed);
+                overflow.push_back(std::move(reqs[i]));
+            }
+        }
+    }
+    if (doorbell) {
+        ring_doorbells_.fetch_add(1, std::memory_order_relaxed);
+        auto db = std::make_unique<Request>();
+        db->op = kOpRingDoorbell;
+        db->no_response = true;
+        submit(std::move(db));
+    }
+    if (!overflow.empty()) {
+        // Inline the submit() enqueue so a refused op (connection already
+        // failed) can still be completed instead of silently dropped, and
+        // the whole spill shares one reactor wake.
+        size_t queued = 0;
+        for (auto& r : overflow) {
+            bool sent = false;
+            {
+                std::lock_guard<std::mutex> lock(submit_mu_);
+                if (connected_.load()) {
+                    r->prime();
+                    submitted_.push_back(std::move(r));
+                    sent = true;
+                }
+            }
+            if (sent)
+                queued++;
+            else
+                complete(std::move(r), static_cast<int>(kStatusUnavailable),
+                         /*take_body=*/false);
+        }
+        if (queued > 0) {
+            uint64_t one = 1;
+            ssize_t rc = write(wake_fd_, &one, sizeof(one));
+            (void)rc;
+        }
+    }
+}
+
+void Connection::ring_poll_counters(uint64_t* batch_slots, uint64_t* batch_ops,
+                                    uint64_t* poll_hits, uint64_t* poll_arms) const {
+    if (batch_slots != nullptr)
+        *batch_slots = ring_batch_slots_.load(std::memory_order_relaxed);
+    if (batch_ops != nullptr)
+        *batch_ops = ring_batch_ops_.load(std::memory_order_relaxed);
+    if (poll_hits != nullptr)
+        *poll_hits = ring_poll_hits_.load(std::memory_order_relaxed);
+    if (poll_arms != nullptr)
+        *poll_arms = ring_poll_arms_.load(std::memory_order_relaxed);
 }
 
 // Reactor-side completion-ring drain. Returns false only on a corrupt ring
@@ -411,6 +568,10 @@ bool Connection::drain_cq() {
             return false;
         }
         ring_completions_.fetch_add(1, std::memory_order_relaxed);
+        // Feed the adaptive poll budget: back-to-back completions pull the
+        // gap EWMA toward zero (poll hard), a quiet ring pushes it past the
+        // cap (park immediately). Reactor-owned state, no lock.
+        ring_gap_note(&ring_gap_ewma_us_, &ring_last_cqe_us_, now_us());
         complete(std::move(req), static_cast<int>(status), /*take_body=*/false);
     }
     return true;
@@ -940,9 +1101,14 @@ void Connection::fail_all(int code) {
     std::vector<std::unique_ptr<Request>> ring_ops;
     {
         std::lock_guard<std::mutex> lock(dring_mu_);
-        ring_ops.reserve(ring_inflight_.size());
+        ring_ops.reserve(ring_inflight_.size() + group_reqs_.size());
         for (auto& [token, req] : ring_inflight_) ring_ops.push_back(std::move(req));
         ring_inflight_.clear();
+        // An open batch group holds captured-but-unpublished ops; they die
+        // with the connection like any other in-flight request.
+        for (auto& req : group_reqs_) ring_ops.push_back(std::move(req));
+        group_reqs_.clear();
+        group_active_ = false;
     }
     for (auto& req : ring_ops) complete(std::move(req), code, /*take_body=*/false);
     while (!awaiting_.empty()) {
@@ -1291,35 +1457,7 @@ void Connection::reactor() {
     constexpr int kMaxEvents = 8;
     epoll_event events[kMaxEvents];
     bool ok = true;
-    while (ok && !stop_.load(std::memory_order_relaxed)) {
-        if (poison_.load()) break;  // abandoned segment op: fail everything
-        int timeout = 200;
-        if (ring_ok_.load(std::memory_order_acquire)) {
-            // Park-then-recheck (Dekker pairing with the server's CQE
-            // publish + flag read): either we see the new tail here, or the
-            // server sees cli_waiting and sends a doorbell frame.
-            if (!drain_cq()) break;
-            ring_flag_park(&dring_->view.ctrl->cli_waiting);
-            ring_fence();
-            if (ring_load_acq(&dring_->view.ctrl->cq_tail) != ring_cq_seq_) {
-                ring_flag_clear(&dring_->view.ctrl->cli_waiting);
-                if (!drain_cq()) break;
-                // The recheck hit, so the flag is DOWN: a CQE published
-                // while we slept would send no doorbell. Poll instead of
-                // blocking — the next loop iteration re-parks properly
-                // (the server's loop() applies the same discipline).
-                timeout = 0;
-            }
-        }
-        int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
-        if (ring_ok_.load(std::memory_order_acquire)) {
-            ring_flag_clear(&dring_->view.ctrl->cli_waiting);
-            if (!drain_cq()) break;
-        }
-        if (n < 0) {
-            if (errno == EINTR) continue;
-            break;
-        }
+    auto dispatch = [&](int n) {
         for (int i = 0; i < n && ok; i++) {
             int fd = events[i].data.fd;
             if (fd == wake_fd_) {
@@ -1341,6 +1479,79 @@ void Connection::reactor() {
                 if (ok && (events[i].events & EPOLLIN)) ok = read_ready();
             }
         }
+    };
+    while (ok && !stop_.load(std::memory_order_relaxed)) {
+        if (poison_.load()) break;  // abandoned segment op: fail everything
+        int timeout = 200;
+        if (ring_ok_.load(std::memory_order_acquire)) {
+            if (!drain_cq()) break;
+            // Adaptive poll-then-park (docs/descriptor_ring.md): with ring
+            // ops in flight and completions arriving on a fast cadence,
+            // busy-poll the CQ for ~2x the smoothed inter-CQE gap before
+            // arming the doorbell — a hit completes the op with no park, no
+            // doorbell frame, no epoll wake. Socket/wake traffic is served
+            // inside the window (zero-timeout epoll), so posting and
+            // payload streaming are never starved by the spin. An idle ring
+            // (nothing in flight) or a slow cadence yields a zero budget:
+            // straight to the parked doze, zero CPU.
+            bool inflight;
+            {
+                std::lock_guard<std::mutex> lock(dring_mu_);
+                inflight = !ring_inflight_.empty();
+            }
+            if (inflight) {
+                uint64_t budget = ring_poll_budget(ring_gap_ewma_us_);
+                bool hit = false;
+                if (budget != 0) {
+                    uint64_t deadline = now_us() + budget;
+                    while (ok && !stop_.load(std::memory_order_relaxed) &&
+                           !poison_.load()) {
+                        if (ring_load_acq(&dring_->view.ctrl->cq_tail) !=
+                            ring_cq_seq_) {
+                            hit = true;
+                            break;
+                        }
+                        int pn = epoll_wait(epoll_fd_, events, kMaxEvents, 0);
+                        if (pn > 0) dispatch(pn);
+                        if (now_us() >= deadline) break;
+                        // Mandatory on a shared core: the server thread we
+                        // are polling against needs cycles to publish.
+                        std::this_thread::yield();
+                    }
+                }
+                if (!ok) break;
+                if (hit) {
+                    ring_poll_hits_.fetch_add(1, std::memory_order_relaxed);
+                    if (!drain_cq()) break;
+                    continue;
+                }
+                ring_poll_arms_.fetch_add(1, std::memory_order_relaxed);
+            }
+            // Park-then-recheck (Dekker pairing with the server's CQE
+            // publish + flag read): either we see the new tail here, or the
+            // server sees cli_waiting and sends a doorbell frame.
+            ring_flag_park(&dring_->view.ctrl->cli_waiting);
+            ring_fence();
+            if (ring_load_acq(&dring_->view.ctrl->cq_tail) != ring_cq_seq_) {
+                ring_flag_clear(&dring_->view.ctrl->cli_waiting);
+                if (!drain_cq()) break;
+                // The recheck hit, so the flag is DOWN: a CQE published
+                // while we slept would send no doorbell. Poll instead of
+                // blocking — the next loop iteration re-parks properly
+                // (the server's loop() applies the same discipline).
+                timeout = 0;
+            }
+        }
+        int n = epoll_wait(epoll_fd_, events, kMaxEvents, timeout);
+        if (ring_ok_.load(std::memory_order_acquire)) {
+            ring_flag_clear(&dring_->view.ctrl->cli_waiting);
+            if (!drain_cq()) break;
+        }
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;
+        }
+        dispatch(n);
     }
     fail_all(kStatusUnavailable);
 }

@@ -17,11 +17,12 @@ void KVStore::commit(const std::string& key, BlockRef block) {
         // Overwrite: replace in place and touch. The old RAM block is freed
         // once in-flight readers release it; an old spill slot is freed now.
         Entry& e = it->second;
-        (e.spilled() ? spill_lru_ : lru_).erase(e.lru_it);
+        // splice, not erase+push_front: moves the existing node (no node
+        // free/alloc, no key copy) and keeps e.lru_it valid. Also hoists a
+        // spilled entry's node from spill_lru_ into lru_.
+        lru_.splice(lru_.begin(), e.spilled() ? spill_lru_ : lru_, e.lru_it);
         release_entry(e);
-        lru_.push_front(key);
         e.block = std::move(block);
-        e.lru_it = lru_.begin();
         return;
     }
     lru_.push_front(key);
@@ -115,13 +116,32 @@ BlockRef KVStore::get(const std::string& key) {
     if (it == map_.end()) return nullptr;
     Entry& e = it->second;
     if (e.spilled()) return promote(key, it);
-    lru_.erase(e.lru_it);
-    lru_.push_front(key);
-    e.lru_it = lru_.begin();
+    lru_.splice(lru_.begin(), lru_, e.lru_it);  // O(1) touch, no node churn
     return e.block;
 }
 
 bool KVStore::exists(const std::string& key) const { return map_.count(key) != 0; }
+
+BlockRef KVStore::overwrite_slot(const std::string& key, size_t size) {
+    auto it = map_.find(key);
+    if (it == map_.end()) return nullptr;
+    Entry& e = it->second;
+    if (e.block == nullptr || e.block->size() != size) return nullptr;
+    // use_count()==1 means the map holds the only reference: no suspended
+    // GET continuation is mid-stream on this block, so mutating it in
+    // place cannot tear a reader's snapshot.
+    if (e.block.use_count() != 1) return nullptr;
+    lru_.splice(lru_.begin(), lru_, e.lru_it);  // O(1) touch, no node churn
+    return e.block;
+}
+
+bool KVStore::overwrite_eligible(const std::string& key, size_t size) const {
+    auto it = map_.find(key);
+    if (it == map_.end()) return false;
+    const Entry& e = it->second;
+    return e.block != nullptr && e.block->size() == size &&
+           e.block.use_count() == 1;
+}
 
 size_t KVStore::remove(const std::vector<std::string>& keys) {
     size_t removed = 0;

@@ -21,6 +21,7 @@
 #include "its/net_util.h"
 #include "its/log.h"
 #include "its/ring.h"
+#include "its/streamcopy.h"
 
 namespace its {
 
@@ -415,6 +416,11 @@ std::string Server::stats_json() {
               ",\"completions\":" + std::to_string(ring_counters_.completions) +
               ",\"bad_descriptors\":" + std::to_string(ring_counters_.bad_descriptors) +
               ",\"torn_descriptors\":" + std::to_string(ring_counters_.torn_descriptors) +
+              ",\"batch_slots\":" + std::to_string(ring_counters_.batch_slots) +
+              ",\"batch_ops\":" + std::to_string(ring_counters_.batch_ops) +
+              ",\"poll_hits\":" + std::to_string(ring_counters_.poll_hits) +
+              ",\"poll_arms\":" + std::to_string(ring_counters_.poll_arms) +
+              ",\"doorbell_elided\":" + std::to_string(ring_counters_.doorbell_elided) +
               ",\"sq_depth\":" + [this] {
                   uint64_t depth = 0;
                   for (Conn* rc : ring_conns_)
@@ -439,6 +445,7 @@ std::string Server::stats_json() {
               ",\"events_us\":" + std::to_string(prof_.events_us) +
               ",\"rings_us\":" + std::to_string(prof_.rings_us) +
               ",\"slices_us\":" + std::to_string(prof_.slices_us) +
+              ",\"poll_us\":" + std::to_string(prof_.poll_us) +
               ",\"other_us\":" + std::to_string(prof_.other_us) + "}" +
               // Server-side trace tick ring (docs/observability.md): the
               // manage plane's /trace endpoint joins these to client spans
@@ -519,6 +526,52 @@ void Server::loop() {
             timeout =
                 now_us() - last_fg_us_ < config_.bg_cooldown_us ? 1 : 0;
         }
+        uint64_t poll_spent = 0;
+        if (timeout != 0 && !ring_conns_.empty()) {
+            // Adaptive pre-park poll (docs/descriptor_ring.md): while
+            // descriptors have been arriving on a fast cadence, busy-poll
+            // the submission tails for ~2x the smoothed inter-arrival gap
+            // before parking — a hit consumes the next flush with no
+            // doorbell frame and no epoll round-trip. The window is gated
+            // on a RECENT arrival, so a connection going quiet ages out of
+            // polling within kRingPollRecentUs and the reactor dozes at
+            // zero CPU. Socket traffic cuts the window short via a
+            // zero-timeout epoll peek (level-triggered: the main wait
+            // below re-reports whatever the peek saw).
+            uint64_t poll_t0 = now_us();
+            uint64_t budget =
+                (ring_last_desc_us_ != 0 &&
+                 poll_t0 - ring_last_desc_us_ <= kRingPollRecentUs)
+                    ? ring_poll_budget(ring_gap_ewma_us_)
+                    : 0;
+            if (budget != 0) {
+                uint64_t deadline = poll_t0 + budget;
+                bool hit = false;
+                while (!stop_requested_.load(std::memory_order_relaxed)) {
+                    for (Conn* rc : ring_conns_) {
+                        if (ring_load_acq(&rc->ring->view.ctrl->sq_tail) !=
+                            rc->ring->sq_seq) {
+                            hit = true;
+                            break;
+                        }
+                    }
+                    if (hit) break;
+                    epoll_event peek;
+                    if (epoll_wait(epoll_fd_, &peek, 1, 0) > 0) break;
+                    if (now_us() >= deadline) break;
+                    // Mandatory on a shared core: the client thread we are
+                    // polling against needs cycles to publish.
+                    std::this_thread::yield();
+                }
+                if (hit) {
+                    ring_counters_.poll_hits++;
+                    timeout = 0;
+                } else {
+                    ring_counters_.poll_arms++;
+                }
+                poll_spent = now_us() - poll_t0;
+            }
+        }
         if (timeout != 0 && !ring_conns_.empty()) {
             // About to block: park on every attached submission ring, then
             // re-check the tails — the Dekker pairing with the client's
@@ -550,7 +603,8 @@ void Server::loop() {
                 // the busy-poll-vs-eventfd receipt reads.
                 prof_.passes++;
                 prof_.wait_us += wait_t1 - wait_t0;
-                prof_.other_us += wait_t0 - pass_t0;
+                prof_.poll_us += poll_spent;
+                prof_.other_us += wait_t0 - pass_t0 - poll_spent;
                 continue;
             }
             ITS_LOG_ERROR("epoll_wait: %s", strerror(errno));
@@ -598,7 +652,8 @@ void Server::loop() {
         prof_.events_us += events_t1 - wait_t1;
         prof_.rings_us += rings_t1 - events_t1;
         prof_.slices_us += slices_t1 - rings_t1;
-        prof_.other_us += (wait_t0 - pass_t0) + (now_us() - slices_t1);
+        prof_.poll_us += poll_spent;
+        prof_.other_us += (wait_t0 - pass_t0 - poll_spent) + (now_us() - slices_t1);
     }
     // Drain control closures posted during shutdown so no caller hangs.
     {
@@ -741,6 +796,7 @@ bool Server::bg_must_defer() const {
 // ---------------------------------------------------------------------------
 
 void Server::drain_rings() {
+    uint64_t before = ring_counters_.descriptors;
     for (size_t i = 0; i < ring_conns_.size();) {
         Conn* c = ring_conns_[i];
         if (!drain_ring_conn(c)) {
@@ -755,6 +811,10 @@ void Server::drain_rings() {
         // the element at i is already the NEXT conn and i must not advance.
         if (i < ring_conns_.size() && ring_conns_[i] == c) i++;
     }
+    // Feed the adaptive pre-park poll: a pass that consumed descriptors
+    // stamps the arrival EWMA (ring.h ring_gap_note) the next park reads.
+    if (ring_counters_.descriptors != before)
+        ring_gap_note(&ring_gap_ewma_us_, &ring_last_desc_us_, now_us());
 }
 
 bool Server::drain_ring_conn(Conn* c) {
@@ -781,6 +841,72 @@ bool Server::drain_ring_conn(Conn* c) {
         uint8_t op = s->op;
         uint64_t token = s->token;
         uint32_t meta_len = s->meta_len;
+        if (s->flags & kRingSlotFlagBatch) {
+            // Multi-op batch slot: RingBatchHdr + count x (RingBatchEntry +
+            // SegBatchMeta). Op k completes under token + k. The whole slot
+            // is validated before any op is queued; a malformed slot
+            // error-CQEs every token the client parked against it (when the
+            // header itself is unreadable, only the base token — there is
+            // nothing trustworthy to size the group by).
+            const uint8_t* arena =
+                reinterpret_cast<const uint8_t*>(r.view.meta_at(r.sq_seq));
+            uint16_t cnt = 0;
+            bool ok = meta_len >= sizeof(RingBatchHdr) &&
+                      meta_len <= r.view.meta_stride;
+            if (ok) {
+                RingBatchHdr hdr;
+                memcpy(&hdr, arena, sizeof(hdr));
+                cnt = hdr.count;
+                ok = cnt >= 1 && cnt <= kRingBatchMaxOps;
+                if (!ok) cnt = 0;  // header untrustworthy
+            }
+            std::vector<Conn::RingSrv::PendingDesc> decoded;
+            if (ok) {
+                decoded.reserve(cnt);
+                size_t off = sizeof(RingBatchHdr);
+                for (uint16_t k = 0; k < cnt && ok; k++) {
+                    RingBatchEntry ent;
+                    if (off + sizeof(ent) > meta_len) {
+                        ok = false;
+                        break;
+                    }
+                    memcpy(&ent, arena + off, sizeof(ent));
+                    off += sizeof(ent);
+                    ok = (ent.op == kOpPutFrom || ent.op == kOpGetInto) &&
+                         ent.meta_len <= meta_len - off;
+                    if (!ok) break;
+                    try {
+                        SegBatchMeta m = SegBatchMeta::decode(arena + off, ent.meta_len);
+                        decoded.push_back(
+                            Conn::RingSrv::PendingDesc{ent.op, token + k, std::move(m)});
+                    } catch (const std::exception&) {
+                        ok = false;
+                        break;
+                    }
+                    off += ent.meta_len;
+                }
+            }
+            r.sq_seq++;
+            ring_store_rel(&r.view.ctrl->sq_head, r.sq_seq);
+            if (!ok) {
+                uint64_t fail = cnt != 0 ? cnt : 1;
+                ring_counters_.descriptors += fail;
+                ring_counters_.bad_descriptors += fail;
+                for (uint64_t k = 0; k < fail && !c->dead; k++)
+                    ring_push_cqe(c, token + k, kStatusInvalidReq, 0);
+                if (c->dead) return true;  // cqe overflow closed it
+                continue;
+            }
+            ring_counters_.descriptors += cnt;
+            ring_counters_.batch_slots++;
+            ring_counters_.batch_ops += cnt;
+            for (auto& d : decoded) {
+                auto& q = d.m.priority == kPriorityBackground ? r.pending_bg
+                                                              : r.pending_fg;
+                q.push_back(std::move(d));
+            }
+            continue;
+        }
         SegBatchMeta m;
         bool ok = (op == kOpPutFrom || op == kOpGetInto) &&
                   meta_len <= r.view.meta_stride;
@@ -899,6 +1025,11 @@ void Server::ring_push_cqe(Conn* c, uint64_t token, uint32_t status, uint64_t by
         // completions landing while it is awake piggyback silently.
         ring_counters_.cq_doorbells_tx++;
         send_resp(c, kStatusRingEvent, {}, {}, {});
+    } else {
+        // The client is awake — inside its adaptive poll window or already
+        // draining — so this completion needed no doorbell frame at all:
+        // the elision the small-op fast path banks on.
+        ring_counters_.doorbell_elided++;
     }
 }
 
@@ -1188,46 +1319,122 @@ void Server::run_cont_slice(Conn* c) {
     const size_t bs = ct.m.block_size;
     // Adaptive slice budget for ring-sourced ops (docs/descriptor_ring.md):
     // when this is the ONLY pending sliced op and the loop has seen
-    // event-free polls (idle_streak_), grow the quantum up to 8x — per-slice
-    // fixed cost (queue churn, clock reads, loop overhead) was the dominant
-    // non-copy term inside first_slice->last_slice. Any epoll event resets
-    // the streak, so a contending request waits at most one boosted slice —
-    // the same bound the pre-existing multi-round idle boost imposed. Socket
-    // conts keep the exact legacy budget (off-path behavior unchanged).
+    // event-free polls (idle_streak_), grow the quantum exponentially up to
+    // 32x (4MB at the default 128KB) — per-slice fixed cost (queue churn,
+    // clock reads, loop overhead) was the dominant non-copy term inside
+    // first_slice->last_slice. Any epoll event resets the streak, so a
+    // contending request waits at most one boosted slice (~300us at
+    // streaming-store bandwidth, see streamcopy.h). Socket conts keep the
+    // exact legacy budget (off-path behavior unchanged).
     size_t eff_slice_bytes = config_.slice_bytes;
     if (ct.from_ring && cont_fg_.empty() && cont_bg_.empty() && idle_streak_ > 0)
-        eff_slice_bytes *= 1 + static_cast<size_t>(std::min(idle_streak_, 7));
+        eff_slice_bytes <<= std::min(idle_streak_, 5);
     const size_t budget_blocks = std::max<size_t>(1, eff_slice_bytes / bs);
 
     trace_slice(c);  // one tick per PutFrom/GetInto budget slice
     if (ct.op == kOpPutFrom) {
         if (ct.phase == Conn::SegCont::Phase::kAlloc) {
             size_t chunk = std::min(budget_blocks, n - ct.idx);
+            // Re-put fast path (kvstore.h overwrite_slot): keys whose
+            // current block can be overwritten in place get a nullptr
+            // placeholder instead of a fresh block — the copy phase writes
+            // straight into the resident block, skipping the per-key
+            // lease + make_shared here and the commit + old-block free
+            // there. A fresh put (no eligible keys) allocates exactly as
+            // before, so the OOM-before-any-commit guarantee is unchanged
+            // on that path.
+            // Whole-op probe on the first slice: a fully-eligible batch
+            // (the steady-state re-put) needs NO allocation at all, and the
+            // probe is ~30ns/key — skip straight to the copy phase in one
+            // slice instead of sweeping budget_blocks keys per tick.
+            if (ct.idx == 0) {
+                size_t elig = 0;
+                for (size_t i = 0; i < n; i++)
+                    if (kv_->overwrite_eligible(ct.m.keys[i], bs)) elig++;
+                if (elig == n) {
+                    ct.blocks.assign(n, nullptr);
+                    ct.idx = n;
+                    ct.phase = Conn::SegCont::Phase::kCopy;
+                    return;
+                }
+            }
+            size_t need = 0;
+            for (size_t i = 0; i < chunk; i++)
+                if (!kv_->overwrite_eligible(ct.m.keys[ct.idx + i], bs))
+                    need++;
             std::vector<Lease> leases;
             // Budgeted reclaim: a capped demote pass retries next slice
             // instead of 507ing an op the spill tier could still absorb.
-            bool ok;
-            {
+            bool ok = true;
+            if (need != 0) {
                 SliceBudget budget(this, budget_blocks);
-                ok = alloc_blocks(bs, chunk, &leases);
+                ok = alloc_blocks(bs, need, &leases);
             }
             if (!ok) {
                 if (!slice_capped_) finish_cont(c, kStatusOutOfMemory);
                 return;  // capped: demotes happened, retry next tick
             }
-            for (auto& l : leases)
-                ct.blocks.push_back(std::make_shared<Block>(mm_.get(), l.ptr, l.size));
+            size_t li = 0;
+            for (size_t i = 0; i < chunk; i++) {
+                if (kv_->overwrite_eligible(ct.m.keys[ct.idx + i], bs)) {
+                    ct.blocks.push_back(nullptr);
+                } else {
+                    const Lease& l = leases[li++];
+                    ct.blocks.push_back(
+                        std::make_shared<Block>(mm_.get(), l.ptr, l.size));
+                }
+            }
+            // Over-allocation corner: a key's eligibility appearing
+            // BETWEEN the two sweeps (impossible single-threaded — both
+            // run in this slice) would strand a lease; li==need by
+            // construction, every lease is owned by a Block above.
             ct.idx += chunk;
             if (ct.idx == n) ct.phase = Conn::SegCont::Phase::kCopy;
             return;
         }
-        size_t chunk = std::min(budget_blocks, n - ct.copied);
-        for (size_t i = 0; i < chunk; i++) {
-            size_t k = ct.copied + i;
-            memcpy(ct.blocks[k]->data(), seg.base + ct.m.offsets[k], bs);
-            kv_->commit(ct.m.keys[k], std::move(ct.blocks[k]));
+        size_t end = std::min(ct.copied + budget_blocks, n);
+        while (ct.copied < end) {
+            size_t k = ct.copied;
+            if (ct.blocks[k] != nullptr) {
+                stream_copy(ct.blocks[k]->data(), seg.base + ct.m.offsets[k],
+                            bs);
+                kv_->commit(ct.m.keys[k], std::move(ct.blocks[k]));
+                ct.copied++;
+                continue;
+            }
+            // Overwrite placeholder from the alloc phase: re-verify NOW —
+            // eligibility can lapse between slices (eviction demoted the
+            // block, or a GET pinned it).
+            BlockRef dst = kv_->overwrite_slot(ct.m.keys[k], bs);
+            if (dst != nullptr) {
+                stream_copy(dst->data(), seg.base + ct.m.offsets[k], bs);
+                ct.copied++;  // entry already committed by identity
+                continue;
+            }
+            // Lapsed: emergency single-block alloc + legacy commit. The
+            // only path where OOM can land mid-op (some keys already
+            // committed) — it needs eviction or a concurrent pin to race
+            // this op between slices AND reclaim to run dry.
+            std::vector<Lease> leases;
+            bool ok;
+            {
+                SliceBudget budget(this, budget_blocks);
+                ok = alloc_blocks(bs, 1, &leases);
+            }
+            if (!ok) {
+                stream_copy_fence();
+                if (!slice_capped_) finish_cont(c, kStatusOutOfMemory);
+                return;  // capped: demotes happened, resume here next tick
+            }
+            BlockRef nb =
+                std::make_shared<Block>(mm_.get(), leases[0].ptr, leases[0].size);
+            stream_copy(nb->data(), seg.base + ct.m.offsets[k], bs);
+            kv_->commit(ct.m.keys[k], std::move(nb));
+            ct.copied++;
         }
-        ct.copied += chunk;
+        // Order the slice's non-temporal stores before anything that
+        // publishes them (ring CQE push below, a later GET's socket send).
+        stream_copy_fence();
         if (ct.copied == n) {
             if (ct.from_ring) {
                 ring_finish(c, kStatusOk, static_cast<uint64_t>(n) * bs);
@@ -1258,8 +1465,12 @@ void Server::run_cont_slice(Conn* c) {
     size_t chunk = std::min(budget_blocks, n - ct.copied);
     for (size_t i = 0; i < chunk; i++) {
         size_t k = ct.copied + i;
-        memcpy(seg.base + ct.m.offsets[k], ct.blocks[k]->data(), ct.blocks[k]->size());
+        stream_copy(seg.base + ct.m.offsets[k], ct.blocks[k]->data(),
+                    ct.blocks[k]->size());
     }
+    // The client reads these bytes the moment the completion publishes;
+    // drain the write-combining buffers before the CQE / response leaves.
+    stream_copy_fence();
     ct.copied += chunk;
     if (ct.copied == n) {
         if (ct.from_ring) {

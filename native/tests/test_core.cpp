@@ -103,6 +103,43 @@ static void test_kvstore_lru_eviction() {
     CHECK(mm.used_bytes() == 0);  // refcount returned every block
 }
 
+static void test_kvstore_overwrite_slot() {
+    MM mm(64 << 10, 16 << 10, false);  // 4 blocks
+    KVStore kv(&mm);
+    auto put = [&](const std::string& key) {
+        std::vector<Lease> l;
+        CHECK(mm.allocate(16 << 10, 1, nullptr, &l));
+        kv.commit(key, std::make_shared<Block>(&mm, l[0].ptr, l[0].size));
+    };
+    put("a");
+    put("b");
+    // Resident, size-matched, only-reference: eligible, and the fast path
+    // hands back the committed block itself (copy lands in place).
+    CHECK(kv.overwrite_eligible("a", 16 << 10));
+    BlockRef slot = kv.overwrite_slot("a", 16 << 10);
+    CHECK(slot != nullptr && slot == kv.get("a"));
+    // overwrite_slot touched "a": with the pool full, a one-entry evict
+    // (4 -> 3 blocks = 0.75 usage <= 0.8) must take the colder "b".
+    slot.reset();
+    put("c");
+    put("d");
+    CHECK(kv.evict(0.8, 0.9) == 1);
+    CHECK(kv.exists("a") && !kv.exists("b"));
+    // Size mismatch and missing key: ineligible, no slot.
+    CHECK(!kv.overwrite_eligible("a", 8 << 10));
+    CHECK(kv.overwrite_slot("a", 8 << 10) == nullptr);
+    CHECK(!kv.overwrite_eligible("nope", 16 << 10));
+    // A pinned reader (outstanding BlockRef) blocks the in-place path —
+    // mutating the block would tear that reader's snapshot.
+    BlockRef pinned = kv.get("a");
+    CHECK(!kv.overwrite_eligible("a", 16 << 10));
+    CHECK(kv.overwrite_slot("a", 16 << 10) == nullptr);
+    pinned.reset();
+    CHECK(kv.overwrite_eligible("a", 16 << 10));
+    kv.purge();
+    CHECK(mm.used_bytes() == 0);
+}
+
 static void test_wire_codec_roundtrip() {
     BatchMeta m;
     m.block_size = 4096;
@@ -846,6 +883,14 @@ static void test_ring_doorbell_coalescing() {
     CHECK(stat_counter(st, "doorbells_rx") == static_cast<long long>(doorbells));
     // CQ-side doorbells can never exceed published completions.
     CHECK(stat_counter(st, "cq_doorbells_tx") <= stat_counter(st, "completions"));
+    // Every published completion either paid a CQ doorbell or was elided
+    // because the client consumer was awake — the two must account for all
+    // of them, and the burst completing behind the sliced head op has to
+    // land at least one CQE inside the client's adaptive poll window.
+    long long elided = stat_counter(st, "doorbell_elided");
+    CHECK(elided >= 1);
+    CHECK(stat_counter(st, "cq_doorbells_tx") + elided ==
+          stat_counter(st, "completions"));
 
     conn.close();
     server.stop();
@@ -961,6 +1006,197 @@ static void test_ring_qos_ordering_and_trace() {
     server.stop();
 }
 
+static void test_ring_batch_slot_wrap() {
+    // Multi-op batch slots: a group_begin/end window packs every same-thread
+    // async op into ONE slot (RingBatchHdr + per-op RingBatchEntry frames in
+    // the slot's meta arena), and the batch format must survive cursor wrap
+    // on a tiny ring exactly like the single-op format — byte-correct, every
+    // op CQE'd under token base+k, both sides' batch ledgers in lockstep.
+    Server server(ring_scfg());
+    CHECK(server.start());
+    Connection conn(ring_ccfg(server.port(), /*ring_slots=*/4));
+    CHECK(conn.connect() == 0);
+    CHECK(conn.ring_active());
+
+    const size_t per = 4, rounds = 12, bs = 16 << 10;  // 12 slots / 4 = 3 wraps
+    char* seg = static_cast<char*>(conn.alloc_shm_mr(per * rounds * bs));
+    CHECK(seg != nullptr);
+    for (size_t i = 0; i < per * rounds * bs; i++)
+        seg[i] = static_cast<char>(i * 13 + 5);
+    std::atomic<int> done{0};
+    auto cb = [](void* ctx, int c) {
+        if (c == 200) static_cast<std::atomic<int>*>(ctx)->fetch_add(1);
+    };
+    for (size_t r = 0; r < rounds; r++) {
+        conn.ring_group_begin();
+        for (size_t i = 0; i < per; i++) {
+            size_t k = r * per + i;
+            CHECK(conn.put_batch_async({"bw" + std::to_string(k)}, {k * bs}, bs,
+                                       seg, cb, &done) == 0);
+        }
+        uint64_t mid = 1;
+        conn.ring_counters(&mid, nullptr, nullptr, nullptr, nullptr);
+        CHECK(mid == r * per);  // captured, not posted, until the window closes
+        conn.ring_group_end();
+        for (int w = 0; w < 2500 && done.load() < static_cast<int>((r + 1) * per);
+             w++)
+            std::this_thread::sleep_for(std::chrono::milliseconds(2));
+        CHECK(done.load() == static_cast<int>((r + 1) * per));
+    }
+    uint64_t posted = 0, full = 0, meta = 0, comps = 0;
+    conn.ring_counters(&posted, nullptr, &full, &meta, &comps);
+    CHECK(posted == rounds * per && comps == rounds * per);
+    CHECK(full == 0 && meta == 0);
+    uint64_t bslots = 0, bops = 0;
+    conn.ring_poll_counters(&bslots, &bops, nullptr, nullptr);
+    CHECK(bslots == rounds);       // one slot per flush window...
+    CHECK(bops == rounds * per);   // ...carrying the whole window's ops
+    std::string st = server.stats_json();
+    CHECK(stat_counter(st, "descriptors") == static_cast<long long>(rounds * per));
+    CHECK(stat_counter(st, "batch_slots") == static_cast<long long>(rounds));
+    CHECK(stat_counter(st, "batch_ops") == static_cast<long long>(rounds * per));
+    CHECK(stat_counter(st, "torn_descriptors") == 0);
+    CHECK(stat_counter(st, "bad_descriptors") == 0);
+
+    // Read-back through one sync multi-key get (sync ops never join a batch
+    // window — the waiter would block before the window could flush).
+    std::vector<char> want(seg, seg + per * rounds * bs);
+    std::vector<std::string> keys;
+    std::vector<uint64_t> offs;
+    for (size_t k = 0; k < per * rounds; k++) {
+        keys.push_back("bw" + std::to_string(k));
+        offs.push_back(k * bs);
+    }
+    memset(seg, 0, per * rounds * bs);
+    CHECK(conn.get_batch(keys, offs, bs, seg) == 0);
+    CHECK(memcmp(seg, want.data(), per * rounds * bs) == 0);
+    uint64_t bslots2 = 0;
+    conn.ring_poll_counters(&bslots2, nullptr, nullptr, nullptr);
+    CHECK(bslots2 == bslots);
+
+    conn.close();
+    server.stop();
+}
+
+static void test_ring_batch_slot_torn_rejected() {
+    // Malformed batch slots: a correctly published (gen-tagged) slot whose
+    // batch payload is garbage must be rejected with error CQEs — counted as
+    // bad_descriptors, never decoded into ops. An untrustworthy header
+    // (count out of range) can only fail the base token; a trustworthy count
+    // with truncated entries fails every token in the group. Either way the
+    // client sees a completion for a token it never issued and fails the
+    // connection — the same containment as a torn generation tag.
+    for (int variant = 0; variant < 2; variant++) {
+        Server server(ring_scfg());
+        CHECK(server.start());
+        Connection conn(ring_ccfg(server.port(), /*ring_slots=*/8));
+        CHECK(conn.connect() == 0);
+        CHECK(conn.ring_active());
+        std::string name = conn.ring_name();
+        CHECK(!name.empty());
+
+        int fd = shm_open(name.c_str(), O_RDWR, 0);
+        CHECK(fd >= 0);
+        struct stat stbuf {};
+        CHECK(fstat(fd, &stbuf) == 0);
+        void* mem = mmap(nullptr, static_cast<size_t>(stbuf.st_size),
+                         PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+        CHECK(mem != MAP_FAILED);
+        ::close(fd);
+        RingView view;
+        CHECK(ring_view_init(&view, static_cast<char*>(mem),
+                             static_cast<uint64_t>(stbuf.st_size)));
+        uint64_t seq = ring_load_acq(&view.ctrl->sq_tail);
+        // variant 0: count=0 — header untrustworthy, one error CQE on the
+        // base token. variant 1: count=3 but zero entry bytes behind the
+        // header — all three tokens error-CQE'd.
+        RingBatchHdr hdr{static_cast<uint16_t>(variant == 0 ? 0 : 3), 0};
+        memcpy(view.meta_at(seq), &hdr, sizeof(hdr));
+        RingSlot* s = view.slot(seq);
+        s->token = 0xdead0000;
+        s->meta_len = sizeof(RingBatchHdr);
+        s->op = 0;
+        s->flags = kRingSlotFlagBatch;
+        s->reserved = 0;
+        ring_store_rel(&s->gen, seq + 1);
+        ring_store_rel(&view.ctrl->sq_tail, seq + 1);
+
+        bool dead = false;
+        for (int i = 0; i < 2500 && !dead; i++) {
+            conn.check_exist("poke");  // outcome irrelevant — generates events
+            dead = !conn.connected();
+            std::this_thread::sleep_for(std::chrono::milliseconds(2));
+        }
+        CHECK(dead);  // the unknown-token CQE poisons the client side
+        std::string st = server.stats_json();
+        CHECK(stat_counter(st, "bad_descriptors") == (variant == 0 ? 1 : 3));
+        CHECK(stat_counter(st, "torn_descriptors") == 0);
+        CHECK(stat_counter(st, "batch_slots") == 0);  // malformed != batched
+        munmap(mem, static_cast<size_t>(stbuf.st_size));
+        conn.close();
+        server.stop();
+    }
+}
+
+static void test_ring_batch_slot_qos_ordering() {
+    // QoS across ONE batch slot: the server decodes the whole slot before
+    // starting any op and queues per priority class, so a foreground op
+    // packed BEHIND background ops in the same slot still starts first —
+    // batching must not flatten priorities into slot order.
+    Server server(ring_scfg());
+    CHECK(server.start());
+    Connection conn(ring_ccfg(server.port(), /*ring_slots=*/16));
+    CHECK(conn.connect() == 0);
+    CHECK(conn.ring_active());
+
+    constexpr size_t nbg = 3;
+    const size_t nblk = 64, bs = 16 << 10;  // 1MB per bg op = 8 default slices
+    char* seg = static_cast<char*>(conn.alloc_shm_mr((nbg * nblk + 1) * bs));
+    CHECK(seg != nullptr);
+    memset(seg, 'b', (nbg * nblk + 1) * bs);
+    static std::atomic<int> g_bseq_next;
+    static std::atomic<int> g_bseq[nbg + 1];
+    g_bseq_next.store(0);
+    for (auto& s : g_bseq) s.store(-1);
+    auto cb = [](void* ctx, int c) {
+        if (c == 200)
+            static_cast<std::atomic<int>*>(ctx)->store(g_bseq_next.fetch_add(1));
+    };
+    conn.ring_group_begin();
+    for (size_t b = 0; b < nbg; b++) {
+        std::vector<std::string> keys;
+        std::vector<uint64_t> offs;
+        for (size_t i = 0; i < nblk; i++) {
+            keys.push_back("bq" + std::to_string(b) + "_" + std::to_string(i));
+            offs.push_back((b * nblk + i) * bs);
+        }
+        CHECK(conn.put_batch_async(keys, offs, bs, seg, cb, &g_bseq[b],
+                                   kPriorityBackground) == 0);
+    }
+    CHECK(conn.put_batch_async({"bqfg"}, {nbg * nblk * bs}, bs, seg, cb,
+                               &g_bseq[nbg], kPriorityForeground) == 0);
+    conn.ring_group_end();
+    for (int i = 0; i < 2500 && g_bseq_next.load() < static_cast<int>(nbg) + 1; i++)
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    CHECK(g_bseq_next.load() == static_cast<int>(nbg) + 1);
+    // The slot lands whole, so nothing can be running when the fg op is
+    // queued: foreground completes strictly first, background keeps FIFO.
+    CHECK(g_bseq[nbg].load() == 0);
+    for (size_t b = 0; b < nbg; b++)
+        CHECK(g_bseq[b].load() == static_cast<int>(b) + 1);
+
+    uint64_t bslots = 0, bops = 0;
+    conn.ring_poll_counters(&bslots, &bops, nullptr, nullptr);
+    CHECK(bslots == 1 && bops == nbg + 1);
+    std::string st = server.stats_json();
+    CHECK(stat_counter(st, "batch_slots") == 1);
+    CHECK(stat_counter(st, "batch_ops") == static_cast<long long>(nbg) + 1);
+    CHECK(stat_counter(st, "bg_ops") >= static_cast<long long>(nbg));
+
+    conn.close();
+    server.stop();
+}
+
 static void test_opstats_percentile_accuracy() {
     // The HDR-style histogram must report percentiles within ~3% — 32
     // sub-buckets per octave (kSubBits=5, ~2.2% quantization) feed both
@@ -1011,6 +1247,7 @@ int main() {
     test_mempool_basic();
     test_mempool_exhaustion_and_rollback();
     test_kvstore_lru_eviction();
+    test_kvstore_overwrite_slot();
     test_spill_tier_demote_promote();
     test_wire_codec_roundtrip();
     test_qos_wire_priority_tag();
@@ -1023,6 +1260,9 @@ int main() {
     test_ring_doorbell_coalescing();
     test_ring_torn_descriptor_rejected();
     test_ring_qos_ordering_and_trace();
+    test_ring_batch_slot_wrap();
+    test_ring_batch_slot_torn_rejected();
+    test_ring_batch_slot_qos_ordering();
     test_loopback_end_to_end(/*enable_shm=*/true);
     test_loopback_end_to_end(/*enable_shm=*/false);
     test_completion_ring(/*enable_shm=*/true);

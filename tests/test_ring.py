@@ -104,7 +104,14 @@ def test_ring_disabled_degrades_to_socket_path(server):
         arr[:] = 0
         conn.read_cache(blocks, BLOCK, ptr)
         assert (arr == 0x21).all()
-        assert all(v == 0 for k, v in conn.ring_stats().items())
+        cs = conn.ring_stats()
+        assert all(v == 0 for k, v in cs.items())
+        # The batch/poll ledger keys exist (pinned 0) even with the ring
+        # off — dashboards never see the vocabulary appear mid-flight.
+        assert {
+            "ring_batch_slots", "ring_batch_ops", "ring_batch_ops_per_slot",
+            "ring_poll_hits", "ring_poll_arms", "ring_batch_windows",
+        } <= set(cs)
     finally:
         conn.close()
 
@@ -144,7 +151,9 @@ def test_ring_off_leaves_socket_protocol_untouched(server):
         assert st["ring"] == {
             "attached": 0, "conns": 0, "descriptors": 0, "doorbells_rx": 0,
             "cq_doorbells_tx": 0, "completions": 0, "bad_descriptors": 0,
-            "torn_descriptors": 0, "sq_depth": 0, "pending": 0,
+            "torn_descriptors": 0, "batch_slots": 0, "batch_ops": 0,
+            "poll_hits": 0, "poll_arms": 0, "doorbell_elided": 0,
+            "sq_depth": 0, "pending": 0,
         }
         # The ops really ran — over the segment opcodes, not the ring.
         ops = st["ops"]
@@ -169,12 +178,41 @@ def test_wire_encodings_byte_stable():
     assert d.name == "/its.1.ring" and d.size == 4096
 
 
+def test_ring_batch_layout_byte_stable():
+    """The batch-slot frame (RingBatchHdr + per-op RingBatchEntry +
+    SegBatchMeta) is shared memory the native server decodes raw — pin the
+    exact bytes ``ring_batch_encode`` (the reference encoding the native
+    client's ring_group_end mirrors) produces so a drive-by field edit
+    fails loudly, plus the op-count bounds."""
+    m1 = wire.SegBatchMeta(block_size=4096, seg_id=7, keys=["k"], offsets=[65536])
+    m2 = wire.SegBatchMeta(block_size=4096, seg_id=7, keys=["k2"], offsets=[0])
+    b = wire.ring_batch_encode(
+        [(wire.OP_PUT_FROM, m1.encode()), (wire.OP_GET_INTO, m2.encode())]
+    )
+    assert b.hex() == (
+        "0200000019000000460000000010000007000100000001006b0100000000000100"
+        "000000001a000000490000000010000007000100000002006b3201000000000000"
+        "0000000000"
+    )
+    # hdr.count little-endian up front; each entry leads with meta_len.
+    assert b[:2] == (2).to_bytes(2, "little")
+    assert b[4:8] == len(m1.encode()).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        wire.ring_batch_encode([])
+    with pytest.raises(ValueError):
+        wire.ring_batch_encode(
+            [(wire.OP_PUT_FROM, b"")] * (wire.RING_BATCH_MAX_OPS + 1)
+        )
+
+
 def test_ring_geometry_helpers_match_native_layout():
     """wire.py's geometry mirror must agree with native ring.h: struct
     sizes via the packed formats, offsets via the 64-byte-aligned walk."""
     assert wire._RING_CTRL.size == 72
     assert wire._RING_SLOT.size == 24
     assert wire._RING_CQE.size == 32
+    assert wire._RING_BATCH_HDR.size == 4
+    assert wire._RING_BATCH_ENTRY.size == 8
     assert wire.ring_sq_off() == wire.RING_CTRL_SPAN
     assert wire.ring_cq_off(64) == 4096 + 64 * 24
     assert wire.ring_meta_off(64, 64) == 4096 + 64 * 24 + 64 * 32
@@ -224,6 +262,107 @@ def test_ring_full_backpressure_is_counted_fallback(server):
         assert (arr == 0x33).all()
     finally:
         conn.close()
+
+
+def test_batch_window_packs_flush_into_one_slot(server):
+    """The flush-coalescing contract end-to-end: every async op submitted
+    in one event-loop tick rides ONE multi-op batch slot — K-op flush, one
+    descriptor, one doorbell per doze — and the eager
+    ``ring_batch_window()`` hint (what FetchCoalescer._flush calls) is
+    counted."""
+    conn = _connect(server.port)
+    try:
+        assert conn.ring_active
+        n = 8
+        arr, ptr, blocks = _seg_blocks(conn, n)
+        arr[:] = 0x44
+
+        async def flush():
+            conn.ring_batch_window()  # the coalescer's eager hint
+            await asyncio.gather(*[
+                conn.write_cache_async([blk], BLOCK, ptr) for blk in blocks
+            ])
+
+        asyncio.run(flush())
+        cs = conn.ring_stats()
+        assert cs["ring_posted"] == n
+        assert cs["ring_batch_slots"] == 1
+        assert cs["ring_batch_ops"] == n
+        assert cs["ring_batch_ops_per_slot"] == float(n)
+        assert cs["ring_batch_windows"] == 1
+        assert cs["ring_full_fallbacks"] == 0
+        ring = conn.get_stats()["ring"]
+        assert ring["batch_slots"] == 1
+        assert ring["batch_ops"] == n
+        assert ring["descriptors"] == n
+        # The bytes all landed (one sync read — a plain, non-batch slot).
+        arr[:] = 0
+        conn.read_cache(blocks, BLOCK, ptr)
+        assert (arr == 0x44).all()
+        assert conn.ring_stats()["ring_batch_slots"] == 1  # sync never joins
+    finally:
+        conn.close()
+
+
+def test_batch_arena_overflow_matrix():
+    """Oversized descriptor bodies degrade exactly like the single-op path
+    promised: a pair of ops too big to SHARE a slot splits the flush (the
+    lone one posts as a plain slot, the rest still batch), and a single op
+    whose body exceeds the whole 128KB arena stride rides the socket as a
+    counted meta fallback — never an error."""
+    srv = its.start_local_server(prealloc_bytes=96 << 20, block_bytes=4096)
+    conn = _connect(srv.port)
+    try:
+        assert conn.ring_active
+        stride = wire.RING_META_STRIDE
+
+        def body_len(nkeys):
+            keys = [f"m{j:05d}" for j in range(nkeys)]
+            m = wire.SegBatchMeta(
+                block_size=512, seg_id=0, keys=keys, offsets=[0] * nkeys
+            )
+            return len(m.encode())
+
+        # Two "big" ops: each fits a slot alone, two never share one.
+        nbig = 4600
+        assert 12 + body_len(nbig) <= stride
+        assert 4 + 2 * (8 + body_len(nbig)) > stride
+        arr = conn.alloc_shm_mr(4096)
+        ptr = arr.ctypes.data
+
+        def blks(tag, nkeys):
+            # Puts read from the segment: offsets may overlap, so one page
+            # backs arbitrarily many keys.
+            return [(f"{tag}{j:05d}", 0) for j in range(nkeys)]
+
+        async def mixed():
+            await asyncio.gather(
+                conn.write_cache_async(blks("b1_", nbig), 512, ptr),
+                conn.write_cache_async(blks("b2_", nbig), 512, ptr),
+                conn.write_cache_async([("s1", 0)], 512, ptr),
+                conn.write_cache_async([("s2", 0)], 512, ptr),
+            )
+
+        asyncio.run(mixed())
+        cs = conn.ring_stats()
+        assert cs["ring_posted"] == 4          # every op still rode the ring
+        assert cs["ring_batch_slots"] == 1     # big2 + s1 + s2
+        assert cs["ring_batch_ops"] == 3       # big1 split off as a plain slot
+        assert cs["ring_meta_fallbacks"] == 0
+        assert cs["ring_full_fallbacks"] == 0
+
+        # One op whose body alone exceeds the arena stride: counted meta
+        # fallback onto the socket path, op succeeds.
+        nhuge = 9100
+        assert body_len(nhuge) > stride
+        conn.write_cache(blks("h", nhuge), 512, ptr)
+        cs = conn.ring_stats()
+        assert cs["ring_meta_fallbacks"] == 1
+        assert cs["ring_posted"] == 4          # unchanged — socket carried it
+        assert conn.check_exist(f"h{nhuge - 1:05d}")
+    finally:
+        conn.close()
+        srv.stop()
 
 
 def test_torn_descriptor_poisons_connection(server):
@@ -321,6 +460,13 @@ def test_metrics_renders_ring_family(server):
         assert "infinistore_ring_torn_descriptors 0" in text
         assert "infinistore_ring_sq_depth 0" in text
         assert "infinistore_ring_pending 0" in text
+        # Batch + adaptive-poll mechanism families (values are
+        # timing-dependent; one sync op batches nothing).
+        assert "infinistore_ring_batch_slots 0" in text
+        assert "infinistore_ring_batch_ops 0" in text
+        assert "infinistore_ring_poll_hits" in text
+        assert "infinistore_ring_poll_arms" in text
+        assert "infinistore_ring_doorbell_elided" in text
     finally:
         conn.close()
 
@@ -340,6 +486,11 @@ def test_top_renders_ring_row():
             'infinistore_ring_doorbells{dir="tx"}': 8.0,
             "infinistore_ring_bad_descriptors": 0.0,
             "infinistore_ring_torn_descriptors": 0.0,
+            "infinistore_ring_batch_slots": 64.0,
+            "infinistore_ring_batch_ops": 512.0,
+            "infinistore_ring_poll_hits": 100.0,
+            "infinistore_ring_poll_arms": 4.0,
+            "infinistore_ring_doorbell_elided": 600.0,
         },
     }
     lines = render(frame)
@@ -350,9 +501,20 @@ def test_top_renders_ring_row():
     assert "descs=640" in row and "rx=16" in row and "tx=8" in row
     assert "descs/db=40.0" in row  # the coalescing ratio
 
-    # No ring conns -> no row (a socket-only fleet stays uncluttered).
+    # The batch/poll mechanism line rides directly under the ring row.
+    batch_rows = [ln for ln in lines if "batch slots=" in ln]
+    assert len(batch_rows) == 1
+    brow = batch_rows[0]
+    assert "slots=64" in brow and "ops=512" in brow
+    assert "ops/slot=8.0" in brow  # the flush-coalescing ratio
+    assert "poll hit=100" in brow and "arm=4" in brow
+    assert "db_elided=600" in brow
+
+    # No ring conns -> no rows (a socket-only fleet stays uncluttered).
     frame["metrics"] = {"infinistore_ring_conns": 0.0}
-    assert not [ln for ln in render(frame) if ln.startswith("ring ")]
+    quiet = render(frame)
+    assert not [ln for ln in quiet if ln.startswith("ring ")]
+    assert not [ln for ln in quiet if "batch slots=" in ln]
 
 
 def test_striped_connection_aggregates_ring_stats(server):
@@ -371,5 +533,10 @@ def test_striped_connection_aggregates_ring_stats(server):
         st = conn.ring_stats()
         assert st["ring_posted"] >= 1
         assert st["ring_completions"] == st["ring_posted"]
+        # The batch/poll ledger aggregates across stripes too.
+        assert {
+            "ring_batch_slots", "ring_batch_ops", "ring_batch_ops_per_slot",
+            "ring_poll_hits", "ring_poll_arms", "ring_batch_windows",
+        } <= set(st)
     finally:
         conn.close()

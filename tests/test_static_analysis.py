@@ -86,6 +86,7 @@ class TestWireDrift:
         assert cpp.header_asserts == {
             "ReqHeader": 9, "RespHeader": 16,
             "RingCtrl": 72, "RingSlot": 24, "RingCqe": 32,
+            "RingBatchHdr": 4, "RingBatchEntry": 8,
         }
         for name in ("BatchMeta", "SegBatchMeta", "ShmLocResp", "SegMeta",
                      "RingMeta", "TcpPutMeta", "TicketMeta", "KeyMeta",
@@ -93,7 +94,8 @@ class TestWireDrift:
             assert name in cpp.structs and name in py.structs
         # The mapped ring structs are parsed on BOTH representations: packed
         # width sequences (W004) and named-field layouts (W005).
-        for name in ("RingCtrl", "RingSlot", "RingCqe"):
+        for name in ("RingCtrl", "RingSlot", "RingCqe", "RingBatchHdr",
+                     "RingBatchEntry"):
             assert name in cpp.headers and name in py.headers
             assert name in py.ring_layouts
             assert py.ring_layouts[name] == [
@@ -207,6 +209,29 @@ class TestWireDrift:
         # And the width diff alone would indeed have stayed silent.
         assert not any(
             f.rule == "ITS-W004" and "RingCtrl" in f.message for f in found
+        )
+
+    def test_batch_entry_same_width_field_swap_is_caught(self, tmp_path):
+        """Same gap, new struct: swapping the two u8s of a batch-slot entry
+        (op <-> flags) keeps the width sequence AND the static_assert sum
+        identical — only the named-field layout diff (W005) can see the
+        server decoding every batched op's opcode from the flags byte."""
+        ctx = drifted_ctx(tmp_path, header_sub=(
+            # Anchored through RingBatchEntry's unique meta_len comment —
+            # RingSlot carries byte-identical op/flags lines.
+            "    uint32_t meta_len;  // SegBatchMeta bytes following this entry\n"
+            "    uint8_t op;         // kOpPutFrom or kOpGetInto\n"
+            "    uint8_t flags;      // reserved (0)",
+            "    uint32_t meta_len;  // SegBatchMeta bytes following this entry\n"
+            "    uint8_t flags;      // reserved (0)\n"
+            "    uint8_t op;         // kOpPutFrom or kOpGetInto",
+        ))
+        found = wire_drift.compare(ctx)
+        assert any(
+            f.rule == "ITS-W005" and "RingBatchEntry" in f.message for f in found
+        )
+        assert not any(
+            f.rule == "ITS-W004" and "RingBatchEntry" in f.message for f in found
         )
 
     def test_ring_width_change_is_caught_by_both(self, tmp_path):

@@ -50,6 +50,13 @@ NONBLOCKING_NATIVE = {
     "its_conn_set_completion_fd",
     "its_conn_drain_completions",
     "its_conn_completion_counters",
+    "its_conn_ring_poll_counters",
+    # Tick-group bracketing (docs/descriptor_ring.md, batch-slot section):
+    # begin marks the calling thread as the group owner; end publishes the
+    # captured descriptors into the mapped ring (memcpy into the slot
+    # arena) — neither ever waits on the store.
+    "its_conn_ring_group_begin",
+    "its_conn_ring_group_end",
     "its_conn_shm_active",
     "its_conn_connected",
     "its_server_port",
@@ -94,6 +101,10 @@ AUDITED = {
     ("infinistore_tpu/lib.py", "InfinityConnection._semaphore"):
         "per-loop semaphore registry: lock taken once per loop lifetime "
         "(slow path); steady state is a lock-free dict read",
+    ("infinistore_tpu/lib.py", "InfinityConnection._ring_await"):
+        "adaptive bridge poll: the lock brackets one non-blocking native "
+        "ring drain (same op _drain_ready does per wakeup), bounded by a "
+        "sub-millisecond budget and yielding every iteration",
 }
 
 

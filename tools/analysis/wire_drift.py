@@ -273,6 +273,8 @@ _PY_HEADER_NAMES = {
     "_RING_CTRL": "RingCtrl",
     "_RING_SLOT": "RingSlot",
     "_RING_CQE": "RingCqe",
+    "_RING_BATCH_HDR": "RingBatchHdr",
+    "_RING_BATCH_ENTRY": "RingBatchEntry",
 }
 
 

@@ -279,12 +279,28 @@ def render(frame: dict, width: int = 100, ascii_only=None) -> list:
             bad = fam.get("infinistore_ring_bad_descriptors", 0)
             torn = fam.get("infinistore_ring_torn_descriptors", 0)
             coalesce = f"{descs / db_rx:.1f}" if db_rx else "-"
+            # Batch-slot + adaptive-poll mechanism counters (PR 16): ops
+            # per multi-op slot (high = flushes coalescing well), poll
+            # windows that caught work vs parked, and doorbells the server
+            # skipped because the client was awake polling.
+            bslots = fam.get("infinistore_ring_batch_slots", 0)
+            bops = fam.get("infinistore_ring_batch_ops", 0)
+            ops_per_slot = f"{bops / bslots:.1f}" if bslots else "-"
+            phits = fam.get("infinistore_ring_poll_hits", 0)
+            parms = fam.get("infinistore_ring_poll_arms", 0)
+            elided = fam.get("infinistore_ring_doorbell_elided", 0)
             lines.append(
                 f"ring  conns={rconns:.0f}  "
                 f"sq_depth={fam.get('infinistore_ring_sq_depth', 0):.0f}  "
                 f"pending={fam.get('infinistore_ring_pending', 0):.0f}  "
                 f"descs={descs:.0f}  db rx={db_rx:.0f} tx={db_tx:.0f}  "
                 f"descs/db={coalesce}  bad={bad:.0f} torn={torn:.0f}"
+            )
+            lines.append(
+                f"      batch slots={bslots:.0f} ops={bops:.0f} "
+                f"ops/slot={ops_per_slot}  "
+                f"poll hit={phits:.0f} arm={parms:.0f}  "
+                f"db_elided={elided:.0f}"
             )
 
     # Metrics-history sparklines (docs/observability.md, time-series
