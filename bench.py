@@ -3059,6 +3059,253 @@ def _recovery_metrics(its, np) -> dict:
     return out
 
 
+def _disagg_metrics(its, np) -> dict:
+    """Overlapped prefill->decode handoff (docs/disaggregation.md): TTFT
+    for four legs of the SAME request against a real prefill-engine
+    subprocess streaming layerwise KV through the store:
+
+    - ``overlap``  — watermark=1: decode layer l waits only on layer l's
+      install; the first step launches with later layers still in flight.
+    - ``blocking`` — watermark=L: fetch/install ride the same announce
+      stream, but the first step waits for the full prefix (today's
+      fetch-all admission).
+    - ``cold``     — store-and-forward: wait for the producer's ``done``,
+      then fetch-all, install, decode (the pre-announce world).
+    - ``local``    — no store: recompute the prefix where decode runs.
+
+    The prefill subprocess PACES its per-layer ships (emulating a
+    dedicated prefill engine's production rate — stream_prefill docstring:
+    on this shared-core host an un-paced producer time-slices against the
+    decode process and the comparison measures scheduler contention, not
+    pipeline overlap; the bytes/keys/announce protocol stay fully real and
+    the leg byte-checks the overlapped decode against the local oracle).
+
+    Ratios ride the weather rule: order-alternating paired rounds,
+    min-of-reps per leg per round (scheduler-noise floor), estimator
+    min(median-of-ratios, ratio-of-sums), pooling more pairs while a
+    reading is below 1.0. Gated in tools/bench_check.py: both ratios
+    > 1.0, first token with >= 1 layer in flight, 0 wrong bytes, 0
+    fallbacks on the clean legs.
+
+    Satellite receipt: the harness's heterogeneous prompt lengths (1..4
+    blocks, cycled) drive the continuous-batching engine's ragged decode
+    waves — ``disagg_wave_pad_fraction`` is ``wave_pad_fraction`` under
+    the disagg workload."""
+    import asyncio
+
+    from infinistore_tpu import disagg
+    from infinistore_tpu.connector import KVConnector
+    from infinistore_tpu.engine import (
+        ContinuousBatchingHarness,
+        EngineKVAdapter,
+    )
+
+    # Frozen leg config (measured on this host): L=16 layers deep enough
+    # that the hidden per-layer install+compute accumulates, dim=128 so a
+    # layer's decode compute is real but prefill's own compute stays small
+    # next to the 2.5ms/layer pace.
+    cfg = disagg.demo_config(
+        n_layers=16, block_tokens=8, dim=128, ffn_dim=512
+    )
+    blocks, pace_ms, reps, pairs, max_pairs = 4, 2.5, 4, 6, 10
+    srv = its.start_local_server(
+        prealloc_bytes=512 << 20,
+        block_bytes=max(64 << 10, cfg.kv_spec(1).block_nbytes),
+    )
+
+    def mk():
+        c = its.InfinityConnection(
+            its.ClientConfig(
+                host_addr="127.0.0.1", service_port=srv.port,
+                log_level="error",
+            )
+        )
+        c.connect()
+        return c
+
+    ds = disagg.reset_counters()
+    h = disagg.DisaggHarness(
+        mk, cfg, num_blocks=4 * blocks, req_blocks=blocks
+    )
+    out = {}
+    try:
+
+        async def drive() -> dict:
+            proc = await disagg.PrefillProcess.spawn(
+                srv.port, blocks=blocks, n_layers=cfg.n_layers,
+                block_tokens=cfg.block_tokens, dim=cfg.dim,
+                ffn_dim=cfg.ffn_dim, pace_ms=pace_ms,
+            )
+            legs = {
+                "overlap": dict(watermark=1),
+                "blocking": dict(watermark=cfg.n_layers),
+                "cold": dict(cold=True),
+            }
+            try:
+                # Compile/warm every leg once (both processes jit the
+                # layer programs on first use), then the byte receipt:
+                # the overlapped decode must be bitwise the local oracle.
+                seed = 9000
+                for kw in legs.values():
+                    seed += 1
+                    r = await h.run_proc(proc, seed, **kw)
+                    assert not r["result"].fallback, "fallback in warmup"
+                    h.drop(h.prompt(seed=seed))
+                seed += 1
+                got = await h.run_proc(proc, seed, watermark=1)
+                oracle = await h.run_local(h.prompt(seed=seed))
+                assert h.check_bytes(got["result"], oracle["result"]), (
+                    "overlapped decode diverged from the local oracle"
+                )
+                h.drop(h.prompt(seed=seed))
+                await h.run_local(h.prompt(seed=0))  # warm the local leg
+
+                sums = {k: 0.0 for k in ("overlap", "blocking", "cold")}
+                ratios = {"blocking": [], "cold": []}
+                times = {k: [] for k in ("overlap", "blocking", "cold")}
+                local_times = []
+                overlap_layers = []
+                inflight = []
+                flip = [0]
+                seeds = [0]
+
+                async def one_leg(tag) -> float:
+                    best = float("inf")
+                    for _ in range(reps):
+                        seeds[0] += 1
+                        s = seeds[0]
+                        if tag == "local":
+                            r = await h.run_local(h.prompt(seed=s))
+                        else:
+                            r = await h.run_proc(proc, s, **legs[tag])
+                            assert not r["result"].fallback
+                            h.drop(h.prompt(seed=s))
+                        best = min(best, r["ttft_s"])
+                        if tag == "overlap":
+                            overlap_layers.append(
+                                r["result"].overlap_layers
+                            )
+                            inflight.append(
+                                r["result"].inflight_at_first_token
+                            )
+                    return best
+
+                async def one_pair():
+                    flip[0] ^= 1
+                    order = ("overlap", "blocking", "cold")
+                    if flip[0]:
+                        order = order[::-1]
+                    sample = {}
+                    for tag in order:
+                        sample[tag] = await one_leg(tag)
+                    for tag, v in sample.items():
+                        sums[tag] += v
+                        times[tag].append(v)
+                    ratios["blocking"].append(
+                        sample["blocking"] / sample["overlap"]
+                    )
+                    ratios["cold"].append(
+                        sample["cold"] / sample["overlap"]
+                    )
+                    local_times.append(await one_leg("local"))
+
+                def estimate(tag) -> float:
+                    rs = ratios[tag]
+                    med = sorted(rs)[len(rs) // 2]
+                    return min(med, sums[tag] / sums["overlap"])
+
+                for _ in range(pairs):
+                    await one_pair()
+                while (
+                    min(estimate("blocking"), estimate("cold")) < 1.0
+                    and len(ratios["blocking"]) < max_pairs
+                ):
+                    await one_pair()
+
+                med = lambda xs: sorted(xs)[len(xs) // 2]
+                return {
+                    "disagg_ttft_overlap_ms": round(
+                        1e3 * med(times["overlap"]), 2
+                    ),
+                    "disagg_ttft_blocking_ms": round(
+                        1e3 * med(times["blocking"]), 2
+                    ),
+                    "disagg_ttft_cold_ms": round(
+                        1e3 * med(times["cold"]), 2
+                    ),
+                    "disagg_ttft_local_ms": round(
+                        1e3 * med(local_times), 2
+                    ),
+                    "disagg_ttft_overlap_vs_blocking": round(
+                        estimate("blocking"), 3
+                    ),
+                    "disagg_ttft_handoff_vs_cold": round(
+                        estimate("cold"), 3
+                    ),
+                    "disagg_ttft_pairs": len(ratios["blocking"]),
+                    # Mechanism receipts: every overlapped round must
+                    # have issued its first token with layers still in
+                    # flight (min over rounds — one degenerate round is
+                    # a regression, not weather).
+                    "disagg_overlap_layers": min(overlap_layers),
+                    "disagg_inflight_at_first_token": min(inflight),
+                }
+            finally:
+                await proc.close()
+
+        out.update(asyncio.run(drive()))
+
+        # Heterogeneous-length disagg workload -> ragged decode waves:
+        # the engine harness runs the DisaggHarness's mixed 1..4-block
+        # prompts with a block of generation each; wave_pad_fraction is
+        # the ragged assembly's padding share under that skew.
+        async def waves() -> dict:
+            import jax
+
+            from infinistore_tpu.models import init_params
+
+            wcfg = disagg.demo_config(
+                n_layers=4, block_tokens=8, dim=128, ffn_dim=512
+            )
+            conn = mk()
+            try:
+                # +1 block over the longest prompt: room for the block of
+                # generation the decode waves produce.
+                kvc = KVConnector(
+                    conn, wcfg.kv_spec(64), "disagg-wave",
+                    max_blocks=blocks + 1,
+                )
+                eng = ContinuousBatchingHarness(
+                    EngineKVAdapter(kvc),
+                    init_params(wcfg, jax.random.PRNGKey(0)),
+                    wcfg, 64, blocks + 1,
+                )
+                prompts = h.heterogeneous_prompts(8, seed=5)
+                m = await eng.run(
+                    prompts, concurrency=8,
+                    gen_tokens=wcfg.block_tokens,
+                )
+                return {
+                    "disagg_wave_pad_fraction": round(
+                        m["wave_pad_fraction"], 4
+                    ),
+                    "disagg_wave_requests": m["requests"],
+                }
+            finally:
+                conn.close()
+
+        out.update(asyncio.run(waves()))
+    finally:
+        # Counter ledger last, without clobbering the per-round receipts
+        # above (disagg_overlap_layers in the receipt is the MIN over
+        # measured rounds; the /metrics counter of the same name is
+        # cumulative).
+        for key, val in ds.status().items():
+            out.setdefault(key, val)
+        srv.stop()
+    return out
+
+
 def _run_check(files) -> int:
     """`bench.py --check RECEIPT.json [...]`: run the data-plane regression
     gate (tools/bench_check.py) over existing receipts instead of measuring.
@@ -3125,6 +3372,7 @@ def main(argv=None) -> int:
     churn = _membership_churn_metrics(its, np)
     tiering = _tiering_metrics(its, np)
     recovery = _recovery_metrics(its, np)
+    disagg = _disagg_metrics(its, np)
     try:
         tpu = _tpu_connector_gbps(its, np, conn)
         import jax
@@ -3354,6 +3602,15 @@ def main(argv=None) -> int:
         # save-path overhead is paired-interleaved gated <= 10%. All in
         # tools/bench_check.py.
         **recovery,
+        # Overlapped prefill->decode handoff (docs/disaggregation.md):
+        # TTFT of the watermark pipeline vs blocking fetch-all vs
+        # store-and-forward cold vs local recompute, against a REAL
+        # prefill-engine subprocess streaming layerwise KV (paced ships —
+        # _disagg_metrics docstring). Gated in tools/bench_check.py:
+        # overlap beats blocking AND cold under the weather rule, the
+        # first token is issued with layers still in flight, zero wrong
+        # bytes, zero fallback recomputes on the clean legs.
+        **disagg,
         "tpu_backend": backend,
     }
     if tpu is not None:

@@ -83,6 +83,16 @@ def __getattr__(name):
         from . import tiering
 
         return getattr(tiering, name)
+    if name in (
+        "DisaggHarness",
+        "DisaggCounters",
+        "stream_prefill",
+        "overlapped_decode",
+        "local_decode",
+    ):
+        from . import disagg
+
+        return getattr(disagg, name)
     if name in ("FaultRule", "FaultyConnection", "kill_transport"):
         from . import faults
 
@@ -114,6 +124,11 @@ __all__ = [
     "TierManager",
     "TemperatureSketch",
     "TIERS",
+    "DisaggHarness",
+    "DisaggCounters",
+    "stream_prefill",
+    "overlapped_decode",
+    "local_decode",
     "FaultRule",
     "FaultyConnection",
     "kill_transport",

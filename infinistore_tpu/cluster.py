@@ -64,7 +64,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from . import telemetry, tracing
+from . import telemetry, tracing, wire
 from .connector import KVConnector, token_chain_hashes
 from .lib import (
     InfiniStoreException,
@@ -2156,7 +2156,7 @@ class ClusterKVConnector:
 
     def stage_layer_save(
         self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
-        first_block: int = 0,
+        first_block: int = 0, priority: int = wire.PRIORITY_BACKGROUND,
     ):
         """Layer-granular save, routed: the whole request's blocks share a
         chain root, so every layer's put lands on the SAME serving member —
@@ -2183,7 +2183,8 @@ class ClusterKVConnector:
                 continue
             try:
                 ship = self.members[i].stage_layer_save(
-                    token_ids, layer, kv_pair, block_ids, first_block=first_block
+                    token_ids, layer, kv_pair, block_ids,
+                    first_block=first_block, priority=priority,
                 )
             except InfiniStoreException as e:
                 # The stage-time failure path (pool/register/gather against

@@ -567,6 +567,9 @@ class KVConnector:
         prefetch_pool: Optional[HostStagingPool] = None,
         priority: int = wire.PRIORITY_FOREGROUND,
         known_hit: Optional[int] = None,
+        retry_missing_s: float = 0.0,
+        retry_interval_s: float = 0.002,
+        fetch_gate=None,
     ) -> LayerwisePrefetch:
         """Begin the GATE-FREE half of a load: probe the store (one control
         round trip) and immediately start streaming the hit prefix's layers
@@ -588,6 +591,24 @@ class KVConnector:
         wave ``wire.PRIORITY_BACKGROUND`` so it never delays
         decode-blocking reads (docs/qos.md). Same-class submissions still
         coalesce; classes never merge.
+
+        ``retry_missing_s``: handoff read-racing-write mode (disagg.py).
+        A decode engine fetching a prefix the prefill engine is STILL
+        SHIPPING sees KeyNotFound for layers not yet published; with a
+        nonzero deadline the prefetch re-probes missing keys instead of
+        failing, so per-layer installs (``install_layer``) ride out the
+        race. Zero (the default) keeps strict cache semantics: absent
+        means miss. Retry mode bypasses the coalescer (each layer's reads
+        go direct) so one stalled layer never wedges merged group-mates.
+        ``retry_interval_s`` is the re-probe cadence — it bounds the
+        quantization latency a just-published layer waits before its
+        re-probe lands, so TTFT-critical handoffs pass a sub-millisecond
+        interval. ``fetch_gate`` (``async fetch_gate(layer)``) is the
+        announce-driven variant: when the producer signals per-layer
+        publication, layer ``l``'s read waits for the announcement instead
+        of blind-probing keys that cannot exist yet (a probe storm that
+        contends with the very ships it is waiting on). Gated fetches also
+        bypass the coalescer.
 
         Raises :class:`~.tpu.staging.StagingPoolExhausted` when the
         prefetch arena cannot hold another pipeline — callers treat that
@@ -619,12 +640,15 @@ class KVConnector:
         # Mutable class cell so promote() upgrades LATER submissions even
         # on the coalescer path (the closure reads it per call).
         pri_cell = {"value": priority}
-        if prefetch_pool is None:
+        if prefetch_pool is None and retry_missing_s <= 0 and fetch_gate is None:
             coalescer = self._ensure_coalescer(pool)
             submit = lambda blocks: coalescer.submit(
                 blocks, priority=pri_cell["value"]
             )
         else:
+            # Retry/gated modes go direct: a KeyNotFound re-probe loop (or
+            # an announcement wait) inside a merged batch would re-drive —
+            # or stall — its group-mates' reads too.
             submit = None
         try:
             handle = LayerwisePrefetch(
@@ -639,6 +663,9 @@ class KVConnector:
                 # One shared cell: promote() on the handle flips the class
                 # the coalescer closure reads too.
                 priority_cell=pri_cell,
+                retry_missing_s=retry_missing_s,
+                retry_interval_s=retry_interval_s,
+                fetch_gate=fetch_gate,
             )
         except StagingPoolExhausted as e:
             # The probe already ran — hand its answer to the fallback so a
@@ -656,6 +683,10 @@ class KVConnector:
         limit_blocks: Optional[int] = None,
         prefetch_pool: Optional[HostStagingPool] = None,
         priority: int = wire.PRIORITY_FOREGROUND,
+        known_hit: Optional[int] = None,
+        retry_missing_s: float = 0.0,
+        retry_interval_s: float = 0.002,
+        fetch_gate=None,
     ) -> LayerwisePrefetch:
         """:meth:`start_fetch` for event-loop callers: the probe (a full
         store round trip) runs in the default executor, then the handle is
@@ -663,14 +694,23 @@ class KVConnector:
         starts need the running loop, so ONLY the probe may leave it.
         Mid-wave admission (vllm_v1 phase 1, the engine's install path)
         calls this so one request's lookup RTT never stalls the wave's
-        other reads (ITS-L001, docs/static_analysis.md)."""
+        other reads (ITS-L001, docs/static_analysis.md).
+
+        ``known_hit`` skips the probe entirely — the overlapped handoff
+        path (disagg.py) passes the block count the prefill side announced,
+        because a store probe during an in-flight handoff would see only
+        the layers published so far (layer 0 ships FIRST there, and it IS
+        the sentinel, so the probe is also racy-optimistic)."""
         self._require_store("start_fetch")
-        hit = await asyncio.to_thread(
-            self._lookup_chains, self._chains(token_ids)
-        )
+        if known_hit is None:
+            known_hit = await asyncio.to_thread(
+                self._lookup_chains, self._chains(token_ids)
+            )
         return self.start_fetch(
             token_ids, first_block=first_block, limit_blocks=limit_blocks,
-            prefetch_pool=prefetch_pool, priority=priority, known_hit=hit,
+            prefetch_pool=prefetch_pool, priority=priority,
+            known_hit=known_hit, retry_missing_s=retry_missing_s,
+            retry_interval_s=retry_interval_s, fetch_gate=fetch_gate,
         )
 
     def _ensure_prefetch_pool(self) -> HostStagingPool:
@@ -694,7 +734,7 @@ class KVConnector:
 
     def stage_layer_save(
         self, token_ids, layer: int, kv_pair, block_ids: np.ndarray,
-        first_block: int = 0,
+        first_block: int = 0, priority: int = wire.PRIORITY_BACKGROUND,
     ):
         """Stage ONE layer's computed blocks for saving; returns ``ship``,
         an async callable performing the network puts (2*n blocks written).
@@ -709,7 +749,20 @@ class KVConnector:
         keys are the whole-block presence sentinel (``lookup``), so
         shipping it before deeper layers commit would publish a half-saved
         block. Whole-request saves should use ``save()``, whose writer
-        enforces that ordering internally."""
+        enforces that ordering internally.
+
+        ``priority``: QoS class of the puts (docs/qos.md). Layer-streamed
+        saves default BACKGROUND — they run behind the engine's forward
+        pass and must never delay a decode-blocking fetch. A prefill→decode
+        HANDOFF ship passes ``wire.PRIORITY_FOREGROUND``: its consumer is
+        actively waiting on these exact bytes (disagg.py), so background
+        class would delay the reader it feeds. Disagg producers must name
+        the class explicitly at the call site (ITS-P004,
+        docs/static_analysis.md).
+
+        Tracing: the CALLER's active span (captured now, not at ship time)
+        rides the ship — one trace id covers prefill compute → store puts →
+        decode install. The ship stamps ``submit`` when its puts issue."""
         self._require_store("stage_layer_save")
         import jax.numpy as jnp
 
@@ -744,20 +797,25 @@ class KVConnector:
         ])
         keys_k = [(self.block_key(layer, "k", chains[i]), i * bn) for i in range(n)]
         keys_v = [(self.block_key(layer, "v", chains[i]), (n + i) * bn) for i in range(n)]
-        # Layer-streamed saves are BACKGROUND by construction (docs/qos.md):
-        # they run behind the engine's forward pass and must never delay a
-        # decode-blocking fetch.
-        pri_kw = wire.qos_kwargs(self.conn, wire.PRIORITY_BACKGROUND)
+        pri_kw = wire.qos_kwargs(self.conn, priority)
+        # Capture the request's trace context HERE: ship() typically runs as
+        # a free-floating task whose contextvars are whatever scheduled it,
+        # not the request that staged this layer.
+        span = tracing.active_span()
 
         async def ship() -> int:
             loop = asyncio.get_running_loop()
             (kv_host,) = await loop.run_in_executor(None, tr.wait)
             base = kv_host.ctypes.data
+            if span is not None:
+                span.stage("submit")
+                span.annotate(handoff_layer=layer, handoff_blocks=2 * n)
             try:
-                await asyncio.gather(
-                    self.conn.write_cache_async(keys_k, bn, base, **pri_kw),
-                    self.conn.write_cache_async(keys_v, bn, base, **pri_kw),
-                )
+                with tracing.override_span(span):
+                    await asyncio.gather(
+                        self.conn.write_cache_async(keys_k, bn, base, **pri_kw),
+                        self.conn.write_cache_async(keys_v, bn, base, **pri_kw),
+                    )
             finally:
                 tr.release()
             return 2 * n

@@ -13,6 +13,11 @@ from .llama import (
     LlamaConfig,
     decode_step,
     decode_step_batched,
+    decode_wave_layer,
+    embed_prompt,
+    embed_wave,
+    lm_logits,
+    prefill_layer,
     verify_step_batched,
     verify_step_ragged,
     init_params,
@@ -28,6 +33,11 @@ __all__ = [
     "init_params",
     "prefill",
     "prefill_continue",
+    "prefill_layer",
+    "embed_prompt",
+    "embed_wave",
+    "lm_logits",
+    "decode_wave_layer",
     "speculative_verify",
     "decode_step",
     "decode_step_batched",

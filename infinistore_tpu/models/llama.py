@@ -588,6 +588,123 @@ def decode_step_batched(
 
 
 # ---------------------------------------------------------------------------
+# Layerwise inference entry points (disaggregated prefill -> decode handoff,
+# docs/disaggregation.md). The monolithic ``prefill``/``verify_step_batched``
+# bodies are re-expressed one layer per jitted call so a prefill engine can
+# SHIP layer l's KV while layer l+1 computes, and a decode engine can gate
+# each layer's attention on that layer's install alone (the watermark rule).
+# Both handoff directions — streamed prefill and the fallback recompute —
+# use THESE functions, and the watermarked and blocking decode paths share
+# ``decode_wave_layer``, so "overlapped equals blocking byte-for-byte" holds
+# by construction regardless of how XLA fuses across the per-layer
+# boundaries.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def embed_prompt(params: Params, tokens: jax.Array) -> jax.Array:
+    """[S] prompt tokens -> [1, S, dim] activations (the layerwise prefill
+    chain's entry)."""
+    return jnp.take(params["embed"], tokens, axis=0)[None]
+
+
+@functools.partial(jax.jit, static_argnames=("config", "layer"))
+def prefill_layer(
+    params: Params,
+    x: jax.Array,  # [1, S, dim] activations entering this layer
+    k_cache: jax.Array,  # this LAYER's paged K array
+    v_cache: jax.Array,  # this LAYER's paged V array
+    block_table: jax.Array,  # [S // block_tokens] int32 cache block ids
+    config: LlamaConfig,
+    layer: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer of the whole-prompt prefill: project this layer's K/V,
+    scatter them into the layer's cache blocks, and run the block. Returns
+    ``(x_next, k_cache, v_cache)`` — ``x_next`` feeds ``layer + 1`` while
+    the caller ships the freshly scattered K/V (the streaming overlap).
+    Chaining layers 0..L-1 then ``lm_logits`` is semantically equal to
+    ``prefill`` (same per-layer math, pinned by tests)."""
+    s = x.shape[1]
+    bt = config.block_tokens
+    positions = jnp.arange(s, dtype=jnp.int32)[None]
+    k, v = _kv_proj(params, layer, x, positions, config)
+    x = _block(params, layer, x, k, v, positions, None, config)
+    k_blocks = k[0].reshape(s // bt, bt, config.n_kv_heads, config.head_dim)
+    v_blocks = v[0].reshape(s // bt, bt, config.n_kv_heads, config.head_dim)
+    return (
+        x,
+        scatter_blocks(k_cache, block_table, k_blocks),
+        scatter_blocks(v_cache, block_table, v_blocks),
+    )
+
+
+@jax.jit
+def lm_logits(params: Params, x: jax.Array) -> jax.Array:
+    """Final norm + LM head over [B, S, dim] activations (the layerwise
+    chains' exit; [B, S, vocab] logits)."""
+    x = _rms_norm(x, params["final_norm"])
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+
+
+@jax.jit
+def embed_wave(params: Params, tokens: jax.Array) -> jax.Array:
+    """[B, K] wave tokens -> [B, K, dim] activations (the layerwise decode
+    chain's entry)."""
+    return jnp.take(params["embed"], tokens, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "layer", "max_blocks"))
+def decode_wave_layer(
+    params: Params,
+    x: jax.Array,  # [B, K, dim] activations entering this layer
+    positions: jax.Array,  # [B, K] int32 absolute positions
+    k_cache: jax.Array,  # this LAYER's paged K array
+    v_cache: jax.Array,  # this LAYER's paged V array
+    block_tables: jax.Array,  # [B, max_blocks] int32 (rows padded)
+    config: LlamaConfig,
+    layer: int,
+    max_blocks: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One layer of ``verify_step_batched``'s wave body: insert the wave's
+    K/V at (table[pos // bt], pos % bt), fused paged attention over this
+    layer's cache, residual + FFN. Returns ``(x_next, k_cache, v_cache)``.
+
+    The watermark-gated decode admission (disagg.py) calls this only after
+    THIS layer's prefix KV installed — layer l's attention never reads
+    bytes still in flight — and the blocking fetch-all path chains the same
+    function, so the two paths agree byte-for-byte on logits and caches."""
+    bsz, kk = positions.shape
+    if block_tables.shape != (bsz, max_blocks):
+        raise ValueError(
+            f"block_tables must be [{bsz}, {max_blocks}] (one padded row per "
+            f"request), got {block_tables.shape}"
+        )
+    bt = config.block_tokens
+    flat_pos = positions.reshape(-1)
+    block_idx = jnp.take_along_axis(
+        block_tables, positions // bt, axis=1
+    ).reshape(-1)
+    slots = flat_pos % bt
+    row_tables = jnp.repeat(block_tables, kk, axis=0)
+    k, v = _kv_proj(params, layer, x, positions, config)
+    k_cache = k_cache.at[block_idx, slots].set(
+        k.reshape(bsz * kk, *k.shape[2:]).astype(k_cache.dtype)
+    )
+    v_cache = v_cache.at[block_idx, slots].set(
+        v.reshape(bsz * kk, *v.shape[2:]).astype(v_cache.dtype)
+    )
+    pre = f"l{layer}."
+    q = _q_proj(params, layer, x, positions, config)
+    attn = paged_decode_attention_batched(
+        q.reshape(bsz * kk, *q.shape[2:]), k_cache, v_cache,
+        row_tables, flat_pos + 1,
+    ).reshape(bsz, kk, *q.shape[2:])
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, params[pre + "wo"])
+    x = _ffn(params, layer, x, config)
+    return x, k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
 # Training step (dense attention, no cache) — exercised by the multichip
 # dryrun with dp/tp shardings.
 # ---------------------------------------------------------------------------

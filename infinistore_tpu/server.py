@@ -111,6 +111,7 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
                      slo_status: dict = None, event_counts: dict = None,
                      gossip_status: dict = None, tier_status: dict = None,
                      prof_status: dict = None, timeseries_status: dict = None,
+                     disagg_status: dict = None,
                      exemplars: bool = False) -> bytes:
     """Render the stats snapshot in Prometheus exposition format (the
     reference exposes no metrics at all — SURVEY.md §5.1/§5.5). With a
@@ -326,6 +327,8 @@ def _prometheus_text(stats: dict, membership_status: dict = None,
         lines += _gossip_prometheus_lines(gossip_status)
     if tier_status is not None:
         lines += _tier_prometheus_lines(tier_status)
+    if disagg_status is not None:
+        lines += _disagg_prometheus_lines(disagg_status)
     if slo_status is not None:
         lines += _slo_prometheus_lines(slo_status)
     if prof_status is not None:
@@ -522,6 +525,43 @@ def _tier_prometheus_lines(ts: dict) -> list:
         "# TYPE infinistore_tier_last_pass_ms gauge",
         f"infinistore_tier_last_pass_ms {ts['tier_last_pass_ms']}",
     ]
+
+
+def _disagg_prometheus_lines(ds: dict) -> list:
+    """Disaggregated-handoff counter families for /metrics, from the flat
+    ``disagg.DisaggCounters.status`` snapshot (the same dict ``GET
+    /disagg`` serves). The counters checker (ITS-C009,
+    tools/analysis/counters.py) holds this exporter to the ``disagg_*``
+    ledger vocabulary both ways — a handoff counter the dashboards cannot
+    see is observability drift (docs/disaggregation.md)."""
+    return [
+        "# TYPE infinistore_disagg_handoffs counter",
+        f"infinistore_disagg_handoffs {ds['disagg_handoffs']}",
+        "# TYPE infinistore_disagg_overlap_layers counter",
+        f"infinistore_disagg_overlap_layers {ds['disagg_overlap_layers']}",
+        "# TYPE infinistore_disagg_inflight_at_first_token counter",
+        "infinistore_disagg_inflight_at_first_token "
+        f"{ds['disagg_inflight_at_first_token']}",
+        "# TYPE infinistore_disagg_watermark_stalls counter",
+        f"infinistore_disagg_watermark_stalls {ds['disagg_watermark_stalls']}",
+        "# TYPE infinistore_disagg_fallback_recomputes counter",
+        "infinistore_disagg_fallback_recomputes "
+        f"{ds['disagg_fallback_recomputes']}",
+        "# TYPE infinistore_disagg_wrong_bytes counter",
+        f"infinistore_disagg_wrong_bytes {ds['disagg_wrong_bytes']}",
+    ]
+
+
+def _disagg_status():
+    """The process-wide disagg counter snapshot, or None when no handoff
+    has run here. Lazy on purpose: ``infinistore_tpu.disagg`` pulls in
+    the jax engine stack, and the core client/server API must stay
+    importable without it — so this only *observes* an already-imported
+    module (``sys.modules``), never imports one."""
+    dsd = sys.modules.get("infinistore_tpu.disagg")
+    if dsd is None:
+        return None
+    return dsd.counters().status()
 
 
 def _prof_prometheus_lines(ps: dict) -> list:
@@ -832,6 +872,7 @@ class ManageServer:
                     self.history.status()
                     if self.history is not None else None
                 )
+                ds = _disagg_status()
                 try:
                     stats = await asyncio.to_thread(_lib.get_server_stats)
                 except Exception:
@@ -845,6 +886,7 @@ class ManageServer:
                         _membership_prometheus_lines(ms)
                         + (_gossip_prometheus_lines(gs) if gs is not None else [])
                         + (_tier_prometheus_lines(ts) if ts is not None else [])
+                        + (_disagg_prometheus_lines(ds) if ds is not None else [])
                         + _slo_prometheus_lines(slo)
                         + (_prof_prometheus_lines(ps) if ps is not None else [])
                         + (_timeseries_prometheus_lines(hs)
@@ -861,7 +903,7 @@ class ManageServer:
                 return _prometheus_text(
                     stats, membership_status=ms, slo_status=slo,
                     event_counts=counts, gossip_status=gs, tier_status=ts,
-                    prof_status=ps, timeseries_status=hs,
+                    prof_status=ps, timeseries_status=hs, disagg_status=ds,
                     exemplars=params.get("exemplars") == ["1"],
                 )
             if path == "/health" and method == "GET":
@@ -960,6 +1002,19 @@ class ManageServer:
                         )
                     ],
                 })
+            if path == "/disagg" and method == "GET":
+                # Disaggregated prefill->decode handoff (docs/
+                # disaggregation.md): the flat disagg_* counter snapshot —
+                # the DisaggCounters.status vocabulary /metrics exports as
+                # infinistore_disagg_* (ITS-C009). Served only when a
+                # handoff has run in this process; the module stays
+                # unimported (and jax unloaded) otherwise.
+                ds = _disagg_status()
+                if ds is None:
+                    return _http_response(
+                        200, {"enabled": False, "error": "no handoff has run"}
+                    )
+                return _http_response(200, {"enabled": True, **ds})
             if path == "/membership" and method == "GET":
                 return self._membership_get()
             if path == "/membership" and method == "POST":
@@ -971,7 +1026,7 @@ class ManageServer:
             if path in ("/purge", "/kvmap_len", "/stats", "/usage", "/metrics",
                         "/selftest", "/health", "/trace", "/membership",
                         "/slo", "/events", "/gossip", "/bootstrap", "/tiers",
-                        "/profile", "/timeseries"):
+                        "/profile", "/timeseries", "/disagg"):
                 return _http_response(405, {"error": "method not allowed"})
             return _http_response(404, {"error": "not found"})
         except Exception as e:  # control plane must not die on a bad request

@@ -71,6 +71,7 @@ EVENT_KINDS = (
     "tier_demotion",      # an idle root's copy shipped to the pooled cold tier
     "tier_promotion",     # a reused cold root copied back to its serving owner
     "metric_anomaly",     # metrics-history change-point detector fired
+    "disagg_fallback",    # handoff layer late/failed -> local recompute leg
 )
 
 _DEFAULT_JOURNAL_CAPACITY = 512

@@ -20,7 +20,10 @@ from typing import List, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # older jax: the experimental home (see paged_attention)
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from .. import wire
+
 try:  # TPU-specific pallas bits
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -272,21 +274,24 @@ class QuantizedKVConnector:
         return self._zip(data_out, scale_out), n
 
     def stage_layer_save(
-        self, token_ids, layer: int, kv_pair, block_ids, first_block: int = 0
+        self, token_ids, layer: int, kv_pair, block_ids, first_block: int = 0,
+        priority: int = wire.PRIORITY_BACKGROUND,
     ):
         """Layer-granular save (KVConnector.stage_layer_save contract) for
         a quantized layer ``((k_int8, k_scales), (v_int8, v_scales))``.
         The returned ship puts scales BEFORE data, preserving the commit
         order the class relies on; layer-by-layer callers (vllm_v1) defer
         layer 0's ship to last, so the data sentinel still commits after
-        everything — scales layers 1+, data layers 1+, scales 0, data 0."""
+        everything — scales layers 1+, data layers 1+, scales 0, data 0.
+        ``priority`` rides both underlying ships (docs/qos.md)."""
         (kq, ks), (vq, vs) = kv_pair
         ship_scales = self.scales.stage_layer_save(
             token_ids, layer, (ks[..., None], vs[..., None]), block_ids,
-            first_block=first_block,
+            first_block=first_block, priority=priority,
         )
         ship_data = self.data.stage_layer_save(
-            token_ids, layer, (kq, vq), block_ids, first_block=first_block
+            token_ids, layer, (kq, vq), block_ids, first_block=first_block,
+            priority=priority,
         )
 
         async def ship() -> int:

@@ -405,6 +405,9 @@ class LayerwisePrefetch:
         submit=None,
         priority: int = wire.PRIORITY_FOREGROUND,
         priority_cell: Optional[dict] = None,
+        retry_missing_s: float = 0.0,
+        retry_interval_s: float = 0.002,
+        fetch_gate=None,
     ):
         """``submit(blocks)``: optional override for the store read (the
         connector's fetch coalescer batches concurrent admissions' reads
@@ -414,6 +417,21 @@ class LayerwisePrefetch:
         speculative prefetch beyond the next wave may be tagged
         BACKGROUND (docs/qos.md). Ignored when ``submit`` is given (the
         coalescer owns tagging there).
+        ``retry_missing_s`` > 0 switches a layer's KeyNotFound from "dooms
+        the prefix" to a bounded re-probe loop (every
+        ``retry_interval_s``): the handoff mode, where the decode side's
+        fetch legitimately RACES the prefill side's layer ships
+        (docs/disaggregation.md) and a missing key usually means "not
+        shipped yet", not "evicted". Each re-probe counts into
+        :attr:`retry_stalls`; past the deadline the error keeps its normal
+        miss semantics (the watermark path falls back to recompute).
+        ``fetch_gate``: optional ``async fetch_gate(layer)`` awaited before
+        layer ``layer``'s store read issues — the ANNOUNCE-DRIVEN handoff
+        mode: when the producer can signal per-layer publication (same
+        process, or a control channel), gating on the announcement replaces
+        blind re-probing, so the reader never burns store round trips on
+        keys that cannot exist yet. Composable with ``retry_missing_s``
+        (the gate bounds when to START, the retry rides any residual race).
         Raises :class:`~..tpu.staging.StagingPoolExhausted` when the pool
         cannot hold even a double-buffered pipeline."""
         self.conn = conn
@@ -435,6 +453,11 @@ class LayerwisePrefetch:
         )
         self.blocks_fetched = 0  # K+V blocks landed in staging
         self.blocks_installed = 0  # K+V blocks scattered to the device
+        self.retry_missing_s = retry_missing_s
+        self.retry_interval_s = retry_interval_s
+        self._fetch_gate = fetch_gate
+        self.retry_stalls = 0  # KeyNotFound re-probes (handoff read-racing-write)
+        self.wait_stalls = 0  # install_layer() calls that blocked on staging
         self.fetch_started_s = time.perf_counter()
         self.fetch_finished_s: Optional[float] = None
         self._cancelled = False
@@ -502,6 +525,10 @@ class LayerwisePrefetch:
         return self._lease.offset + (layer % self.regions) * self._region_stride
 
     async def _fetch_layer(self, layer: int):
+        if self._fetch_gate is not None:
+            # Announce-driven handoff: wait for the producer's per-layer
+            # publication signal before spending a store round trip.
+            await self._fetch_gate(layer)
         if layer >= self.regions:
             # Double buffering: refill a region only once install consumed
             # (or discard wrote off) its previous occupant.
@@ -516,7 +543,7 @@ class LayerwisePrefetch:
             (self._key_fn(layer, "v", i), base + (n + i) * bn) for i in range(n)
         ]
         try:
-            await self._submit(blocks)
+            await self._submit_with_retry(blocks)
         except asyncio.CancelledError:
             self._cancel_rest()
             raise
@@ -534,6 +561,26 @@ class LayerwisePrefetch:
             self._staged[layer].set_result(layer % self.regions)
         if layer == self.num_layers - 1:
             self.fetch_finished_s = time.perf_counter()
+
+    async def _submit_with_retry(self, blocks):
+        """The store read, with the handoff mode's bounded KeyNotFound
+        re-probe loop (``retry_missing_s``; docs/disaggregation.md): a key
+        the prefill side has not shipped YET is a stall, not a miss —
+        until the deadline, after which the error keeps its normal
+        semantics and the caller's fallback machinery takes over."""
+        if self.retry_missing_s <= 0:
+            await self._submit(blocks)
+            return
+        deadline = time.perf_counter() + self.retry_missing_s
+        while True:
+            try:
+                await self._submit(blocks)
+                return
+            except InfiniStoreKeyNotFound:
+                if self._cancelled or time.perf_counter() >= deadline:
+                    raise
+                self.retry_stalls += 1
+                await asyncio.sleep(self.retry_interval_s)
 
     def _on_task_done(self, task):
         if not task.cancelled() and task.exception() is not None:
@@ -806,3 +853,103 @@ class LayerwisePrefetch:
                 on_layer(layer, out[layer])
             self._release_region_async([layer], kv_dev, out[layer], loop)
         return out, n
+
+    # -- per-layer handles (watermark-gated decode admission) ----------------
+
+    def layer_ready(self, layer: int) -> bool:
+        """True once ``layer``'s bytes sit staged and healthy — the
+        watermark plane's non-blocking probe (how many layers are still in
+        flight at first-token time is counted off this)."""
+        if self.n_blocks == 0:
+            return True
+        fut = self._staged[layer]
+        return fut.done() and not fut.cancelled() and fut.exception() is None
+
+    async def install_layer(
+        self,
+        caches: Sequence[Tuple[jax.Array, jax.Array]],
+        block_ids: np.ndarray,
+        layer: int,
+        on_layer=None,
+    ):
+        """Install ONE layer's staged prefix — the watermark rule's unit of
+        admission (docs/disaggregation.md): layer l's attention launches
+        after ``install_layer(..., l)`` returns True, while layers > l are
+        still on the network. Returns ``(updated caches, ok)``; only
+        ``caches[layer]`` changes (donated like :meth:`install`).
+
+        Call with strictly increasing ``layer`` — staging regions wrap, and
+        region ``l % regions`` is refilled only after layer ``l`` is
+        consumed, so out-of-order installs deadlock the fetch pipeline.
+        ``ok`` False means the layer is unavailable (missing past the retry
+        deadline, store failure, or discarded): the prefetch is written off
+        and the caller must fall back to recompute — never read the
+        partial prefix as if it were complete."""
+        if self._discarded:
+            raise PrefetchDiscarded("install_layer() after discard()")
+        out = list(caches)
+        if self.n_blocks == 0:
+            return out, True
+        n = self.n_blocks
+        if len(block_ids) != n:
+            raise ValueError(
+                f"install_layer needs exactly the {n} fetched blocks' "
+                f"placement, got {len(block_ids)} block ids"
+            )
+        if len(caches) != self.num_layers:
+            raise ValueError(
+                f"cache list has {len(caches)} layers, prefetch fetched "
+                f"{self.num_layers}"
+            )
+        if layer in self._installing:
+            raise ValueError(f"layer {layer} already installed")
+        fut = self._staged[layer]
+        if not fut.done():
+            # The compute side outran the transfer: a genuine watermark
+            # stall (the overlap's residual wait, counted for /metrics).
+            self.wait_stalls += 1
+        try:
+            await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            if not fut.cancelled():
+                raise  # the INSTALLING task was cancelled, not the fetch
+            self._write_off_uninstalled()
+            return out, False
+        except Exception:
+            # Missing past the retry deadline, shed load, or transport
+            # failure: one verdict for the watermark path — this layer is
+            # unavailable, fall back (the error already routed through the
+            # connector's degrade machinery on the fetch side).
+            self._cancel_rest()
+            self._write_off_uninstalled()
+            return out, False
+        if self._lease is None or self._lease._released:
+            return out, False
+        ids_dev = jax.numpy.asarray(np.asarray(block_ids), jax.numpy.int32)
+        bn = self.spec.block_nbytes
+        dt = np.dtype(jax.numpy.dtype(self.spec.dtype))
+        loop = asyncio.get_running_loop()
+        off = self._region_offset(layer)
+        kv_host = (
+            self.pool.buf[off : off + 2 * n * bn]
+            .view(dt)
+            .reshape((2 * n, *self.spec.block_shape))
+        )
+
+        def dev_one(pair):
+            kv_dev = jax.device_put(kv_host)
+            k_cache, v_cache = pair
+            return kv_dev, (
+                scatter_blocks(k_cache, ids_dev, kv_dev[:n]),
+                scatter_blocks(v_cache, ids_dev, kv_dev[n:]),
+            )
+
+        kv_dev, out[layer] = await loop.run_in_executor(
+            None, dev_one, out[layer]
+        )
+        self._installing.add(layer)
+        self.blocks_installed += 2 * n
+        if on_layer is not None:
+            on_layer(layer, out[layer])
+        self._release_region_async([layer], kv_dev, out[layer], loop)
+        return out, True

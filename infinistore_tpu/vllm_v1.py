@@ -60,6 +60,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from . import wire
 from .connector import KVConnector  # noqa: F401 - the canonical surface
 from .tpu.staging import StagingPoolExhausted
 
@@ -690,9 +691,13 @@ class InfiniStoreKVConnectorV1(KVConnectorBase_V1):
             # cannot perturb the shipped bytes; the network put is a pure-
             # await callable (KVConnector.stage_layer_save — also the seam
             # where ClusterKVConnector routes by chain root).
+            # BACKGROUND named at source (ITS-P004): this is the engine's
+            # own streamed save behind the forward pass, NOT a handoff a
+            # decode consumer is waiting on — disagg.py ships FOREGROUND.
             ship = self.kv.stage_layer_save(
                 spec.token_ids, layer, kv_layer, spec.block_ids,
                 first_block=spec.first_block,
+                priority=wire.PRIORITY_BACKGROUND,
             )
             if layer == 0:
                 self._deferred_sentinels.append(ship)

@@ -1671,3 +1671,160 @@ class TestRaces:
         found = races.scan(ctx, docs=False)
         hits = [f for f in found if f.rule == "ITS-R004"]
         assert hits and ctx.suppressed(hits[0])
+
+
+# ---------------------------------------------------------------------------
+# policy ITS-P004: layer-streaming saves name their QoS class at the source
+# ---------------------------------------------------------------------------
+
+P004_FIXTURE = '''\
+from .wire import PRIORITY_FOREGROUND
+import wire
+
+
+def ship_named(conn, prompt, layer, kv, ids):
+    conn.stage_layer_save(prompt, layer, kv, ids,
+                          priority=PRIORITY_FOREGROUND)
+    conn.stage_layer_save(prompt, layer, kv, ids,
+                          priority=wire.PRIORITY_BACKGROUND)
+
+
+def ship_default(conn, prompt, layer, kv, ids):
+    conn.stage_layer_save(prompt, layer, kv, ids)
+
+
+def ship_opaque(conn, prompt, layer, kv, ids, prio):
+    conn.stage_layer_save(prompt, layer, kv, ids, priority=prio)
+'''
+
+
+class TestPolicyP004:
+    def scan(self, tmp_path, rel="pkg/disagg.py"):
+        ctx = make_tree(tmp_path, {rel: P004_FIXTURE})
+        return policy.scan(
+            ctx, package_rel="pkg", p001_exempt=set(), p002_exempt=set(),
+            p003_files=set(), p004_files={"pkg/disagg.py", "pkg/vllm_v1.py"},
+        )
+
+    def test_default_and_opaque_priority_fire(self, tmp_path):
+        p4 = [f for f in self.scan(tmp_path) if f.rule == "ITS-P004"]
+        # The inherited-default call AND the opaque-variable call fire;
+        # ITS-P002's "any explicit kwarg" is not enough here.
+        scopes = sorted(f.key.split(":")[2] for f in p4)
+        assert scopes == ["ship_default", "ship_opaque"]
+
+    def test_literal_class_names_pass(self, tmp_path):
+        p4 = [f for f in self.scan(tmp_path) if f.rule == "ITS-P004"]
+        assert not [f for f in p4 if "ship_named" in f.key]
+
+    def test_scope_is_producer_files_only(self, tmp_path):
+        # Connector-layer forwards (priority=priority) live outside the
+        # producer files and must not fire.
+        ctx = make_tree(tmp_path, {"pkg/connector.py": P004_FIXTURE})
+        found = policy.scan(
+            ctx, package_rel="pkg", p001_exempt=set(), p002_exempt=set(),
+            p003_files=set(), p004_files={"pkg/disagg.py"},
+        )
+        assert not [f for f in found if f.rule == "ITS-P004"]
+
+    def test_vllm_is_in_p004_scope(self, tmp_path):
+        found = self.scan(tmp_path, rel="pkg/vllm_v1.py")
+        assert [f for f in found if f.rule == "ITS-P004"]
+        assert "infinistore_tpu/vllm_v1.py" in policy.P004_FILES
+        assert "infinistore_tpu/disagg.py" in policy.P004_FILES
+
+    def test_real_producers_name_their_class(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in policy.scan(ctx) if f.rule == "ITS-P004"]
+        assert found == []
+
+
+# ---------------------------------------------------------------------------
+# counters ITS-C009: disaggregated-handoff vocabulary lockstep
+# ---------------------------------------------------------------------------
+
+C009_DISAGG = '''\
+class DisaggCounters:
+    def __init__(self):
+        self._c = {"disagg_handoffs": 0, "disagg_wrong_bytes": 0}
+
+    def status(self):
+        c = self._c
+        return {**c, "disagg_overlap_layers": 1, "disagg_watermark_stalls": 0}
+'''
+
+C009_MANAGE_OK = '''\
+def _disagg_prometheus_lines(ds):
+    return [
+        f"a {ds['disagg_handoffs']}",
+        f"b {ds['disagg_wrong_bytes']}",
+        f"c {ds['disagg_overlap_layers']}",
+        f"d {ds['disagg_watermark_stalls']}",
+    ]
+
+route = "/disagg"   # served from _disagg_status()
+'''
+
+C009_DOCS = (
+    "| disagg_handoffs | disagg_wrong_bytes | disagg_overlap_layers | "
+    "disagg_watermark_stalls |\n"
+)
+
+
+class TestCountersDisagg:
+    def scan(self, tmp_path, manage_src=C009_MANAGE_OK,
+             disagg_src=C009_DISAGG, docs=C009_DOCS):
+        ctx = make_tree(tmp_path, {
+            "manage.py": manage_src,
+            "disagg.py": disagg_src,
+            "docs/disaggregation.md": docs,
+        })
+        return counters._scan_disagg(
+            ctx, "manage.py", disagg_rel="disagg.py",
+            docs_rel="docs/disaggregation.md",
+        )
+
+    def test_complete_vocabulary_is_clean(self, tmp_path):
+        assert self.scan(tmp_path) == []
+
+    def test_unexported_status_key_fires(self, tmp_path):
+        manage = C009_MANAGE_OK.replace(
+            "        f\"c {ds['disagg_overlap_layers']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(
+            f.rule == "ITS-C009" and f.key.endswith(":disagg_overlap_layers")
+            for f in found
+        )
+
+    def test_unexported_init_ledger_key_fires(self, tmp_path):
+        # Keys living only in the __init__ counter dict are vocabulary too.
+        manage = C009_MANAGE_OK.replace(
+            "        f\"a {ds['disagg_handoffs']}\",\n", "")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith(":disagg_handoffs") for f in found)
+
+    def test_stale_exporter_key_fires(self, tmp_path):
+        manage = C009_MANAGE_OK.replace("disagg_wrong_bytes",
+                                        "disagg_gone_key")
+        keys = {f.key for f in self.scan(tmp_path, manage_src=manage)}
+        assert any(k.endswith("stale:disagg_gone_key") for k in keys)
+        assert any(k.endswith(":disagg_wrong_bytes") for k in keys)
+
+    def test_undocumented_disagg_key_fires(self, tmp_path):
+        docs = C009_DOCS.replace("disagg_watermark_stalls", "")
+        found = self.scan(tmp_path, docs=docs)
+        assert any(
+            f.key.endswith("undocumented:disagg_watermark_stalls")
+            for f in found
+        )
+
+    def test_missing_disagg_route_fires(self, tmp_path):
+        manage = C009_MANAGE_OK.replace('"/disagg"', '"/nope"').replace(
+            "_disagg_status", "nothing")
+        found = self.scan(tmp_path, manage_src=manage)
+        assert any(f.key.endswith("disagg-route") for f in found)
+
+    def test_real_disagg_vocabulary_is_clean(self):
+        ctx = core.Context(str(REPO))
+        found = [f for f in counters.scan(ctx) if f.rule == "ITS-C009"]
+        assert found == []

@@ -54,6 +54,15 @@ and cross-checks them:
   keep serving ``GET /profile`` from the process profiler and ``GET
   /timeseries`` from the metrics history.
 
+- ITS-C009 disaggregated-handoff vocabulary drift
+  (docs/disaggregation.md): every ``disagg_*`` key of the
+  ``disagg.DisaggCounters`` ledger (``__init__`` literal + ``status``
+  snapshot) must be consumed by the /metrics disagg exporter
+  (``server.py _disagg_prometheus_lines``) and enumerated in
+  docs/disaggregation.md — and the exporter must not consume keys the
+  snapshot no longer emits; the manage plane must keep serving ``GET
+  /disagg`` from the process disagg counters.
+
 Dynamic per-op entries (``"ops": {"W": {...}}``) appear as ``ops.*`` on
 both sides.
 """
@@ -90,6 +99,8 @@ LEDGERS: List[Tuple[str, str]] = [
     ("infinistore_tpu/tiering.py", "TierManager.status"),
     ("infinistore_tpu/profiling.py", "SamplingProfiler.status"),
     ("infinistore_tpu/telemetry.py", "MetricsHistory.status"),
+    ("infinistore_tpu/disagg.py", "DisaggCounters.__init__"),
+    ("infinistore_tpu/disagg.py", "DisaggCounters.status"),
 ]
 
 # The elastic-membership status snapshot (ITS-C005): the dict-literal
@@ -138,6 +149,15 @@ PROFILING_LEDGERS = ["SamplingProfiler.status"]
 PROF_EXPORT_FN = "_prof_prometheus_lines"
 TIMESERIES_LEDGERS = ["MetricsHistory.status"]
 TIMESERIES_EXPORT_FN = "_timeseries_prometheus_lines"
+
+# The disaggregated prefill->decode handoff plane (ITS-C009,
+# docs/disaggregation.md): the DisaggCounters ledger's ``disagg_*`` keys
+# must reach the /metrics disagg exporter both ways, be enumerated in the
+# disaggregation docs, and keep the /disagg manage route.
+DISAGG_REL = "infinistore_tpu/disagg.py"
+DISAGG_LEDGERS = ["DisaggCounters.__init__", "DisaggCounters.status"]
+DISAGG_EXPORT_FN = "_disagg_prometheus_lines"
+DISAGG_DOCS_REL = "docs/disaggregation.md"
 
 # Trace-surface exporters (docs/observability.md): the /trace payload
 # builder consumes the native ring's counters from the stats snapshot, and
@@ -456,6 +476,77 @@ def scan(
     findings += _scan_telemetry(ctx, manage_rel)
     findings += _scan_tiering(ctx, manage_rel)
     findings += _scan_profiling(ctx, manage_rel)
+    findings += _scan_disagg(ctx, manage_rel)
+    return findings
+
+
+def _scan_disagg(
+    ctx: Context,
+    manage_rel: str = MANAGE_REL,
+    disagg_rel: str = DISAGG_REL,
+    docs_rel: str = DISAGG_DOCS_REL,
+) -> List[Finding]:
+    """ITS-C009: the disaggregated-handoff vocabulary in lockstep —
+    ``disagg_*`` ledger keys vs the /metrics disagg exporter (both
+    directions), the disaggregation docs, and the /disagg manage route
+    (docs/disaggregation.md)."""
+    findings: List[Finding] = []
+    if not ctx.exists(disagg_rel):
+        return findings
+    docs = ctx.read(docs_rel) if ctx.exists(docs_rel) else ""
+    doc_words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", docs))
+
+    ledger_key_set: Set[str] = set()
+    ledger_line = 1
+    for dotted in DISAGG_LEDGERS:
+        keys, line = ledger_keys(ctx, disagg_rel, dotted)
+        ledger_key_set |= {k.rsplit(".", 1)[-1] for k in keys}
+        ledger_line = line or ledger_line
+    ledger_key_set = {k for k in ledger_key_set if k.startswith("disagg_")}
+    consumed = {
+        k for k in metrics_consumed_keys(
+            ctx, manage_rel, fn_name=DISAGG_EXPORT_FN
+        )
+        if k.startswith("disagg_")
+    }
+    for key in sorted(ledger_key_set - consumed):
+        findings.append(Finding(
+            rule="ITS-C009", file=manage_rel, line=1,
+            message=f"disagg counter key {key!r} is not exported by the "
+                    f"/metrics disagg exporter ({DISAGG_EXPORT_FN}) — a "
+                    "handoff counter dashboards cannot see is observability "
+                    "drift (docs/disaggregation.md)",
+            key=f"ITS-C009:{manage_rel}:{key}",
+        ))
+    for key in sorted(consumed - ledger_key_set):
+        findings.append(Finding(
+            rule="ITS-C009", file=manage_rel, line=1,
+            message=f"/metrics disagg exporter consumes key {key!r} which "
+                    "the DisaggCounters snapshot no longer emits (KeyError "
+                    "at scrape time)",
+            key=f"ITS-C009:{manage_rel}:stale:{key}",
+        ))
+    for key in sorted(ledger_key_set):
+        if key not in doc_words:
+            findings.append(Finding(
+                rule="ITS-C009", file=disagg_rel, line=ledger_line,
+                message=f"disagg counter key {key!r} is undocumented in "
+                        f"{docs_rel} — the handoff counter vocabulary table "
+                        "must enumerate it",
+                key=f"ITS-C009:{disagg_rel}:undocumented:{key}",
+            ))
+    manage_src = ctx.read(manage_rel)
+    if (
+        not re.search(r'[\'"]/disagg[\'"]', manage_src)
+        or "_disagg_status" not in manage_src
+    ):
+        findings.append(Finding(
+            rule="ITS-C009", file=manage_rel, line=1,
+            message="manage plane must serve GET /disagg from the process "
+                    "disagg counters — the prefill->decode handoff surface "
+                    "(docs/disaggregation.md)",
+            key=f"ITS-C009:{manage_rel}:disagg-route",
+        ))
     return findings
 
 

@@ -37,6 +37,20 @@ exactly what this pass does:
   like everyone else — their ``except InfiniStoreException`` clauses
   must feed the degrade machinery (the cluster's ``_begin``/``_done``
   breaker plumbing), not swallow a dying member mid-migration.
+
+- ITS-P004 **layer-streaming saves name their class at the source.**
+  ``stage_layer_save`` producers (``disagg.py`` — the prefill→decode
+  handoff stream, docs/disaggregation.md; ``vllm_v1.py`` — the engine's
+  own save-behind-the-forward-pass) must pass a ``priority`` whose
+  expression literally names a class (``PRIORITY_FOREGROUND`` /
+  ``PRIORITY_BACKGROUND``). Handoff ships feed a decode consumer that
+  is actively blocked on those exact bytes and must be FOREGROUND;
+  engine background saves must not be — and because the same one-line
+  call sits in both regimes, an inherited default or an opaque variable
+  is exactly how the wrong class sneaks in. Connector-layer *forwards*
+  (``cluster.py``, ``tpu/kv_quant.py`` re-shipping ``priority=priority``)
+  are not producers and are out of scope: the decision was already made
+  upstream.
 """
 
 from __future__ import annotations
@@ -88,6 +102,13 @@ P002_EXEMPT_FILES = {
 # where every data-plane op — batched AND single-key — must be BACKGROUND.
 P003_FILES = {"infinistore_tpu/membership.py", "infinistore_tpu/tiering.py"}
 P003_OPS = BATCHED_OPS | {"tcp_read_cache", "tcp_write_cache"}
+
+# ITS-P004 scope: the layer-streaming PRODUCERS — the disaggregated
+# prefill stream (FOREGROUND: a decode consumer is blocked on the bytes)
+# and the engine's save-behind-the-forward-pass (BACKGROUND). Connector
+# layers that forward priority=priority are out of scope by file.
+P004_FILES = {"infinistore_tpu/disagg.py", "infinistore_tpu/vllm_v1.py"}
+P004_OPS = {"stage_layer_save"}
 
 
 def _scope_map(tree: ast.Module) -> dict:
@@ -154,10 +175,12 @@ def _passes_priority(call: ast.Call) -> bool:
 def scan(ctx: Context, package_rel: str = PACKAGE_REL,
          p001_exempt: Optional[Set[str]] = None,
          p002_exempt: Optional[Set[str]] = None,
-         p003_files: Optional[Set[str]] = None) -> List[Finding]:
+         p003_files: Optional[Set[str]] = None,
+         p004_files: Optional[Set[str]] = None) -> List[Finding]:
     p001_exempt = P001_EXEMPT_FILES if p001_exempt is None else p001_exempt
     p002_exempt = P002_EXEMPT_FILES if p002_exempt is None else p002_exempt
     p003_files = P003_FILES if p003_files is None else p003_files
+    p004_files = P004_FILES if p004_files is None else p004_files
     findings: List[Finding] = []
     for rel in ctx.walk_py(package_rel):
         try:
@@ -170,6 +193,8 @@ def scan(ctx: Context, package_rel: str = PACKAGE_REL,
             findings += _scan_p002(rel, tree)
         if rel in p003_files:
             findings += _scan_p003(rel, tree)
+        if rel in p004_files:
+            findings += _scan_p004(rel, tree)
     return findings
 
 
@@ -263,6 +288,49 @@ def _scan_p003(rel: str, tree: ast.Module) -> List[Finding]:
                     "naming it) so a reshard can never move the foreground "
                     "p99 (docs/membership.md, docs/qos.md)",
             key=_scoped_key("ITS-P003", rel, scopes.get(node, ""), fn.attr, nth),
+        ))
+    return out
+
+
+def _names_priority_class(node) -> bool:
+    """Does this expression literally name a QoS class — a Name or
+    Attribute identifier containing FOREGROUND or BACKGROUND (e.g.
+    PRIORITY_FOREGROUND / wire.PRIORITY_BACKGROUND)?"""
+    for sub in ast.walk(node):
+        ident = (
+            sub.id if isinstance(sub, ast.Name)
+            else sub.attr if isinstance(sub, ast.Attribute) else ""
+        )
+        if "FOREGROUND" in ident or "BACKGROUND" in ident:
+            return True
+    return False
+
+
+def _scan_p004(rel: str, tree: ast.Module) -> List[Finding]:
+    out: List[Finding] = []
+    scopes = _scope_map(tree)
+    nth: dict = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in P004_OPS):
+            continue
+        tagged = any(
+            kw.arg == "priority" and _names_priority_class(kw.value)
+            for kw in node.keywords
+        )
+        if tagged:
+            continue
+        out.append(Finding(
+            rule="ITS-P004", file=rel, line=node.lineno,
+            message=f".{fn.attr}() in a layer-streaming producer without a "
+                    "priority= that names the class — handoff streams are "
+                    "PRIORITY_FOREGROUND (a decode consumer is blocked on "
+                    "these bytes), engine background saves "
+                    "PRIORITY_BACKGROUND; the choice must be literal at the "
+                    "call site (docs/disaggregation.md, docs/qos.md)",
+            key=_scoped_key("ITS-P004", rel, scopes.get(node, ""), fn.attr, nth),
         ))
     return out
 

@@ -648,6 +648,50 @@ CHECKS = [
             "throughput (paired-interleaved; must be <= 10%)"
         ),
     ),
+    # Overlapped prefill->decode handoff (docs/disaggregation.md), two
+    # gates. TTFT ratios ride the weather rule (order-alternating paired
+    # rounds, min-of-reps per leg, min(median-of-ratios, ratio-of-sums))
+    # against a real prefill-engine subprocess streaming layerwise KV:
+    # the watermark pipeline must beat blocking fetch-all admission AND
+    # the store-and-forward cold path outright.
+    Check(
+        "disagg_ttft",
+        ["disagg_ttft_overlap_vs_blocking", "disagg_ttft_handoff_vs_cold"],
+        lambda m: (
+            m["disagg_ttft_overlap_vs_blocking"] > 1.0
+            and m["disagg_ttft_handoff_vs_cold"] > 1.0
+        ),
+        lambda m: (
+            f"overlapped TTFT {m['disagg_ttft_overlap_vs_blocking']:.3f}x "
+            f"vs blocking fetch-all and "
+            f"{m['disagg_ttft_handoff_vs_cold']:.3f}x vs store-and-forward "
+            "cold (paired weather rule; both must exceed 1.0)"
+        ),
+    ),
+    # The mechanism, not just the stopwatch: every measured overlapped
+    # round issued its first token with layers still in flight (the
+    # receipt keys are MINIMA over rounds), the overlapped decode is
+    # byte-checked against the local-recompute oracle, and the clean legs
+    # never took the fallback path.
+    Check(
+        "disagg_mechanism",
+        ["disagg_overlap_layers", "disagg_inflight_at_first_token",
+         "disagg_wrong_bytes", "disagg_fallback_recomputes"],
+        lambda m: (
+            m["disagg_overlap_layers"] >= 1
+            and m["disagg_inflight_at_first_token"] >= 1
+            and m["disagg_wrong_bytes"] == 0
+            and m["disagg_fallback_recomputes"] == 0
+        ),
+        lambda m: (
+            f"first token with {m['disagg_inflight_at_first_token']:.0f} "
+            f"layers in flight / {m['disagg_overlap_layers']:.0f} installed "
+            f"behind compute (min over rounds, both >= 1), "
+            f"wrong_bytes={m['disagg_wrong_bytes']:.0f} "
+            f"fallbacks={m['disagg_fallback_recomputes']:.0f} "
+            "(both must be 0 on the clean legs)"
+        ),
+    ),
     Check(
         # Gate the bridge's OWN overhead, not asyncio's: the receipt measures
         # asyncio_efd_floor_us — a pure eventfd+add_reader wake with zero

@@ -151,6 +151,43 @@ def spawn_fleet_servers(n: int = 2, timeout_s: float = 20.0):
 
 
 # ---------------------------------------------------------------------------
+# Disaggregated prefill engine (infinistore_tpu.disagg subprocess).
+# ---------------------------------------------------------------------------
+
+
+def spawn_disagg_prefill(port: int, **kw):
+    """One prefill-ENGINE subprocess (``python -m infinistore_tpu.disagg``,
+    one-shot mode), stdout piped: it prints ``shipped layer N`` as each
+    layer's KV becomes durable in the store at ``port`` and ``prefill done
+    wrote=...`` at the end. The chaos test reads the per-layer markers to
+    know how far the handoff got, then ``kill_member``s it mid-stream;
+    ``kw`` passes through to ``disagg.prefill_argv`` (``stall_after_layer``
+    / ``stall_s`` hold the window open). Returns the usual member dict."""
+    from infinistore_tpu import disagg
+
+    argv = disagg.prefill_argv(port, **kw)
+    proc = subprocess.Popen(argv, stdout=subprocess.PIPE, text=True)
+    return {"service_port": port, "proc": proc, "argv": argv}
+
+
+def read_until_marker(member: dict, marker: str, timeout_s: float = 120.0):
+    """Read the member's piped stdout line by line until ``marker`` is a
+    substring; returns the matching line. The caller owns the deadline
+    semantics (a dead process raises RuntimeError — its stream EOFs)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = member["proc"].stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"stdout EOF before marker {marker!r} "
+                f"(exit={member['proc'].poll()})"
+            )
+        if marker in line:
+            return line.strip()
+    raise RuntimeError(f"timeout waiting for marker {marker!r}")
+
+
+# ---------------------------------------------------------------------------
 # Client members (infinistore_tpu.fleet_client subprocesses).
 # ---------------------------------------------------------------------------
 

@@ -78,6 +78,11 @@ SURFACE = [
         "InfiniStoreKVConnectorV1",
         "InfiniStoreConnectorMetadata",
     ]),
+    ("infinistore_tpu.disagg", [
+        "DisaggCounters", "DisaggHarness", "counters", "reset_counters",
+        "demo_config", "demo_prompt", "stream_prefill", "overlapped_decode",
+        "local_decode",
+    ]),
     ("infinistore_tpu.tpu.paged", None),
     ("infinistore_tpu.tpu.paged_attention", None),
     ("infinistore_tpu.tpu.flash_prefill", None),
