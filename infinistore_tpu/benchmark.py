@@ -60,6 +60,26 @@ def parse_args(argv=None):
              "leg)",
     )
     p.add_argument(
+        "--trace", default=None, metavar="FILE|PRESET",
+        help="replay a loadgen trace (JSON file or preset name: skewed, "
+             "uniform, outlier_flood) through the continuous-batching "
+             "engine harness against the server — the same traces "
+             "bench.py's serving leg grades (docs/serving_load.md)",
+    )
+    p.add_argument(
+        "--trace-seed", type=int, default=0,
+        help="generator seed when --trace names a preset",
+    )
+    p.add_argument(
+        "--trace-duration", type=float, default=0.4,
+        help="trace duration in seconds when --trace names a preset",
+    )
+    p.add_argument(
+        "--skew-policy", action=argparse.BooleanOptionalAction, default=True,
+        help="replay with the skew-aware wave flush policy "
+             "(wave_skew_policy; docs/serving_load.md) on or off",
+    )
+    p.add_argument(
         "--pacing-mbps", type=int, default=0,
         help="cap each connection's egress in MB/s (SO_MAX_PACING_RATE); "
              "implies the socket path (shm off — a same-host memcpy would "
@@ -257,6 +277,96 @@ def _measure_decode_wave(wave: int) -> dict:
     }
 
 
+def _run_trace(args) -> dict:
+    """``--trace`` mode: replay a loadgen trace (file or preset) through
+    the continuous-batching engine harness against the server — the same
+    workload definition ``bench.py``'s ``_serving_trace_metrics`` leg
+    grades, through the CLI entry point (docs/serving_load.md). Reports
+    the harness's serving metrics (TTFT percentiles, wave pad fraction,
+    the wave-policy ledger) plus the trace's own shape."""
+    import os
+
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError as e:
+        raise SystemExit(f"--trace needs jax for the engine harness: {e}")
+
+    from . import loadgen
+    from .connector import KVConnector
+    from .engine import (
+        ContinuousBatchingHarness,
+        EngineKVAdapter,
+        NGramDrafter,
+    )
+    from .models import LlamaConfig, init_params
+
+    if os.path.exists(args.trace):
+        trace = loadgen.Trace.load(args.trace)
+    else:
+        trace = loadgen.preset(
+            args.trace, seed=args.trace_seed,
+            duration_s=args.trace_duration,
+        )
+
+    cfg = LlamaConfig(
+        vocab=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, block_tokens=8, dtype=jnp.float32,
+    )
+    num_blocks, max_blocks = 96, 8
+    conn = InfinityConnection(ClientConfig(
+        host_addr=args.host, service_port=args.service_port,
+        log_level="warning",
+    ))
+    conn.connect()
+    try:
+        kvc = KVConnector(
+            conn, cfg.kv_spec(num_blocks),
+            f"trace-{uuid.uuid4().hex[:8]}", max_blocks=max_blocks,
+        )
+        h = ContinuousBatchingHarness(
+            EngineKVAdapter(kvc),
+            init_params(cfg, jax.random.PRNGKey(0)),
+            cfg, num_blocks, max_blocks, verify=args.verify,
+            wave_skew_policy=args.skew_policy,
+        )
+        h.drafter = NGramDrafter(max_draft=4)
+        t0 = time.perf_counter()
+        stats = asyncio.run(loadgen.replay(trace, h, concurrency=8))
+        wall = time.perf_counter() - t0
+        errs = [s for s in stats if isinstance(s, Exception)]
+        if errs:
+            raise SystemExit(f"trace replay failed: {errs[:3]}")
+        m = h.metrics()
+        return {
+            "trace": args.trace,
+            "trace_seed": trace.seed,
+            "trace_requests": len(trace.requests),
+            "trace_prefill_only": sum(
+                1 for r in trace.requests if r.gen_tokens == 0
+            ),
+            "trace_background": sum(
+                1 for r in trace.requests if r.priority != 0
+            ),
+            "skew_policy": bool(args.skew_policy),
+            "replay_wall_s": round(wall, 3),
+            "requests_per_s": round(len(trace.requests) / wall, 1),
+            "verified": bool(m["all_verified"]) if args.verify else None,
+            "hit_rate": round(m["hit_rate"], 3),
+            "p50_ttft_us": m["p50_ttft_us"],
+            "p99_ttft_us": m["p99_ttft_us"],
+            "p99_ttft_fg_us": m["p99_ttft_fg_us"],
+            "wave_pad_fraction": round(m["wave_pad_fraction"], 4),
+            "decode_waves": m["decode_waves"],
+            "wave_deferrals": m["wave_deferrals"],
+            "wave_aging_escapes": m["wave_aging_escapes"],
+            "wave_held_flushes": m["wave_held_flushes"],
+            "wave_defer_age_us_p99": m["wave_defer_age_us_p99"],
+        }
+    finally:
+        conn.close()
+
+
 async def _run_batched(conn, keys, offsets, block_size, src, dst, steps):
     """Layer-wise streaming shape (reference benchmark.py:188-256): the block
     list is split into `steps` chunks issued as pipelined batched ops."""
@@ -374,6 +484,25 @@ def run(args) -> dict:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.trace:
+        result = _run_trace(args)
+        if args.json:
+            print(json.dumps(result))
+        else:
+            print(
+                f"replayed {result['trace_requests']} requests "
+                f"({result['trace']}) in {result['replay_wall_s']}s "
+                f"(skew_policy={'on' if result['skew_policy'] else 'off'})"
+            )
+            print(
+                f"p99 TTFT: {result['p99_ttft_us']}us (fg "
+                f"{result['p99_ttft_fg_us']}us), pad fraction "
+                f"{result['wave_pad_fraction']}, deferrals "
+                f"{result['wave_deferrals']}"
+            )
+            if result["verified"] is not None:
+                print(f"data verified: {result['verified']}")
+        return 0 if result.get("verified") in (True, None) else 1
     result = run(args)
     if args.json:
         print(json.dumps(result))

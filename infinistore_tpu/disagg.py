@@ -658,6 +658,22 @@ class DisaggHarness:
             for i in range(count)
         ]
 
+    def trace_prompts(
+        self, trace, count: Optional[int] = None
+    ) -> List[List[int]]:
+        """Materialize a loadgen :class:`~infinistore_tpu.loadgen.Trace`
+        into prompts sized for THIS harness (docs/serving_load.md): token
+        lists from the trace's own seed (shared family prefixes intact),
+        clamped to ``req_blocks`` so every prompt fits the harness's
+        per-request table. The trace-driven counterpart of
+        :meth:`heterogeneous_prompts` — one workload definition grades
+        the engine waves, the bench serving leg, AND the disagg handoff."""
+        prompts = trace.prompts(
+            self.config.block_tokens, vocab=self.config.vocab,
+            max_blocks=self.req_blocks,
+        )
+        return prompts[:count] if count is not None else prompts
+
     def fresh_caches(self):
         return self.config.kv_spec(self.num_blocks).make_caches()
 

@@ -63,6 +63,102 @@ from .tpu.staging import StagingPoolExhausted
 from .wire import PRIORITY_BACKGROUND
 
 
+class WaveCounters:
+    """Process-wide skew-aware wave-policy ledger (ITS-C010,
+    docs/serving_load.md).
+
+    The ``engine_wave_*`` vocabulary the manage plane's /metrics exporter
+    re-serves (``server.py _engine_wave_prometheus_lines``) and ``GET
+    /wave`` snapshots — kept in lockstep with both and with the
+    serving-load docs by the counters checker. Per-harness figures live
+    in ``ContinuousBatchingHarness.metrics``; this singleton aggregates
+    across every decoder in the process so dashboards see engine flows
+    without holding a harness reference. Key vocabulary (every key
+    ``engine_wave_``-prefixed, documented in docs/serving_load.md):
+
+    - ``engine_wave_deferrals``: chunks re-queued to ride a later wave
+      because launching them now would bump the (T, P) jit bucket past
+      the marginal-pad threshold.
+    - ``engine_wave_aging_escapes``: deferred chunks force-launched
+      because their deferral age crossed the QoS-aware starvation bound
+      (``wave_defer_max_s``) — the proof deferral never starves.
+    - ``engine_wave_held_flushes``: whole flushes held back by the EWMA
+      wave-size target (a hot engine refusing a degenerate 1-row wave).
+    - ``engine_wave_policy_waves``: waves launched with the policy on.
+    - ``engine_wave_defer_age_us_p99``: p99 deferral age at launch.
+    - ``engine_wave_bucket_occupancy``: real rows / launched rows over
+      policy waves (1 - pad fraction — what the deferral rule raises).
+    """
+
+    def __init__(self):
+        self._c = {
+            # Requests re-queued to ride a later wave because launching
+            # them now would bump the (T, P) jit bucket past the pad
+            # threshold.
+            "engine_wave_deferrals": 0,
+            # Deferred requests force-launched because their deferral age
+            # crossed the starvation bound (wave_defer_max_s, QoS-aware).
+            "engine_wave_aging_escapes": 0,
+            # Whole flushes held back by the EWMA wave-size target (a hot
+            # engine refusing to launch a degenerate under-target wave).
+            "engine_wave_held_flushes": 0,
+            # Waves launched with the skew policy active.
+            "engine_wave_policy_waves": 0,
+        }
+        self._ages_us: list = []
+        self._real_rows = 0
+        self._launched_rows = 0
+
+    def bump(self, key: str, n: int = 1):
+        self._c[key] += n
+
+    def note_defer_age(self, age_us: float):
+        """Record a previously-deferred entry's age at launch (bounded)."""
+        if len(self._ages_us) < 8192:
+            self._ages_us.append(age_us)
+
+    def note_wave(self, real_rows: int, launched_rows: int):
+        self._real_rows += real_rows
+        self._launched_rows += launched_rows
+
+    def status(self) -> dict:
+        c = self._c
+        ages = sorted(self._ages_us)
+        p99 = ages[min(len(ages) - 1, int(len(ages) * 0.99))] if ages else 0.0
+        return {
+            "engine_wave_deferrals": c["engine_wave_deferrals"],
+            "engine_wave_aging_escapes": c["engine_wave_aging_escapes"],
+            "engine_wave_held_flushes": c["engine_wave_held_flushes"],
+            "engine_wave_policy_waves": c["engine_wave_policy_waves"],
+            # p99 deferral age at launch: how long the policy actually
+            # parks a request (bounded by the starvation rule).
+            "engine_wave_defer_age_us_p99": round(p99, 1),
+            # Fraction of launched wave rows that were REAL (1 - pad
+            # fraction), over policy-launched waves: the bucket-economics
+            # figure the deferral rule exists to raise.
+            "engine_wave_bucket_occupancy": (
+                round(self._real_rows / self._launched_rows, 4)
+                if self._launched_rows
+                else 0.0
+            ),
+        }
+
+
+_WAVE_COUNTERS = WaveCounters()
+
+
+def wave_counters() -> WaveCounters:
+    """The process-wide wave-policy ledger (see :class:`WaveCounters`)."""
+    return _WAVE_COUNTERS
+
+
+def reset_wave_counters() -> WaveCounters:
+    """Fresh ledger (test isolation); returns the new one."""
+    global _WAVE_COUNTERS
+    _WAVE_COUNTERS = WaveCounters()
+    return _WAVE_COUNTERS
+
+
 class BlockPool:
     """Engine-owned physical block allocator (the block-table manager).
 
@@ -201,15 +297,82 @@ class WaveDecoder:
     share of launched wave rows that were padding (the rectangle's was
     1 - sum(len_i) / (B_bucket * K_bucket); the ragged tail's is
     1 - sum(len_i) / T_bucket).
+
+    **Skew-aware flush policy** (``skew_policy=True``, off by default;
+    docs/serving_load.md): blind first-arrival flush lets one 8:1-skew
+    outlier bump the whole wave's (T, P) jit bucket and pad every other
+    row. With the policy on, the flush PARTITIONS the taken batch: an
+    entry whose rows/pages would bump the power-of-two bucket AND whose
+    marginal pad cost exceeds ``defer_pad_frac`` rides the next wave —
+    UNLESS its deferral age crossed the starvation bound
+    (``defer_max_s`` for FOREGROUND entries, ``defer_max_bg_s`` for
+    BACKGROUND ones — the QoS class ``step_chunk`` carries), in which
+    case it launches now (an *aging escape*). An EWMA arrival-rate
+    wave-size target additionally holds a degenerate under-target flush
+    for up to ``hold_max_s`` while arrivals are hot, so a busy engine
+    stops launching 1-row waves. Deferred entries return to the FRONT
+    of the queue and a timed kick guarantees a re-flush even with no
+    new arrivals — deferral is never stranding, and each flush keeps at
+    least its smallest entry, so progress is unconditional. The policy
+    is scheduling-only: it changes which wave a chunk rides, never its
+    bytes — the byte-identity property vs sequential decode holds with
+    deferral on (tested).
+
+    **Canonical bucket ladder** (policy on): a blind flush jit-buckets
+    each dimension independently, so serving mints the organic
+    (B, T, P) PRODUCT one ~1 s XLA compile at a time — measured traces
+    reach ~25 distinct triples, discovered stochastically across
+    rounds. With the policy on every launch instead lands on the
+    declared bucket ``(T, T, T * max_req_blocks)``: table rows pad up
+    to the flat-row rung (free — a padded table row neither scatters
+    nor attends) and pages pad to the rung maximum (padded pages fold
+    fully masked), leaving T — the only dimension whose padding costs
+    real compute — on its power-of-two ladder, already bounded by the
+    deferral rule. One jit entry per rung means the whole compiled
+    working set is known AT STARTUP:
+    ``ContinuousBatchingHarness.prewarm_wave_buckets`` compiles the
+    ladder before serving, so the policy path never pays a mid-serving
+    recompile stall. The padding is masked/unreferenced either way, so
+    byte identity is unchanged.
     """
 
-    def __init__(self, harness: "ContinuousBatchingHarness"):
+    def __init__(
+        self,
+        harness: "ContinuousBatchingHarness",
+        skew_policy: bool = False,
+        defer_max_s: float = 0.025,
+        defer_max_bg_s: Optional[float] = None,
+        defer_pad_frac: float = 0.25,
+        hold_max_s: float = 0.002,
+    ):
         self.h = harness
+        self.skew_policy = skew_policy
+        self.defer_max_s = defer_max_s
+        # BACKGROUND entries tolerate 4x the deferral age by default: the
+        # starvation bound is QoS-aware (docs/qos.md), so deferring a
+        # heavy background outlier never costs a foreground TTFT.
+        self.defer_max_bg_s = (
+            defer_max_bg_s if defer_max_bg_s is not None else defer_max_s * 4
+        )
+        self.defer_pad_frac = defer_pad_frac
+        self.hold_max_s = hold_max_s
         self._pending: List[tuple] = []
         self._flush_scheduled = False
         # Wave-row padding ledger (engine_wave_pad_fraction).
         self.pad_rows = 0
         self.launched_rows = 0
+        # Skew-policy ledger (per-decoder; the process-wide WaveCounters
+        # singleton aggregates the same events for /metrics).
+        self.deferrals = 0
+        self.aging_escapes = 0
+        self.held_flushes = 0
+        self.defer_ages_us: List[float] = []
+        # EWMA of chunk inter-arrival seconds (policy on only): the
+        # wave-size target is hold_max_s / interval — what a full hold
+        # window would coalesce at the current arrival rate.
+        self._ewma_interval: Optional[float] = None
+        self._last_arrival: Optional[float] = None
+        self._kick_handle = None
         # Strong references: the event loop holds only weak refs to tasks,
         # so a fire-and-forget flush could be GC'd mid-flight and strand
         # every waiter with _flush_scheduled stuck True. A SET, not a slot:
@@ -220,28 +383,171 @@ class WaveDecoder:
         self.waves = 0
         self.max_wave = 0
         self.bucket_sizes = set()  # distinct PADDED (B, K) buckets (= compiles)
+        # Canonical (B, T, P) buckets prewarm_wave_buckets compiled at
+        # startup — organic bucket_sizes stays launch-driven so the two
+        # sets can be compared (serving must mint nothing beyond the
+        # declared ladder with the policy on).
+        self.prewarmed = set()
 
-    async def step(self, token: int, position: int, padded_table) -> jax.Array:
+    async def step(
+        self, token: int, position: int, padded_table, priority: int = 0
+    ) -> jax.Array:
         """Advance this request by one token; returns its logits row."""
-        rows = await self.step_chunk([token], [position], padded_table)
+        rows = await self.step_chunk(
+            [token], [position], padded_table, priority=priority
+        )
         return rows[0]
 
     async def step_chunk(
-        self, tokens: Sequence[int], positions: Sequence[int], padded_table
+        self,
+        tokens: Sequence[int],
+        positions: Sequence[int],
+        padded_table,
+        priority: int = 0,
     ) -> jax.Array:
         """Advance this request by a token chunk (tokens[0] committed,
         tokens[1:] speculative); returns its [len(tokens), vocab] logits
-        rows — row j follows tokens[:j+1]."""
+        rows — row j follows tokens[:j+1]. ``priority`` is the request's
+        QoS class (wire.PRIORITY_*): the skew policy's starvation bound
+        is tighter for FOREGROUND entries; with the policy off it is
+        recorded and ignored."""
         if not tokens or len(tokens) != len(positions):
             raise ValueError("need non-empty tokens with matching positions")
+        now = time.perf_counter()
+        if self.skew_policy:
+            if self._last_arrival is not None:
+                dt = now - self._last_arrival
+                self._ewma_interval = (
+                    dt if self._ewma_interval is None
+                    else 0.2 * dt + 0.8 * self._ewma_interval
+                )
+            self._last_arrival = now
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((list(tokens), list(positions), padded_table, fut))
+        # Entry layout: (tokens, positions, table, future, enqueue_t,
+        # qos_priority, defer_count). The trailing three fields are
+        # policy metadata — the policy-off path never reads them.
+        self._pending.append(
+            (list(tokens), list(positions), padded_table, fut, now, priority, 0)
+        )
         if not self._flush_scheduled:
             self._flush_scheduled = True
             task = asyncio.ensure_future(self._flush())
             self._flush_tasks.add(task)
             task.add_done_callback(self._flush_tasks.discard)
         return await fut
+
+    # -- skew-aware flush policy (docs/serving_load.md) ---------------------
+
+    def _defer_bound_s(self, priority: int) -> float:
+        """Starvation bound for one entry's QoS class."""
+        return (
+            self.defer_max_bg_s if priority == PRIORITY_BACKGROUND
+            else self.defer_max_s
+        )
+
+    def _target_rows(self) -> float:
+        """EWMA wave-size target: the rows a full hold window would
+        coalesce at the observed arrival rate (1.0 when idle/unknown —
+        an idle engine never holds a flush)."""
+        if not self._ewma_interval or self._ewma_interval <= 0:
+            return 1.0
+        return min(32.0, self.hold_max_s / self._ewma_interval)
+
+    def _entry_pages(self, entry) -> int:
+        """Attention pages this entry's flat rows contribute (the same
+        per-row rule build_ragged_wave applies: ceil((pos+1)/bt))."""
+        bt = self.h.config.block_tokens
+        return sum(-(-(p + 1) // bt) for p in entry[1])
+
+    def _partition(self, batch: List[tuple], now: float):
+        """Split a taken batch into (take, defer) under the skew rule.
+
+        Aged entries (deferral age past their QoS bound) always launch.
+        Remaining entries are admitted smallest-chunk-first; one is
+        deferred only when adding it bumps the power-of-two row or page
+        bucket AND the resulting marginal pad fraction exceeds
+        ``defer_pad_frac``. The first admitted entry is unconditional,
+        so a flush with any entry at all always launches at least one —
+        deferral can delay a chunk, never starve it."""
+        aged, flex = [], []
+        for e in batch:
+            age = now - e[4]
+            if age >= self._defer_bound_s(e[5]):
+                aged.append(e)
+            else:
+                flex.append(e)
+        for e in aged:
+            if e[6] > 0:
+                # Previously deferred, now force-launched by age: the
+                # starvation rule fired.
+                self.aging_escapes += 1
+                _WAVE_COUNTERS.bump("engine_wave_aging_escapes")
+        # EWMA hold: a hot engine flushing a degenerate under-target wave
+        # holds the WHOLE batch for the next kick instead — but never past
+        # hold_max_s of the oldest entry's age, and never when an aged
+        # entry must launch.
+        if not aged and flex:
+            rows = sum(len(e[0]) for e in flex)
+            oldest = max(now - e[4] for e in flex)
+            if rows < self._target_rows() and oldest < self.hold_max_s:
+                self.held_flushes += 1
+                _WAVE_COUNTERS.bump("engine_wave_held_flushes")
+                return [], [e[:6] + (e[6] + 1,) for e in flex]
+        kept = {id(e) for e in aged}
+        kept_rows = sum(len(e[0]) for e in aged)
+        kept_pages = sum(self._entry_pages(e) for e in aged)
+        deferred_ids = set()
+        for e in sorted(flex, key=lambda e: len(e[0])):
+            r, p = len(e[0]), self._entry_pages(e)
+            if kept_rows or kept_pages:
+                t_new = 1 << (kept_rows + r - 1).bit_length()
+                t_old = 1 << (kept_rows - 1).bit_length()
+                p_new = 1 << (kept_pages + p - 1).bit_length()
+                p_old = 1 << (kept_pages - 1).bit_length()
+                bump_t = t_new > t_old and (
+                    (t_new - (kept_rows + r)) / t_new > self.defer_pad_frac
+                )
+                bump_p = p_new > p_old and (
+                    (p_new - (kept_pages + p)) / p_new > self.defer_pad_frac
+                )
+                if bump_t or bump_p:
+                    deferred_ids.add(id(e))
+                    continue
+            kept.add(id(e))
+            kept_rows += r
+            kept_pages += p
+        take, defer = [], []
+        for e in batch:  # preserve arrival order on both sides
+            if id(e) in deferred_ids:
+                self.deferrals += 1
+                _WAVE_COUNTERS.bump("engine_wave_deferrals")
+                defer.append(e[:6] + (e[6] + 1,))
+            else:
+                take.append(e)
+        return take, defer
+
+    def _schedule_kick(self, deferred: List[tuple], now: float):
+        """Guarantee a future flush for re-queued entries even if no new
+        chunk ever arrives: a timed kick at (roughly) the earliest
+        starvation deadline, clamped to the hold window."""
+        if self._kick_handle is not None:
+            return
+        remaining = min(
+            max(self._defer_bound_s(e[5]) - (now - e[4]), 0.0)
+            for e in deferred
+        )
+        delay = max(min(remaining, self.hold_max_s), 0.0005)
+        self._kick_handle = asyncio.get_running_loop().call_later(
+            delay, self._kick
+        )
+
+    def _kick(self):
+        self._kick_handle = None
+        if self._pending and not self._flush_scheduled:
+            self._flush_scheduled = True
+            task = asyncio.ensure_future(self._flush())
+            self._flush_tasks.add(task)
+            task.add_done_callback(self._flush_tasks.discard)
 
     async def _flush(self):
         batch: List[tuple] = []
@@ -255,6 +561,22 @@ class WaveDecoder:
             self._flush_scheduled = False
             if not batch:
                 return
+            if self.skew_policy:
+                now = time.perf_counter()
+                batch, deferred = self._partition(batch, now)
+                if deferred:
+                    # Front of the queue: deferred entries are older than
+                    # anything arriving after the take, and the kick
+                    # guarantees a re-flush even with no new arrivals.
+                    self._pending[:0] = deferred
+                    self._schedule_kick(deferred, now)
+                if not batch:
+                    return
+                for e in batch:
+                    if e[6] > 0:
+                        age_us = (now - e[4]) * 1e6
+                        self.defer_ages_us.append(age_us)
+                        _WAVE_COUNTERS.note_defer_age(age_us)
             # Ragged assembly (class docstring): concatenate the chunks
             # into one flat token list; pad only at the tail to the
             # power-of-two row bucket by repeating the last flat row
@@ -263,7 +585,7 @@ class WaveDecoder:
             flat_toks: List[int] = []
             flat_pos: List[int] = []
             row_of: List[int] = []
-            for r, (toks, pos, _tbl, _fut) in enumerate(batch):
+            for r, (toks, pos, _tbl, _fut, *_) in enumerate(batch):
                 flat_toks.extend(toks)
                 flat_pos.extend(pos)
                 row_of.extend([r] * len(toks))
@@ -276,20 +598,36 @@ class WaveDecoder:
             # a padded row, so it neither scatters nor attends. Tables
             # arrive host-resident (_padded_table) — converting a DEVICE
             # array here would pay a blocking sync per request per wave.
-            b_bucket = 1 << (len(batch) - 1).bit_length()
+            # Policy on: canonical bucket ladder (class docstring) — B
+            # pads to the flat-row rung and pages to the rung maximum,
+            # so the launch lands on (T, T, T * max_req_blocks), the one
+            # declared jit bucket per rung that prewarm_wave_buckets
+            # compiled at startup. Both pads are compute-free; t_bucket
+            # >= one flat row per entry >= len(batch) always covers.
+            if self.skew_policy:
+                b_bucket = t_bucket
+                pad_pages = t_bucket * self.h.max_req_blocks
+            else:
+                b_bucket = 1 << (len(batch) - 1).bit_length()
+                pad_pages = 0
             tables = [np.asarray(b[2], dtype=np.int32) for b in batch]
             tables.extend([tables[-1]] * (b_bucket - len(batch)))
-            # The builder picks the page bucket (pad_to_pow2): the per-row
-            # page-count rule lives in build_ragged_wave alone.
+            # The builder picks the page bucket (pad_to_pow2, or the
+            # canonical pad_to): the per-row page-count rule lives in
+            # build_ragged_wave alone.
             meta = build_ragged_wave(
                 [tables[r] for r in row_of],
                 [p + 1 for p in flat_pos],
                 self.h.config.block_tokens,
+                pad_to=pad_pages,
                 pad_to_pow2=True,
             )
             self.bucket_sizes.add((b_bucket, t_bucket, meta.num_pages))
             self.pad_rows += t_bucket - t_real
             self.launched_rows += t_bucket
+            if self.skew_policy:
+                _WAVE_COUNTERS.bump("engine_wave_policy_waves")
+                _WAVE_COUNTERS.note_wave(t_real, t_bucket)
 
             async with self.h.gate.exclusive():
                 logits, self.h.caches = verify_step_ragged(
@@ -308,7 +646,7 @@ class WaveDecoder:
             self.waves += 1
             self.max_wave = max(self.max_wave, len(batch))
             off = 0
-            for toks, _, _, fut in batch:
+            for toks, _, _, fut, *_ in batch:
                 if not fut.done():
                     fut.set_result(logits[off : off + len(toks)])
                 off += len(toks)
@@ -321,7 +659,7 @@ class WaveDecoder:
             exc = e if isinstance(e, Exception) else RuntimeError(
                 f"decode wave aborted: {e!r}"
             )
-            for _, _, _, fut in stranded:
+            for _, _, _, fut, *_ in stranded:
                 if not fut.done():
                     fut.set_exception(exc)
             if not isinstance(e, Exception):
@@ -521,6 +859,12 @@ class RequestStats:
     # computed): the end-to-end figure that decides whether a cache hit
     # actually beats recomputing.
     prefix_ready_us: float = 0.0
+    # t0 -> the FIRST generated token emitted (0.0 when gen_tokens == 0):
+    # the serving-side latency figure the skew-aware flush policy is
+    # graded on (docs/serving_load.md), and the request's QoS class
+    # (wire.PRIORITY_*) so TTFT percentiles split by class.
+    ttft_us: float = 0.0
+    priority: int = 0
 
 
 class ContinuousBatchingHarness:
@@ -551,12 +895,22 @@ class ContinuousBatchingHarness:
         verify: bool = False,
         verify_tol: float = 2e-4,
         drafter: Optional[NGramDrafter] = None,
+        wave_skew_policy: bool = False,
+        wave_defer_max_s: float = 0.025,
+        wave_defer_max_bg_s: Optional[float] = None,
+        wave_defer_pad_frac: float = 0.25,
+        wave_hold_max_s: float = 0.002,
     ):
         """``drafter``: enables speculative decoding in the serving loop —
         each generation round verifies the drafted chunk in one wave row
         (verify_step_ragged), emitting every greedy-accepted token plus
         the model's continuation, so tokens/round can exceed 1 with output
-        identical to plain greedy decode."""
+        identical to plain greedy decode.
+
+        ``wave_skew_policy`` + the ``wave_defer_*`` / ``wave_hold_max_s``
+        knobs: the WaveDecoder's skew-aware deferral flush policy
+        (docs/serving_load.md). Off by default — the False path is
+        behavior-identical to the blind first-arrival flush (tested)."""
         self.adapter = adapter
         self.params = params
         self.config = config
@@ -567,7 +921,14 @@ class ContinuousBatchingHarness:
         self.caches = config.kv_spec(num_blocks).make_caches()
         self.pool = BlockPool(num_blocks)
         self.gate = DeviceGate()
-        self.wave = WaveDecoder(self)
+        self.wave = WaveDecoder(
+            self,
+            skew_policy=wave_skew_policy,
+            defer_max_s=wave_defer_max_s,
+            defer_max_bg_s=wave_defer_max_bg_s,
+            defer_pad_frac=wave_defer_pad_frac,
+            hold_max_s=wave_hold_max_s,
+        )
         self.max_req_blocks = max_req_blocks
         self.verify = verify
         # float-exact stores hold 2e-4; a quantizing adapter (int8 blocks,
@@ -600,6 +961,56 @@ class ContinuousBatchingHarness:
         self._prefill = jax.jit(prefill, static_argnames=("config",))
 
     # -- model compute -------------------------------------------------------
+
+    async def prewarm_wave_buckets(self, max_rows: int = 64) -> list:
+        """Precompile the skew policy's declared wave-bucket ladder.
+
+        With ``wave_skew_policy`` on, every wave launches on the
+        canonical ``(T, T, T * max_req_blocks)`` bucket (WaveDecoder
+        docstring), so the jit working set is KNOWN AT STARTUP: one
+        bucket per power-of-two row rung up to ``max_rows``. This runs
+        one throwaway wave per rung — the real ``verify_step_ragged``
+        program, zero tokens at position 0 — so every bucket compile
+        lands here instead of stalling a serving round (the mid-serving
+        XLA recompile is the tail-latency pathology
+        docs/serving_load.md measures). The dummy scatter rides block
+        0 slot 0, harmless under the cache invariant: a request only
+        attends slots its own prefill/decode populated. No-op
+        (returns ``[]``) with the policy off — a blind flush has no
+        declared shape set, which is exactly why it keeps compiling
+        mid-serving. Returns the prewarmed ladder."""
+        if not self.wave.skew_policy:
+            return []
+        mrb = self.max_req_blocks
+        ladder = []
+        t = 1
+        while t <= max_rows:
+            meta = build_ragged_wave(
+                [np.zeros(mrb, dtype=np.int32)] * t,
+                [1] * t,
+                self.config.block_tokens,
+                pad_to=t * mrb,
+            )
+            zeros_t = jnp.zeros((t,), jnp.int32)
+            async with self.gate.exclusive():
+                _, self.caches = verify_step_ragged(
+                    self.params,
+                    zeros_t,
+                    zeros_t,
+                    zeros_t,
+                    jnp.asarray(meta.pages),
+                    jnp.asarray(meta.page_rows),
+                    jnp.asarray(meta.page_starts),
+                    self.caches,
+                    jnp.asarray(np.zeros((t, mrb), np.int32)),
+                    self.config,
+                    mrb,
+                )
+            bucket = (t, t, t * mrb)
+            self.wave.prewarmed.add(bucket)
+            ladder.append(bucket)
+            t <<= 1
+        return ladder
 
     def _padded_table(self, table: np.ndarray) -> np.ndarray:
         """Host-resident padded table. Numpy ON PURPOSE: the WaveDecoder
@@ -685,7 +1096,9 @@ class ContinuousBatchingHarness:
         finally:
             self._saving -= 1
 
-    async def _generate(self, token_ids, table: np.ndarray, gen_tokens: int):
+    async def _generate(
+        self, token_ids, table: np.ndarray, gen_tokens: int, priority: int = 0
+    ):
         """Greedy generation through the shared WaveDecoder: every live
         request advances one round per lockstep wave (the continuous-
         batching inner loop). The first round re-decodes the last prompt
@@ -700,20 +1113,27 @@ class ContinuousBatchingHarness:
         tokens/round > 1 whenever drafts land, and rejected rows cost
         nothing (their K/V is masked by position until real tokens
         overwrite it). The chunk is capped to the tokens still wanted, so
-        a round never overshoots ``gen_tokens``."""
+        a round never overshoots ``gen_tokens``.
+
+        Returns ``(tokens, first_token_t)`` — the perf_counter stamp of
+        the first emitted token feeds ``RequestStats.ttft_us``."""
         padded = self._padded_table(table)
         pos = len(token_ids) - 1
         tok = int(token_ids[-1])
         history = list(token_ids)
         out: List[int] = []
+        first_token_t: Optional[float] = None
         while len(out) < gen_tokens:
             chunk = [tok]
             if self.drafter is not None:
                 remaining = gen_tokens - len(out)
                 chunk += self.drafter.draft(history)[: remaining - 1]
             rows = await self.wave.step_chunk(
-                chunk, list(range(pos, pos + len(chunk))), padded
+                chunk, list(range(pos, pos + len(chunk))), padded,
+                priority=priority,
             )
+            if first_token_t is None:
+                first_token_t = time.perf_counter()
             # ONE device->host transfer per round (the [K] argmaxes).
             preds = np.asarray(jnp.argmax(rows, axis=-1))
             n_acc = 1
@@ -733,8 +1153,8 @@ class ContinuousBatchingHarness:
         # one more step lands it; otherwise its block is an incomplete tail
         # with no chain key — skip the wasted wave.
         if (len(token_ids) + gen_tokens) % self.config.block_tokens == 0:
-            await self.wave.step(tok, pos, padded)
-        return out
+            await self.wave.step(tok, pos, padded, priority=priority)
+        return out, first_token_t
 
     def _verify_request(self, token_ids, table: np.ndarray) -> bool:
         """Compare the harness cache's blocks for this request against a
@@ -764,8 +1184,16 @@ class ContinuousBatchingHarness:
     # -- request lifecycle ---------------------------------------------------
 
     async def run_request(
-        self, token_ids: Sequence[int], gen_tokens: int = 0
+        self,
+        token_ids: Sequence[int],
+        gen_tokens: int = 0,
+        priority: int = 0,
     ) -> RequestStats:
+        """``priority``: the request's QoS class (wire.PRIORITY_*).
+        BACKGROUND requests tag their speculative store prefetch
+        background and tolerate a longer wave-deferral age under the
+        skew-aware flush policy (docs/serving_load.md); the class is
+        recorded on the stats so TTFT percentiles split by class."""
         bt = self.config.block_tokens
         n_blocks = len(token_ids) // bt
         total_blocks = -(-(n_blocks * bt + gen_tokens) // bt)
@@ -835,6 +1263,7 @@ class ContinuousBatchingHarness:
                 fetch_kw = {}
                 if getattr(self.adapter, "QOS_AWARE", False) and (
                     self.pool.available < total_blocks
+                    or priority == PRIORITY_BACKGROUND
                 ):
                     fetch_kw["priority"] = PRIORITY_BACKGROUND
                 try:
@@ -957,8 +1386,13 @@ class ContinuousBatchingHarness:
                     token_ids, prompt_table[loaded_blocks:], loaded_blocks
                 )
             generated = None
+            ttft_us = 0.0
             if gen_tokens:
-                generated = await self._generate(token_ids, table, gen_tokens)
+                generated, first_token_t = await self._generate(
+                    token_ids, table, gen_tokens, priority=priority
+                )
+                if first_token_t is not None:
+                    ttft_us = (first_token_t - t0) * 1e6
                 # Save the COMPLETE blocks the response filled, keyed by the
                 # extended chain (prompt + generated): a follow-up turn whose
                 # prompt is this conversation so far gets a full prefix hit
@@ -991,6 +1425,8 @@ class ContinuousBatchingHarness:
                     prefetch.wasted_blocks if prefetch is not None else 0
                 ),
                 prefix_ready_us=prefix_ready_us,
+                ttft_us=ttft_us,
+                priority=priority,
             )
             self.stats.append(stats)
             return stats
@@ -1061,9 +1497,18 @@ class ContinuousBatchingHarness:
         (``recompute_saved_s``, ``prefill_per_block_s``); concurrency
         receipts (``max_live_requests``, ``max_concurrent_saves``); the
         ragged wave-decode story (``decode_waves``, ``max_wave_size``,
-        ``wave_buckets`` — distinct padded (B, T, P) jit buckets — and
+        ``wave_buckets`` — distinct padded (B, T, P) jit buckets —
+        ``wave_prewarmed_buckets`` — the canonical ladder
+        ``prewarm_wave_buckets`` compiled at startup — and
         ``wave_pad_fraction``, the share of launched wave rows that were
-        padding); generation/speculation (``generated_tokens``,
+        padding); the skew-aware flush policy's ledger
+        (docs/serving_load.md: ``wave_deferrals``,
+        ``wave_aging_escapes`` — deferred entries force-launched at the
+        starvation bound, ``wave_held_flushes`` — whole flushes held by
+        the EWMA wave-size target, ``wave_defer_age_us_p99``) and
+        serving latency (``p50_ttft_us``, ``p99_ttft_us``,
+        ``p99_ttft_fg_us`` — time to first generated token, overall and
+        FOREGROUND-class only); generation/speculation (``generated_tokens``,
         ``spec_tokens_per_step``, ``spec_acceptance_rate``,
         ``spec_drafted_tokens``, ``spec_accepted_tokens``);
         ``all_verified``; and, over a self-healing pool, ``store_health``.
@@ -1091,6 +1536,11 @@ class ContinuousBatchingHarness:
         ready_hit = sorted(s.prefix_ready_us for s in self.stats if s.loaded_blocks)
         ready_miss = sorted(
             s.prefix_ready_us for s in self.stats if not s.loaded_blocks
+        )
+        ttft = sorted(s.ttft_us for s in self.stats if s.ttft_us > 0)
+        ttft_fg = sorted(
+            s.ttft_us for s in self.stats
+            if s.ttft_us > 0 and s.priority != PRIORITY_BACKGROUND
         )
 
         def _p(xs, q):
@@ -1150,6 +1600,9 @@ class ContinuousBatchingHarness:
             # the ragged wave step (jit keys on shape): the compile-count
             # story.
             "wave_buckets": sorted(self.wave.bucket_sizes),
+            # The canonical ladder prewarm_wave_buckets compiled at
+            # startup (policy on): serving must mint nothing beyond it.
+            "wave_prewarmed_buckets": sorted(self.wave.prewarmed),
             # Share of launched wave rows that were padding (ragged
             # assembly pads only the flat tail; the old rectangle padded
             # every short chunk to the widest one) — the attribution key
@@ -1159,6 +1612,19 @@ class ContinuousBatchingHarness:
                 if self.wave.launched_rows
                 else 0.0
             ),
+            # Skew-aware flush policy (docs/serving_load.md): the per-
+            # harness deferral ledger (the process-wide WaveCounters
+            # singleton aggregates the same events for /metrics), and
+            # time-to-first-token — the latency figure the policy is
+            # graded on, split so the FOREGROUND class's tail is visible
+            # next to the mixed one.
+            "wave_deferrals": self.wave.deferrals,
+            "wave_aging_escapes": self.wave.aging_escapes,
+            "wave_held_flushes": self.wave.held_flushes,
+            "wave_defer_age_us_p99": _p(sorted(self.wave.defer_ages_us), 0.99),
+            "p50_ttft_us": _p(ttft, 0.50),
+            "p99_ttft_us": _p(ttft, 0.99),
+            "p99_ttft_fg_us": _p(ttft_fg, 0.99),
             "generated_tokens": sum(
                 len(s.generated) for s in self.stats if s.generated
             ),

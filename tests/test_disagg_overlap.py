@@ -245,13 +245,24 @@ class TestChaos:
     ):
         """kill -9 the prefill ENGINE subprocess mid-handoff: layers 0..1
         durable, deeper layers never arrive; the decode side's retry
-        deadline expires and the leg recomputes — never wrong bytes."""
+        deadline expires and the leg recomputes — never wrong bytes.
+
+        The kill window opens only after BOTH layers' durability markers
+        (in any order — ships are concurrent, and under in-suite load
+        layer 1's puts can finish before layer 0's): killing on the last
+        marker alone could SIGKILL while layer 0 is still partially
+        written, and the fallback would then fire at layer 0 instead of
+        the first never-shipped layer (the one-flake-in-suite PR 17
+        noted)."""
         member = fleet.spawn_disagg_prefill(
             store.port, blocks=REQ_BLOCKS, n_layers=CFG.n_layers,
             prompt_seed=9, stall_after_layer=1, stall_s=60.0,
         )
         try:
-            fleet.read_until_marker(member, "shipped layer 1", timeout_s=180.0)
+            fleet.read_until_markers(
+                member, ["shipped layer 0", "shipped layer 1"],
+                timeout_s=180.0,
+            )
             assert fleet.kill_member(member) == -9
         finally:
             if member["proc"].poll() is None:

@@ -174,17 +174,35 @@ def read_until_marker(member: dict, marker: str, timeout_s: float = 120.0):
     """Read the member's piped stdout line by line until ``marker`` is a
     substring; returns the matching line. The caller owns the deadline
     semantics (a dead process raises RuntimeError — its stream EOFs)."""
+    return read_until_markers(member, [marker], timeout_s=timeout_s)[marker]
+
+
+def read_until_markers(
+    member: dict, markers, timeout_s: float = 120.0
+) -> dict:
+    """Read piped stdout until EVERY marker in ``markers`` has appeared,
+    in ANY order; returns ``{marker: matching line}``. The order-free
+    contract matters for durability gating: ``stream_prefill`` ships
+    layers concurrently (``max_inflight_ships``), so ``shipped layer 1``
+    can legally print before ``shipped layer 0`` under load — a caller
+    that waits for the LAST marker alone can act while an earlier
+    layer's puts are still in flight."""
+    want = {m: None for m in markers}
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:
         line = member["proc"].stdout.readline()
         if not line:
             raise RuntimeError(
-                f"stdout EOF before marker {marker!r} "
+                f"stdout EOF before markers {list(want)!r} "
                 f"(exit={member['proc'].poll()})"
             )
-        if marker in line:
-            return line.strip()
-    raise RuntimeError(f"timeout waiting for marker {marker!r}")
+        for m in want:
+            if want[m] is None and m in line:
+                want[m] = line.strip()
+        if all(v is not None for v in want.values()):
+            return want
+    missing = [m for m, v in want.items() if v is None]
+    raise RuntimeError(f"timeout waiting for markers {missing!r}")
 
 
 # ---------------------------------------------------------------------------

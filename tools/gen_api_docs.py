@@ -39,7 +39,8 @@ SURFACE = [
     ("infinistore_tpu.connector", ["KVConnector", "token_chain_hashes"]),
     ("infinistore_tpu.engine", [
         "EngineKVAdapter", "ContinuousBatchingHarness", "BlockPool",
-        "WaveDecoder", "DeviceGate", "RequestStats",
+        "WaveDecoder", "DeviceGate", "RequestStats", "WaveCounters",
+        "wave_counters", "reset_wave_counters",
     ]),
     ("infinistore_tpu.cluster", [
         "ClusterKVConnector", "rendezvous_owner", "rendezvous_ranked",
@@ -77,6 +78,9 @@ SURFACE = [
         "KVConnectorBase_V1",
         "InfiniStoreKVConnectorV1",
         "InfiniStoreConnectorMetadata",
+    ]),
+    ("infinistore_tpu.loadgen", [
+        "TraceRequest", "Trace", "generate", "preset", "replay",
     ]),
     ("infinistore_tpu.disagg", [
         "DisaggCounters", "DisaggHarness", "counters", "reset_counters",
