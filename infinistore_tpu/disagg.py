@@ -123,6 +123,10 @@ class DisaggCounters:
     """
 
     def __init__(self):
+        # Written only on the role's engine loop (prefill ships / decode
+        # admits on their own asyncio loop); the manage-plane server
+        # thread snapshots via status().
+        # its: guard[_c: single_writer]
         self._c = {
             "disagg_handoffs": 0,
             "disagg_overlap_layers": 0,

@@ -91,6 +91,9 @@ class WaveCounters:
     """
 
     def __init__(self):
+        # Written only on the engine loop (the flush/launch path runs
+        # there); the manage-plane server thread snapshots via status().
+        # its: guard[_c, _ages_us, _real_rows, _launched_rows: single_writer]
         self._c = {
             # Requests re-queued to ride a later wave because launching
             # them now would bump the (T, P) jit bucket past the pad

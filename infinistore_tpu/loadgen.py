@@ -30,6 +30,11 @@ against one traffic shape:
 The trace is a plain JSON document (``Trace.to_json``/``from_json``;
 schema in docs/serving_load.md) — the replay side never re-runs the
 generator, so a saved trace reproduces a result bit-for-bit later.
+
+Concurrency (ITS-R audit): none. Generation and replay are pure
+single-threaded functions over a seeded ``numpy`` Generator; the module
+spawns no threads, holds no locks, and shares no mutable state — the
+consumers (engine harness, bench legs) each own their Trace instance.
 """
 
 import json

@@ -1,0 +1,495 @@
+"""Tests for the ITS-M model-checking layer (tools/analysis/specs,
+tools/analysis/modelcheck) and the counterexample->test replay bridge
+(tools/analysis/interleave.replay_schedule).
+
+Three layers:
+
+1. **Explorer mechanics**: BFS over all interleavings with state
+   hashing — shortest counterexamples, nondeterministic actions,
+   deadlock detection, AG EF liveness, the state-cap backstop.
+2. **Schedule replay against the REAL classes**: model-generated action
+   schedules drive real ``Membership`` peers and a real ``DurableLog``
+   file through ``replay_schedule``, asserting in LOCKSTEP that the
+   model state and the real state agree step for step — the PR-13
+   workflow that turns any future ITS-M counterexample into a
+   deterministic regression test.
+3. **Spec sanity**: the four shipped specs explore completely at HEAD
+   (the acceptance gate the `analysis` CI job re-checks via --all).
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from infinistore_tpu.membership import DurableLog, Membership  # noqa: E402
+from tools.analysis import modelcheck  # noqa: E402,F401 (registers checker)
+from tools.analysis.interleave import replay_schedule  # noqa: E402
+from tools.analysis.specs import (  # noqa: E402
+    Action,
+    Spec,
+    all_specs,
+    durable_log_spec,
+    explore,
+    membership_spec,
+)
+
+
+# ---------------------------------------------------------------------------
+# Explorer mechanics.
+# ---------------------------------------------------------------------------
+
+def counter_spec(limit=3, invariant_below=None, cap=200_000):
+    invs = ()
+    if invariant_below is not None:
+        invs = (("below", lambda s: s[0] < invariant_below),)
+    return Spec(
+        name="counter",
+        doc="test",
+        initial_states=lambda: [(0,)],
+        actions=(
+            Action("inc", guard=lambda s: s[0] < limit,
+                   apply=lambda s: (s[0] + 1,)),
+        ),
+        invariants=invs,
+        state_cap=cap,
+    )
+
+
+class TestExplorer:
+    def test_full_exploration_is_complete(self):
+        res = explore(counter_spec(limit=5))
+        assert res.complete
+        assert res.states == 6  # 0..5
+        assert res.edges == 5
+        assert not res.violations
+
+    def test_invariant_violation_has_shortest_schedule(self):
+        res = explore(counter_spec(limit=5, invariant_below=3))
+        assert not res.complete
+        v = res.violations[0]
+        assert v.kind == "invariant" and v.prop == "below"
+        # BFS: the first reported counterexample is minimal.
+        assert v.schedule == ["inc", "inc", "inc"]
+
+    def test_nondeterministic_apply_explores_all_outcomes(self):
+        spec = Spec(
+            name="fork", doc="test",
+            initial_states=lambda: [("start",)],
+            actions=(
+                Action("fork", guard=lambda s: s[0] == "start",
+                       apply=lambda s: [("a",), ("b",)]),
+            ),
+            invariants=(("not-b", lambda s: s[0] != "b"),),
+        )
+        res = explore(spec)
+        assert res.states == 3
+        assert [v.prop for v in res.violations] == ["not-b"]
+        assert res.violations[0].schedule == ["fork"]
+
+    def test_step_invariant_anchors_on_edge(self):
+        spec = counter_spec(limit=2)
+        spec.step_invariants = (
+            ("never-two", lambda prev, a, nxt: nxt[0] != 2),
+        )
+        res = explore(spec)
+        v = res.violations[0]
+        assert v.kind == "step"
+        assert v.schedule == ["inc", "inc"]
+
+    def test_deadlock_detected_with_schedule(self):
+        spec = Spec(
+            name="wedge", doc="test",
+            initial_states=lambda: [(0,)],
+            actions=(
+                Action("step", guard=lambda s: s[0] == 0,
+                       apply=lambda s: (1,)),
+            ),
+            is_done=lambda s: False,  # nothing is a legal stop
+        )
+        res = explore(spec)
+        kinds = {v.kind for v in res.violations}
+        assert "deadlock" in kinds
+        dead = [v for v in res.violations if v.kind == "deadlock"]
+        assert dead[0].schedule == ["step"]
+
+    def test_liveness_trap_state_detected(self):
+        # 0 -> 1 (goal) or 0 -> 2 (trap, self-loops forever).
+        spec = Spec(
+            name="trap", doc="test",
+            initial_states=lambda: [(0,)],
+            actions=(
+                Action("good", guard=lambda s: s[0] == 0,
+                       apply=lambda s: (1,)),
+                Action("bad", guard=lambda s: s[0] == 0,
+                       apply=lambda s: (2,)),
+            ),
+            liveness=(("reach-goal", lambda s: s[0] == 1),),
+        )
+        res = explore(spec)
+        assert [v.prop for v in res.violations] == ["reach-goal"]
+        assert res.violations[0].kind == "liveness"
+        assert not res.complete
+
+    def test_state_cap_marks_incomplete(self):
+        res = explore(counter_spec(limit=10_000, cap=16))
+        assert not res.complete
+        assert res.states == 16
+        assert not res.violations  # incomplete != violated
+
+    def test_replay_schedule_strict_raises_on_unmapped(self):
+        with pytest.raises(KeyError):
+            replay_schedule(["mystery"], {})
+        assert replay_schedule(["mystery"], {}, strict=False) == [None]
+
+
+# ---------------------------------------------------------------------------
+# Membership: model schedules drive REAL peers in lockstep.
+# ---------------------------------------------------------------------------
+
+_STATE_NAME = {
+    "J": "joining", "A": "active", "L": "leaving",
+    "D": "dead", "R": "removed",
+}
+N = membership_spec.N_PEERS
+
+
+class RealPeers:
+    """Three real Membership instances (one shared steady member) driven
+    by model action names; the contested member id is ``x``."""
+
+    def __init__(self):
+        self.ms = [Membership(["seed"]) for _ in range(N)]
+
+    def actions(self):
+        acts = {}
+        for i in range(N):
+            acts[f"add@{i}"] = lambda i=i: self.ms[i].add_member("x")
+            acts[f"readd@{i}"] = lambda i=i: self.ms[i].add_member("x")
+            acts[f"remove@{i}"] = lambda i=i: self.ms[i].remove_member("x")
+            acts[f"mark_dead@{i}"] = lambda i=i: self.ms[i].mark_dead("x")
+            acts[f"finalize@{i}"] = (
+                lambda i=i: self.ms[i].finalize_transitions()
+            )
+            for j in range(N):
+                if j != i:
+                    acts[f"exchange@{i}<-{j}"] = (
+                        lambda i=i, j=j: self._exchange(i, j)
+                    )
+        return acts
+
+    def _exchange(self, i, j):
+        payload = self.ms[j].view().as_dict()
+        return self.ms[i].merge_apply(payload["members"], payload["epoch"])
+
+    def snapshot(self, i):
+        """(entry, epoch) of peer i in the model's vocabulary: the latest
+        ``x`` entry as (state_name, since_epoch), or None."""
+        v = self.ms[i].view()
+        for m, s, se in zip(
+            reversed(v.member_ids), reversed(v.states), reversed(v.since)
+        ):
+            if m == "x":
+                return (s, int(se)), v.epoch
+        return None, v.epoch
+
+
+def run_model(schedule):
+    """Apply a schedule to the membership model, asserting every step's
+    guard (a guard-invalid schedule is a test bug, not a model result)."""
+    state = membership_spec.initial_states()[0]
+    by_name = {a.name: a for a in membership_spec.SPEC.actions}
+    for name in schedule:
+        action = by_name[name]
+        assert action.guard(state), f"model guard rejects {name} in {state}"
+        state = action.apply(state)
+    return state
+
+
+def assert_lockstep(schedule):
+    """Drive model and real peers through ``schedule``; final states must
+    agree peer for peer (state name, since_epoch, epoch)."""
+    model = run_model(schedule)
+    real = RealPeers()
+    replay_schedule(schedule, real.actions())
+    for i in range(N):
+        (m_entry, m_epoch) = model[0][i]
+        r_entry, r_epoch = real.snapshot(i)
+        expect = (
+            None if m_entry is None
+            else (_STATE_NAME[m_entry[0]], m_entry[1])
+        )
+        assert r_entry == expect, f"peer {i}: real {r_entry} != model {expect}"
+        assert r_epoch == m_epoch, f"peer {i}: epoch {r_epoch} != {m_epoch}"
+    return real
+
+
+class TestMembershipReplay:
+    def test_concurrent_dead_vs_removed_converges(self):
+        # The schedule the checker surfaced in development: peer0 marks x
+        # DEAD at epoch 4 while peer1 finalizes its LEAVING to REMOVED at
+        # epoch 4 — same incarnation, concurrent terminal knowledge. The
+        # rank order picks REMOVED on every peer (a legal terminal->
+        # terminal join, NOT a resurrection).
+        real = assert_lockstep([
+            "add@0", "remove@0", "exchange@1<-0", "mark_dead@0",
+            "finalize@1", "exchange@0<-1", "exchange@1<-0",
+            "exchange@2<-0", "exchange@2<-1",
+        ])
+        for i in range(N):
+            entry, _epoch = real.snapshot(i)
+            assert entry == ("removed", 4)
+
+    def test_readd_after_dead_is_a_new_incarnation(self):
+        real = assert_lockstep([
+            "add@0", "mark_dead@0", "exchange@1<-0", "readd@1",
+            "exchange@0<-1", "exchange@2<-1", "exchange@2<-0",
+        ])
+        for i in range(N):
+            entry, epoch = real.snapshot(i)
+            assert entry == ("joining", 4)
+            assert epoch == 4
+        # The dead incarnation's entry index survives (tombstones are
+        # never reused): peers that HELD the tombstone append the re-add
+        # as a NEW entry; peer2 only ever heard the new incarnation.
+        for i, expect in ((0, ["dead", "joining"]),
+                          (1, ["dead", "joining"]),
+                          (2, ["joining"])):
+            v = real.ms[i].view()
+            states = [
+                e for mid, e in zip(v.member_ids, v.states) if mid == "x"
+            ]
+            assert states == expect, f"peer {i}"
+
+    def test_exchange_order_insensitive(self):
+        # The convergence invariant, demonstrated on the REAL class: peer2
+        # hears peer0 and peer1 in either order and lands identically.
+        base = ["add@0", "remove@0", "exchange@1<-0", "mark_dead@0",
+                "finalize@1"]
+        a = RealPeers()
+        replay_schedule(base + ["exchange@2<-0", "exchange@2<-1"],
+                        a.actions())
+        b = RealPeers()
+        replay_schedule(base + ["exchange@2<-1", "exchange@2<-0"],
+                        b.actions())
+        assert a.snapshot(2) == b.snapshot(2)
+        assert a.snapshot(2)[0] == ("removed", 4)
+
+    def test_stale_liveness_never_resurrects_tombstone(self):
+        # peer1 holds stale ACTIVE knowledge; peer0's DEAD tombstone of
+        # the same incarnation must dominate on exchange in BOTH
+        # directions (the no-resurrection property on the real class).
+        sched = ["add@0", "finalize@0", "exchange@1<-0", "mark_dead@0"]
+        real = assert_lockstep(sched + ["exchange@1<-0", "exchange@0<-1"])
+        # x: JOINING@2 -> ACTIVE@3 (peer1's stale knowledge) -> DEAD@4;
+        # the tombstone dominates in both exchange directions.
+        assert real.snapshot(0)[0] == ("dead", 4)
+        assert real.snapshot(1)[0] == ("dead", 4)
+
+
+# ---------------------------------------------------------------------------
+# DurableLog: crash/replay schedules against a REAL journal file.
+# ---------------------------------------------------------------------------
+
+def op_to_record(op):
+    if op[0] == "root":
+        return {"kind": "root", "root": op[1]}
+    if op[0] == "drop":
+        return {"kind": "drop", "root": op[1]}
+    if op[0] == "plan":
+        return {"kind": "plan", "epoch": op[1], "roots": list(op[2])}
+    if op[0] == "migrated":
+        return {"kind": "migrated", "epoch": op[1], "root": op[2]}
+    if op[0] == "fin":
+        return {"kind": "fin", "epoch": op[1]}
+    raise AssertionError(op)
+
+
+def record_to_op(rec):
+    k = rec["kind"]
+    if k == "root":
+        return ("root", rec["root"])
+    if k == "drop":
+        return ("drop", rec["root"])
+    if k == "plan":
+        return ("plan", rec["epoch"], tuple(rec["roots"]))
+    if k == "migrated":
+        return ("migrated", rec["epoch"], rec["root"])
+    if k == "fin":
+        return ("fin", rec["epoch"])
+    raise AssertionError(rec)
+
+
+class RealLog:
+    """A real DurableLog driven by the durable_log spec's action names,
+    mirroring the model state (frames) alongside for lockstep asserts."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self.log = DurableLog(self.path, fsync_interval_s=0.0)
+        self.state = durable_log_spec.initial_states()[0]
+        self._by_name = {
+            a.name: a for a in durable_log_spec.SPEC.actions
+        }
+        self.replayed_ops = None
+
+    def _model_step(self, name, pick=0):
+        action = self._by_name[name]
+        assert action.guard(self.state), (name, self.state)
+        nxt = action.apply(self.state)
+        self.state = nxt[pick] if isinstance(nxt, list) else nxt
+
+    def _next_record(self):
+        idx = self.state[durable_log_spec.IDX]
+        return op_to_record(durable_log_spec.SCRIPT[idx])
+
+    def actions(self):
+        return {
+            "append": self.do_append,
+            "append_badcrc": self.do_append_badcrc,
+            "crash": self.do_crash,
+            "crash_torn": self.do_crash_torn,
+            "compact": self.do_compact,
+            "replay": self.do_replay,
+        }
+
+    def do_append(self):
+        rec = self._next_record()
+        self.log.append(rec)
+        self._model_step("append")
+
+    def do_append_badcrc(self):
+        # Append an intact frame, then flip one payload byte on disk —
+        # the crc no longer matches (bit rot / torn mid-frame rewrite).
+        before = os.path.getsize(self.path)
+        rec = self._next_record()
+        self.log.append(rec)
+        with open(self.path, "r+b") as f:
+            f.seek(before + 8)  # past the [u32 len][u32 crc] header
+            b = f.read(1)
+            f.seek(before + 8)
+            f.write(bytes([b[0] ^ 0xFF]))
+        self._model_step("append_badcrc")
+
+    def do_crash(self):
+        # A crash is the absence of further writes; appends already
+        # flushed, so abandoning the handle preserves exactly the bytes
+        # a real crash would.
+        self.log.close()
+        self._model_step("crash")
+
+    def do_crash_torn(self):
+        rec = self._next_record()
+        self.log.append(rec)
+        self.log.close()
+        # Cut the in-flight frame mid-payload: a torn tail.
+        size = os.path.getsize(self.path)
+        os.truncate(self.path, size - 3)
+        self._model_step("crash_torn")
+
+    def do_compact(self):
+        snap = durable_log_spec.snapshot_ops(
+            self.state[durable_log_spec.FILE]
+        )
+        self.log.compact([op_to_record(op) for op in snap])
+        self.log.close()
+        self._model_step("compact", pick=2)  # the non-crashing outcome
+
+    def do_replay(self):
+        self.log = DurableLog(self.path, fsync_interval_s=0.0)
+        self.replayed_ops = tuple(
+            record_to_op(r) for r in self.log.replay()
+        )
+        self._model_step("replay")
+
+
+def drive_log(tmp_path, schedule):
+    real = RealLog(tmp_path / "journal.log")
+    replay_schedule(schedule, real.actions())
+    return real
+
+
+class TestDurableLogReplay:
+    def test_torn_drop_is_not_durable(self, tmp_path):
+        # Crash mid-write of the `drop r1` tombstone: the drop is NOT
+        # durable, so r1 stays live — and real framing agrees with the
+        # model's durable-prefix policy byte for byte.
+        real = drive_log(
+            tmp_path, ["append"] * 4 + ["crash_torn", "replay"]
+        )
+        prefix = durable_log_spec.durable_prefix(
+            real.state[durable_log_spec.FILE]
+        )
+        assert real.replayed_ops == prefix
+        live, plan_epoch, debt = durable_log_spec.interpret(
+            real.replayed_ops
+        )
+        assert live == ("r1", "r2")
+        assert (plan_epoch, debt) == (2, ("r2",))  # analytic resume debt
+        assert real.log.replay_torn == 1
+        assert real.log.replay_bad_checksum == 0
+
+    def test_durable_drop_never_resurrects(self, tmp_path):
+        real = drive_log(tmp_path, ["append"] * 5 + ["crash", "replay"])
+        live, _epoch, debt = durable_log_spec.interpret(real.replayed_ops)
+        assert "r1" not in live
+        assert live == ("r2",)
+        assert debt == ("r2",)  # fin not yet durable
+        assert real.log.replay_torn == 0
+
+    def test_bad_checksum_frame_is_skipped_not_fatal(self, tmp_path):
+        # Frame 2 (`root r2`) rots; everything after it still parses —
+        # skip-and-continue, unlike the torn-tail stop.
+        real = drive_log(
+            tmp_path,
+            ["append", "append_badcrc"] + ["append"] * 4
+            + ["crash", "replay"],
+        )
+        prefix = durable_log_spec.durable_prefix(
+            real.state[durable_log_spec.FILE]
+        )
+        assert real.replayed_ops == prefix
+        assert ("root", "r2") not in real.replayed_ops
+        assert ("fin", 2) in real.replayed_ops  # later frames survived
+        live, plan_epoch, debt = durable_log_spec.interpret(
+            real.replayed_ops
+        )
+        assert live == ()  # r1 dropped, r2's add rotted away
+        assert (plan_epoch, debt) == (0, ())
+        assert real.log.replay_bad_checksum == 1
+
+    def test_compaction_preserves_semantics_and_shrinks(self, tmp_path):
+        full = drive_log(tmp_path, ["append"] * 6 + ["crash", "replay"])
+        before = durable_log_spec.interpret(full.replayed_ops)
+        size_before = os.path.getsize(full.path)
+
+        cdir = tmp_path / "c"
+        cdir.mkdir()
+        compacted = drive_log(cdir, ["append"] * 6 + ["compact"])
+        # Re-open and replay the compacted file.
+        log2 = DurableLog(compacted.path, fsync_interval_s=0.0)
+        ops = tuple(record_to_op(r) for r in log2.replay())
+        assert durable_log_spec.interpret(ops) == before
+        assert os.path.getsize(compacted.path) < size_before
+        assert compacted.log.compactions == 1
+
+
+# ---------------------------------------------------------------------------
+# Shipped specs at HEAD.
+# ---------------------------------------------------------------------------
+
+class TestShippedSpecs:
+    def test_all_specs_explore_completely_and_cleanly(self):
+        for spec, mirrors in all_specs():
+            res = explore(spec)
+            assert res.complete, f"{spec.name}: incomplete"
+            assert res.states > 0, f"{spec.name}: empty state space"
+            assert not res.violations, (
+                f"{spec.name}: {[(v.kind, v.prop, v.schedule) for v in res.violations]}"
+            )
+            assert mirrors["file"], spec.name

@@ -15,7 +15,9 @@ Plus the framework mechanics: inline `# its: allow[ID]` suppressions,
 the committed-baseline flow, and machine-readable JSON output.
 """
 
+import dataclasses
 import json
+import re
 import subprocess
 import sys
 from pathlib import Path
@@ -30,11 +32,14 @@ from tools.analysis import (  # noqa: E402
     core,
     counters,
     loop_block,
+    modelcheck,
     policy,
     races,
     trace_stages,
     wire_drift,
 )
+from tools.analysis import specs as mspecs  # noqa: E402
+from tools.analysis.specs import membership_spec, ring_spec  # noqa: E402
 
 
 def make_tree(tmp_path, files):
@@ -623,8 +628,8 @@ class TestFramework:
         payload = json.loads(out.read_text())
         assert payload["failed"] is False
         assert set(payload["per_checker"]) == {
-            "counters", "loop_block", "policy", "races", "trace_stages",
-            "wire_drift",
+            "counters", "loop_block", "modelcheck", "policy", "races",
+            "trace_stages", "wire_drift",
         }
         assert payload["counts"]["new"] == 0
         # Per-rule-family drift rows: every checker reports its finding
@@ -632,6 +637,13 @@ class TestFramework:
         # growing (the bench-receipt pattern).
         for name, row in payload["per_checker"].items():
             assert set(row) == {"new", "baselined", "suppressed", "ms"}, name
+            assert row["ms"] >= 0.0
+        # The receipt carries modelcheck's per-spec exploration stats
+        # (state counts + wall-time), so budget regressions show in CI.
+        spec_rows = payload["stats"]["modelcheck"]["specs"]
+        assert len(spec_rows) == 4
+        for name, row in spec_rows.items():
+            assert row["states"] > 0 and row["complete"], name
             assert row["ms"] >= 0.0
 
     def test_cli_rejects_unknown_checker(self):
@@ -1921,3 +1933,220 @@ class TestCountersEngineWave:
         ctx = core.Context(str(REPO))
         found = [f for f in counters.scan(ctx) if f.rule == "ITS-C010"]
         assert found == []
+
+
+# ---------------------------------------------------------------------------
+# modelcheck (ITS-M*)
+# ---------------------------------------------------------------------------
+
+def mini_spec(name="mini", **overrides):
+    """A one-state spec that explores cleanly (complete, invariant held) —
+    the neutral carrier for targeting ONE seeded defect per test."""
+    kw = dict(
+        name=name, doc="test fixture", initial_states=lambda: [(0,)],
+        actions=(), invariants=(("true", lambda s: True),),
+    )
+    kw.update(overrides)
+    return mspecs.Spec(**kw)
+
+
+def ring_variant(**replacements):
+    """The real ring spec with named actions swapped for mutants."""
+    acts = tuple(replacements.get(a.name, a) for a in ring_spec.ACTIONS)
+    return dataclasses.replace(ring_spec.SPEC, actions=acts)
+
+
+def schedule_from(finding):
+    """Parse the serialized counterexample out of an ITS-M finding."""
+    m = re.search(r"counterexample schedule (\[.*?\]) \(replay",
+                  finding.message)
+    assert m, finding.message
+    sched = json.loads(m.group(1))
+    assert sched and all(isinstance(step, str) for step in sched)
+    return sched
+
+
+class TestModelcheck:
+    def test_real_tree_is_clean_with_full_exploration(self):
+        """The acceptance gate: every shipped spec explores its complete
+        bounded state space at HEAD with zero findings, and the per-spec
+        stats rows (states/edges/ms) land in Context.stats for --json."""
+        ctx = core.Context(str(REPO))
+        assert modelcheck.scan(ctx) == []
+        rows = ctx.stats["modelcheck"]["specs"]
+        assert set(rows) == {
+            "membership_merge", "durable_log", "ring_sq_cq", "qos_aging",
+        }
+        for row in rows.values():
+            assert row["states"] > 0 and row["edges"] > 0
+            assert row["complete"] is True
+            assert row["violations"] == []
+            assert isinstance(row["ms"], float)
+
+    # -- ITS-M001: stale action list vs the real class ----------------------
+
+    def test_stale_action_list_vs_real_class_fires(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/fake.py": (
+            "class Membership:\n"
+            "    def poke_method(self):\n"
+            "        pass\n"
+            "    def extra(self):\n"
+            "        pass\n"
+        )})
+        spec = mini_spec(actions=(
+            mspecs.Action("poke", lambda s: False, lambda s: s),
+            mspecs.Action("mystery@0", lambda s: False, lambda s: s),
+        ))
+        mirrors = {
+            "kind": "py_class", "file": "pkg/fake.py", "cls": "Membership",
+            "actions": {"poke": "poke_method", "stale": "vanished"},
+            "exempt": {"gone": "was audited once"},
+        }
+        found = modelcheck.scan(ctx, specs=[(spec, mirrors)])
+        # All four drift directions, and nothing else (the carrier spec
+        # itself explores cleanly).
+        assert {f.key for f in found} == {
+            "ITS-M001:pkg/fake.py:mini:unmapped:mystery",
+            "ITS-M001:pkg/fake.py:mini:stale-covered:vanished",
+            "ITS-M001:pkg/fake.py:mini:stale-exempt:gone",
+            "ITS-M001:pkg/fake.py:mini:unmodeled:extra",
+        }
+
+    def test_mirrored_class_vanishing_fires(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/fake.py": "class Other:\n    pass\n"})
+        mirrors = {"kind": "py_class", "file": "pkg/fake.py",
+                   "cls": "Membership", "actions": {}, "exempt": {}}
+        found = modelcheck.scan(ctx, specs=[(mini_spec(), mirrors)])
+        assert any(f.key.endswith(":missing-class") for f in found)
+
+    def test_cpp_surface_strips_comments(self, tmp_path):
+        """Prose like "bg_cooldown_us (hysteresis ...)" in a header comment
+        must not read as a surface name the model has to cover."""
+        ctx = make_tree(tmp_path, {"h.h": (
+            "// bg_ghost (prose about a knob)\n"
+            "/* ring_phantom ( multi-line\n   prose */\n"
+            "static inline void bg_real(int x);\n"
+        )})
+        pattern = r"\b(bg_[a-z_]+|ring_[a-z_]+)\s*\("
+        assert modelcheck._cpp_surface(ctx, "h.h", pattern) == {"bg_real"}
+
+    # -- seeded protocol defects: the mutations MUST be caught ---------------
+
+    def test_dropped_dekker_recheck_is_caught(self):
+        """Mutate the ring model so the server parks WITHOUT the Dekker
+        tail re-check (sleep straight after flag-set). Exploration must
+        refute it — this is the lost-wakeup bug the discipline exists to
+        prevent — and the finding must carry a replayable schedule."""
+        sleepy = mspecs.Action(
+            name="s_park_recheck",
+            guard=lambda s: s[ring_spec.PC_S] == ring_spec.PARKING,
+            apply=lambda s: ring_spec._set(
+                s, s_parked=True, pc_s=ring_spec.IDLE),
+        )
+        spec = ring_variant(s_park_recheck=sleepy)
+        ctx = core.Context(str(REPO))
+        found = modelcheck.scan(ctx, specs=[(spec, ring_spec.MIRRORS)])
+        assert found
+        # Exploration findings only: the mutant's action names still match
+        # the real ring.h surface, so M001 stays quiet.
+        assert {f.rule for f in found} <= {"ITS-M002", "ITS-M003"}
+        sched = schedule_from(found[0])
+        assert any(step.startswith("s_park") for step in sched)
+
+    def test_nonsticky_doorbell_strands_the_parker(self):
+        """Drop the doorbell's socket-frame stickiness (and the re-check's
+        insta-wake drain): a stale doorbell for an already-consumed publish
+        takes the freshly-set park flag before the consumer sleeps, and the
+        consumer then parks with its flag down — undoorbellable. The
+        parked-flag-consistent invariant must find that exact schedule."""
+        forgetful = mspecs.Action(
+            name="p_doorbell",
+            guard=lambda s: s[ring_spec.PC_P] == ring_spec.PUBLISHED,
+            apply=lambda s: ring_spec._set(
+                s, pc_p=ring_spec.IDLE,
+                **({"sq_flag": 0, "s_parked": False}
+                   if s[ring_spec.SQ_FLAG] else {}),
+            ),
+        )
+        amnesiac = mspecs.Action(
+            name="s_park_recheck",
+            guard=lambda s: s[ring_spec.PC_S] == ring_spec.PARKING,
+            apply=lambda s: (
+                ring_spec._set(s, sq_flag=0, pc_s=ring_spec.IDLE)
+                if s[ring_spec.SQ_TAIL] > s[ring_spec.SQ_HEAD]
+                else ring_spec._set(s, s_parked=True, pc_s=ring_spec.IDLE)
+            ),
+        )
+        spec = ring_variant(p_doorbell=forgetful, s_park_recheck=amnesiac)
+        res = mspecs.explore(spec)
+        bad = [v for v in res.violations
+               if v.prop == "parked-flag-consistent"]
+        assert bad
+        # The shortest counterexample ends at the fatal sleep, with the
+        # stale doorbell landing inside the park window.
+        assert bad[0].schedule[-1] == "s_park_recheck"
+        assert "p_doorbell" in bad[0].schedule
+
+    def test_weakened_invariant_yields_replayable_counterexample(self):
+        """Swap the membership no-resurrection step invariant for a
+        WRONG/over-strict variant that also rejects the legal within-
+        incarnation DEAD -> REMOVED terminal rank advance. Exploration
+        must produce an ITS-M002 finding whose schedule ends in the
+        offending exchange — the counterexample-to-test workflow's input
+        (tests/test_modelcheck.py replays exactly this class of schedule
+        against the real Membership)."""
+        def too_strict(prev, action, nxt):
+            if not action.startswith("exchange"):
+                return True
+            for i in range(membership_spec.N_PEERS):
+                a = membership_spec._entry(prev, i)
+                b = membership_spec._entry(nxt, i)
+                if a == b:
+                    continue
+                if not membership_spec.beats(a, b):
+                    return False
+                if (a is not None and a[0] in membership_spec.TERMINAL
+                        and b[1] <= a[1]):
+                    return False  # no terminal-to-terminal carve-out
+            return True
+
+        spec = dataclasses.replace(
+            membership_spec.SPEC,
+            step_invariants=(
+                ("no-resurrection", too_strict),
+                ("epoch-monotone", membership_spec.step_epoch_monotone),
+            ),
+        )
+        ctx = core.Context(str(REPO))
+        found = modelcheck.scan(
+            ctx, specs=[(spec, membership_spec.MIRRORS)])
+        rows = [f for f in found
+                if f.key == "ITS-M002:membership_merge:no-resurrection"]
+        assert rows
+        sched = schedule_from(rows[0])
+        assert sched[-1].startswith("exchange@")
+        # With violations present, the incomplete exploration is NOT
+        # additionally reported as an M005 health finding.
+        assert not any(f.rule == "ITS-M005" for f in found)
+
+    # -- ITS-M005: exploration health ----------------------------------------
+
+    def test_exploration_health_rules_fire(self, tmp_path):
+        ctx = make_tree(tmp_path, {"h.h": "void zz_x(int);\n"})
+        mirrors = {"kind": "cpp_functions", "file": "h.h",
+                   "pattern": r"\b(zz_[a-z_]+)\s*\(",
+                   "actions": {}, "exempt": {"zz_x": "fixture"}}
+        runaway = mini_spec(
+            name="runaway",
+            actions=(mspecs.Action("inc", lambda s: True,
+                                   lambda s: (s[0] + 1,)),),
+            state_cap=8,
+        )
+        keys = {f.key for f in modelcheck.scan(ctx, specs=[
+            (mini_spec(name="hollow", initial_states=lambda: []), mirrors),
+            (mini_spec(name="blind", invariants=()), mirrors),
+            (runaway, mirrors),
+        ])}
+        assert "ITS-M005:hollow:empty" in keys
+        assert "ITS-M005:blind:no-invariants" in keys
+        assert "ITS-M005:runaway:incomplete" in keys

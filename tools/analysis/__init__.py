@@ -18,10 +18,18 @@ Four project-specific checkers over invariants unit tests can only sample
   lock-order acyclicity, journal-outside-lock, predicate-looped cv waits,
   concurrency-model docs lockstep; the dynamic confirmation side (lock
   tracer + deterministic interleaving) lives in interleave.py.
+- ``modelcheck``  (ITS-M*): explicit-state model checking of the
+  hand-written protocols (membership merge lattice, durable-log crash
+  replay, ring publish/park/doorbell, QoS aging) over ALL interleavings,
+  with a model<->implementation lockstep diff and replayable
+  counterexample schedules (specs/ + interleave.replay_schedule).
 
 Importing the subpackage registers every checker with core.CHECKERS.
 """
 
 from . import core  # noqa: F401
-from . import counters, loop_block, policy, races, trace_stages, wire_drift  # noqa: F401
+from . import (  # noqa: F401
+    counters, loop_block, modelcheck, policy, races, trace_stages,
+    wire_drift,
+)
 from .core import CHECKERS, Context, Finding, run  # noqa: F401

@@ -3,7 +3,15 @@
     python -m tools.analysis --all                 # run every checker
     python -m tools.analysis wire_drift policy     # run a subset
     python -m tools.analysis --all --json out.json # machine-readable output
+    python -m tools.analysis --changed             # git-diff-scoped subset
     python -m tools.analysis --all --write-baseline
+
+`--changed [BASE]` selects only the checkers whose declared scope
+intersects the files changed vs BASE (default HEAD: working tree +
+staged + untracked) — the cheap pre-gate for local iteration and CI
+pre-checks. The full `--all` run stays the merge gate: a checker whose
+scope list is stale would silently skip, and only `--all` is immune to
+that by construction.
 
 Exit status: 0 when every finding is suppressed or baselined, 1 when new
 findings exist, 2 on usage errors. See docs/static_analysis.md.
@@ -11,10 +19,47 @@ findings exist, 2 on usage errors. See docs/static_analysis.md.
 
 import argparse
 import json
+import subprocess
 import sys
 
 from . import CHECKERS
 from .core import Context, load_baseline, run, write_baseline
+
+# Changes under the analysis framework itself invalidate every checker's
+# verdict — a --changed run that touches these selects everything.
+_FRAMEWORK_PREFIXES = ("tools/analysis/core.py", "tools/analysis/__main__.py",
+                       "tools/analysis/__init__.py", "tools/analysis/baseline.json")
+
+
+def changed_paths(root: str, base: str) -> list:
+    """Repo-relative paths changed vs ``base``: committed-diff + working
+    tree + staged (git diff) plus untracked files."""
+    paths = set()
+    for args in (
+        ["git", "-C", root, "diff", "--name-only", base],
+        ["git", "-C", root, "ls-files", "--others", "--exclude-standard"],
+    ):
+        out = subprocess.run(args, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip() or f"{args} failed")
+        paths.update(p for p in out.stdout.splitlines() if p)
+    return sorted(paths)
+
+
+def select_changed(paths: list) -> list:
+    """Checker names whose scope intersects ``paths`` (prefix match).
+    An empty scope means "always run" (the conservative default), and a
+    framework change selects everything."""
+    if any(p.startswith(_FRAMEWORK_PREFIXES) for p in paths):
+        return sorted(CHECKERS)
+    names = []
+    for name, chk in sorted(CHECKERS.items()):
+        if not chk.scope:
+            names.append(name)
+            continue
+        if any(p.startswith(chk.scope) for p in paths):
+            names.append(name)
+    return names
 
 
 def main(argv=None) -> int:
@@ -24,6 +69,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument("checkers", nargs="*", help="checker names (see --list)")
     parser.add_argument("--all", action="store_true", help="run every registered checker")
+    parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="BASE",
+        help="run only checkers whose scope intersects the files changed "
+             "vs BASE (default HEAD); --all stays the merge gate",
+    )
     parser.add_argument("--list", action="store_true", help="list checkers and exit")
     parser.add_argument("--json", metavar="PATH", help="write machine-readable results (- for stdout)")
     parser.add_argument(
@@ -42,7 +92,31 @@ def main(argv=None) -> int:
             print(f"{name:14s} {chk.doc}")
         return 0
 
-    names = sorted(CHECKERS) if args.all else args.checkers
+    ctx = Context(args.root) if args.root else Context()
+    if args.all:
+        names = sorted(CHECKERS)
+    elif args.changed is not None:
+        if args.checkers:
+            print("error: --changed selects checkers itself; drop the "
+                  "positional names or use --all", file=sys.stderr)
+            return 2
+        try:
+            paths = changed_paths(ctx.root, args.changed)
+        except (OSError, RuntimeError) as e:
+            print(f"error: --changed could not diff vs {args.changed}: {e}",
+                  file=sys.stderr)
+            return 2
+        names = select_changed(paths)
+        skipped = sorted(set(CHECKERS) - set(names))
+        print(
+            f"--changed vs {args.changed}: {len(paths)} changed file(s); "
+            f"running {names or 'nothing'}"
+            + (f", skipping {skipped}" if skipped else "")
+        )
+        if not names:
+            return 0
+    else:
+        names = args.checkers
     if not names:
         parser.print_usage()
         print("error: name at least one checker or pass --all", file=sys.stderr)
@@ -52,7 +126,6 @@ def main(argv=None) -> int:
         print(f"error: unknown checker(s) {unknown}; see --list", file=sys.stderr)
         return 2
 
-    ctx = Context(args.root) if args.root else Context()
     baseline = {} if args.no_baseline else load_baseline(ctx.baseline_path)
     result = run(names, ctx=ctx, baseline=baseline)
 
@@ -83,6 +156,15 @@ def main(argv=None) -> int:
                 f"{int(row.get('new', 0))} new / "
                 f"{int(row.get('baselined', 0))} baselined / "
                 f"{int(row.get('suppressed', 0))} suppressed"
+            )
+        # modelcheck's per-spec exploration budget: states/edges/wall-time
+        # per protocol model, the regression row for exploration cost.
+        specs = result.stats.get("modelcheck", {}).get("specs", {})
+        for spec_name, srow in sorted(specs.items()):
+            print(
+                f"    spec {spec_name:18s} {srow['states']:7d} states  "
+                f"{srow['edges']:7d} edges  {srow['ms']:8.1f} ms  "
+                f"{'complete' if srow['complete'] else 'INCOMPLETE'}"
             )
     if args.json:
         payload = json.dumps(result.to_json(), indent=2, sort_keys=True)

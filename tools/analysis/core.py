@@ -27,7 +27,7 @@ import os
 import re
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.json")
@@ -67,6 +67,11 @@ class Context:
     def __init__(self, root: str = REPO_ROOT):
         self.root = root
         self._text: Dict[str, str] = {}
+        # Checker-published run statistics (e.g. modelcheck's per-spec
+        # state counts and exploration wall-time), keyed by checker name;
+        # copied into RunResult.stats and the --json receipt so
+        # exploration-budget regressions are visible in CI logs.
+        self.stats: Dict[str, dict] = {}
 
     def path(self, rel: str) -> str:
         return os.path.join(self.root, rel)
@@ -131,14 +136,20 @@ class Checker:
     doc: str
     fn: Callable[[Context], List[Finding]]
     rule_prefix: str = ""  # e.g. "ITS-W": owns every key starting with it
+    # Repo-relative path prefixes this checker's verdict depends on; the
+    # `--changed` git-diff-scoped run selects checkers whose scope
+    # intersects the changed paths. Empty = always selected (conservative).
+    scope: Tuple[str, ...] = ()
 
 
 CHECKERS: Dict[str, Checker] = {}
 
 
-def register(name: str, doc: str, rule_prefix: str = ""):
+def register(name: str, doc: str, rule_prefix: str = "",
+             scope: Tuple[str, ...] = ()):
     def deco(fn):
-        CHECKERS[name] = Checker(name=name, doc=doc, fn=fn, rule_prefix=rule_prefix)
+        CHECKERS[name] = Checker(name=name, doc=doc, fn=fn,
+                                 rule_prefix=rule_prefix, scope=scope)
         return fn
 
     return deco
@@ -203,6 +214,9 @@ class RunResult:
     baselined: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     per_checker: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # Checker-published stats (Context.stats): modelcheck's per-spec
+    # state counts / exploration wall-time land here and in the receipt.
+    stats: Dict[str, dict] = field(default_factory=dict)
 
     @property
     def failed(self) -> bool:
@@ -218,6 +232,7 @@ class RunResult:
                 "suppressed": len(self.suppressed),
             },
             "per_checker": self.per_checker,
+            "stats": self.stats,
             "findings": [asdict(f) for f in self.new],
             "baselined": [asdict(f) for f in self.baselined],
             "suppressed": [asdict(f) for f in self.suppressed],
@@ -256,4 +271,5 @@ def run(
                 result.new.append(f)
                 row["new"] += 1
         row["ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    result.stats = dict(ctx.stats)
     return result

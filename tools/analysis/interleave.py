@@ -28,7 +28,13 @@ the very schedules under test and cost ~30x):
   ``serialized`` instead — which is exactly the regression assertion for a
   fixed race (tests/test_interleave.py).
 
-Both are test-time instruments: nothing here imports the package, and
+A third, schedule-shaped bridge rides along: :func:`replay_schedule`
+executes a model checker's serialized counterexample (an ITS-M violation's
+action-name list, tools/analysis/modelcheck.py) against the REAL classes,
+single-threaded and deterministic — how a refuted protocol invariant
+becomes a committed regression test.
+
+All are test-time instruments: nothing here imports the package, and
 production code never pays for them.
 """
 
@@ -361,6 +367,39 @@ class Interleaver:
             completed=stalled_at is None and not self._aborted,
             stalled_at=stalled_at, errors=errors,
         )
+
+
+def replay_schedule(schedule: Sequence[str], actions: Dict[str, "callable"],
+                    strict: bool = True) -> List[object]:
+    """Drive REAL objects through a model-checker counterexample — the
+    bridge from an ITS-M violation to a deterministic regression test.
+
+    ``schedule`` is the serialized action-name list a spec violation
+    carries (``specs.Violation.schedule``, JSON round-trippable);
+    ``actions`` maps each action name to a callable over the real classes
+    under test (e.g. ``{"exchange@0<-1": lambda: m0.merge_apply(...)}``).
+    The schedule executes in order on THIS thread — the model's
+    interleavings are total orders, so single-threaded replay is exact,
+    with none of the Interleaver's watchdog machinery — and the per-step
+    return values come back for the test to assert on.
+
+    ``strict=False`` skips schedule entries with no mapping (pure-model
+    steps like a crash marker the caller realizes some other way) instead
+    of raising; skipped steps return ``None``.
+    """
+    results: List[object] = []
+    for name in schedule:
+        fn = actions.get(name)
+        if fn is None:
+            if strict:
+                raise KeyError(
+                    f"schedule step {name!r} has no action mapping; pass "
+                    "strict=False to skip pure-model steps"
+                )
+            results.append(None)
+            continue
+        results.append(fn())
+    return results
 
 
 def force_lost_update(bump_a, bump_b, counters: dict, key,

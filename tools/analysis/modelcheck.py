@@ -1,0 +1,249 @@
+"""ITS-M*: explicit-state protocol model checking (docs/static_analysis.md).
+
+The repo carries four hand-written distributed protocols — the gossip
+membership merge lattice, DurableLog crash replay, the zero-copy ring's
+publish/park/doorbell discipline, and the QoS aging bound — each verified
+until now only by example-based tests. This checker exhaustively explores
+small executable models of them (tools/analysis/specs/) over ALL
+interleavings, bounded by state hashing, and diffs each model's action
+vocabulary against the real implementation's surface so the models cannot
+silently rot (the wire_drift IR pattern):
+
+- **ITS-M001** lockstep drift: a spec's ``MIRRORS`` descriptor binds model
+  actions to real methods (Python classes via AST, C++ headers via the
+  name-family regex). A covered/exempt name that no longer exists, a real
+  surface name the model neither covers nor exempts, or a model action
+  with no mapping is a finding — models rot loudly, never silently.
+- **ITS-M002** safety violation: a reachable state (or explored edge)
+  refutes an invariant. The finding carries the serialized action
+  schedule — ``interleave.replay_schedule`` turns it into a deterministic
+  regression test against the REAL classes (the PR-13 workflow).
+- **ITS-M003** deadlock: a reachable non-final state with no enabled
+  action (a lost wakeup, wedged backpressure).
+- **ITS-M004** liveness: a reachable state from which no schedule reaches
+  a declared goal (AG EF under the explored transition relation) —
+  starvation with the schedule to prove it.
+- **ITS-M005** exploration health: an empty state space, a state-cap
+  overflow (incomplete exploration reads as a silent pass otherwise), or
+  a spec with no invariants at all.
+
+Per-spec wall-time and state counts land in ``Context.stats`` and the
+``--json`` receipt, so exploration-budget regressions show up in CI logs
+the same way per-checker timings do.
+
+``python -m tools.analysis.modelcheck`` prints the exploration report.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Context, Finding, register
+from .specs import Spec, SpecResult, all_specs, explore
+
+_KIND_RULE = {
+    "invariant": "ITS-M002",
+    "step": "ITS-M002",
+    "deadlock": "ITS-M003",
+    "liveness": "ITS-M004",
+}
+
+
+# ---------------------------------------------------------------------------
+# ITS-M001: model <-> implementation lockstep.
+# ---------------------------------------------------------------------------
+
+def _py_class_surface(ctx: Context, rel: str,
+                      cls_name: str) -> Optional[Tuple[Set[str], int]]:
+    """Public method names of ``cls_name`` (AST; properties included,
+    underscore/dunder names excluded) and the class' line."""
+    try:
+        tree = ast.parse(ctx.read(rel))
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            names = {
+                item.name for item in node.body
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and not item.name.startswith("_")
+            }
+            return names, node.lineno
+    return None
+
+
+def _cpp_surface(ctx: Context, rel: str,
+                 pattern: str) -> Optional[Set[str]]:
+    """Name-family surface of a C++ header: every distinct capture of
+    ``pattern``, with ``//`` and ``/* */`` comments stripped first —
+    prose like "bg_cooldown_us (hysteresis ...)" must not read as a
+    surface name."""
+    try:
+        text = ctx.read(rel)
+    except OSError:
+        return None
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    text = re.sub(r"//[^\n]*", "", text)
+    return set(re.findall(pattern, text))
+
+
+def check_m001(ctx: Context, spec: Spec, mirrors: dict) -> List[Finding]:
+    findings: List[Finding] = []
+    rel = mirrors["file"]
+    slug = f"ITS-M001:{rel}:{spec.name}"
+
+    def finding(line: int, message: str, sub: str) -> Finding:
+        return Finding(rule="ITS-M001", file=rel, line=line,
+                       message=message, key=f"{slug}:{sub}")
+
+    covered: Dict[str, str] = dict(mirrors.get("actions", {}))
+    exempt: Dict[str, str] = dict(mirrors.get("exempt", {}))
+    if mirrors["kind"] == "py_class":
+        got = _py_class_surface(ctx, rel, mirrors["cls"])
+        if got is None:
+            return [finding(
+                0, f"spec {spec.name!r} mirrors class {mirrors['cls']!r} "
+                   f"in {rel}, which no longer parses or exists — update "
+                   "the spec's MIRRORS descriptor", "missing-class",
+            )]
+        surface, line = got
+    else:
+        surface = _cpp_surface(ctx, rel, mirrors["pattern"])
+        line = 0
+        if surface is None:
+            return [finding(
+                0, f"spec {spec.name!r} mirrors {rel}, which is missing",
+                "missing-file",
+            )]
+    # (a) every model action maps to something (or keys a family prefix:
+    # `add` covers `add@0`..`add@2` — the peer-indexed action names).
+    for action in spec.actions:
+        base = action.name.split("@", 1)[0]
+        if action.name not in covered and base not in covered:
+            findings.append(finding(
+                line, f"model action {action.name!r} of spec "
+                      f"{spec.name!r} has no entry in MIRRORS['actions'] — "
+                      "bind it to the real method it mirrors",
+                f"unmapped:{base}",
+            ))
+    # (b) covered targets and exempt names must still exist on the real
+    # surface (stale spec vocabulary).
+    for target in sorted(set(covered.values())):
+        if target not in surface:
+            findings.append(finding(
+                line, f"spec {spec.name!r} maps actions to "
+                      f"{target!r}, which is not on the real surface of "
+                      f"{rel} — the model's action list is stale",
+                f"stale-covered:{target}",
+            ))
+    for name in sorted(exempt):
+        if name not in surface:
+            findings.append(finding(
+                line, f"spec {spec.name!r} exempts {name!r}, which is not "
+                      f"on the real surface of {rel} — prune the stale "
+                      "exemption", f"stale-exempt:{name}",
+            ))
+    # (c) every real surface name is covered or exempted — a new method
+    # landing without a model update fails the run (anti-rot).
+    known = set(covered.values()) | set(exempt)
+    for name in sorted(surface - known):
+        findings.append(finding(
+            line, f"{rel} grew {name!r}, which spec {spec.name!r} neither "
+                  "models nor exempts — extend the model (or record the "
+                  "audit reason in MIRRORS['exempt'])",
+            f"unmodeled:{name}",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# ITS-M002..M005: exploration findings.
+# ---------------------------------------------------------------------------
+
+def check_exploration(spec: Spec, result: SpecResult) -> List[Finding]:
+    findings: List[Finding] = []
+    # Spec modules live in this repo's tools tree; anchor findings there.
+    rel = f"tools/analysis/specs/{spec.name}.py"
+    for v in result.violations:
+        rule = _KIND_RULE[v.kind]
+        findings.append(Finding(
+            rule=rule, file=rel, line=0,
+            message=(
+                f"spec {spec.name!r}: {v.message}; counterexample "
+                f"schedule {json.dumps(v.schedule)} (replay with "
+                "interleave.replay_schedule; docs/static_analysis.md "
+                "ITS-M counterexample->test workflow)"
+            ),
+            key=f"{rule}:{spec.name}:{v.prop}",
+        ))
+    if result.states == 0:
+        findings.append(Finding(
+            rule="ITS-M005", file=rel, line=0,
+            message=f"spec {spec.name!r} explored 0 states — no initial "
+                    "states or a broken guard set",
+            key=f"ITS-M005:{spec.name}:empty",
+        ))
+    elif not result.complete and not result.violations:
+        findings.append(Finding(
+            rule="ITS-M005", file=rel, line=0,
+            message=(
+                f"spec {spec.name!r} exploration incomplete at "
+                f"{result.states} states (cap {spec.state_cap}) — an "
+                "unbounded model reads as a silent pass; bound it with "
+                "budgets/saturation"
+            ),
+            key=f"ITS-M005:{spec.name}:incomplete",
+        ))
+    if not spec.invariants and not spec.step_invariants:
+        findings.append(Finding(
+            rule="ITS-M005", file=rel, line=0,
+            message=f"spec {spec.name!r} declares no invariants — it "
+                    "explores but checks nothing",
+            key=f"ITS-M005:{spec.name}:no-invariants",
+        ))
+    return findings
+
+
+def scan(ctx: Context,
+         specs: Optional[Sequence[Tuple[Spec, dict]]] = None,
+         ) -> List[Finding]:
+    """Run the lockstep diff + full bounded exploration of every spec;
+    record per-spec stats (states, edges, ms, complete) in ``ctx.stats``
+    for the --json receipt. ``specs`` is injectable for the seeded
+    mutation tests."""
+    findings: List[Finding] = []
+    rows: Dict[str, dict] = {}
+    for spec, mirrors in (all_specs() if specs is None else specs):
+        findings += check_m001(ctx, spec, mirrors)
+        result = explore(spec)
+        findings += check_exploration(spec, result)
+        rows[spec.name] = result.to_json()
+    ctx.stats["modelcheck"] = {"specs": rows}
+    return findings
+
+
+@register("modelcheck",
+          "explicit-state protocol model checking: membership merge, "
+          "durable-log crash replay, ring publish/park, QoS aging (ITS-M*)",
+          rule_prefix="ITS-M",
+          scope=("infinistore_tpu/membership.py", "native/include/its/",
+                 "tools/analysis/specs/", "tools/analysis/modelcheck.py"))
+def check(ctx: Context) -> List[Finding]:
+    return scan(ctx)
+
+
+if __name__ == "__main__":  # pragma: no cover - exploration report helper
+    ctx = Context()
+    all_findings = scan(ctx)
+    for name, row in ctx.stats["modelcheck"]["specs"].items():
+        print(
+            f"{name:18s} {row['states']:7d} states  {row['edges']:7d} edges"
+            f"  {row['ms']:8.1f} ms  "
+            f"{'complete' if row['complete'] else 'INCOMPLETE'}"
+        )
+    for f in all_findings:
+        print(f.render())
+    raise SystemExit(1 if all_findings else 0)

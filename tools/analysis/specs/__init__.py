@@ -1,0 +1,243 @@
+"""Explicit-state protocol specs + BFS explorer — the model side of the
+ITS-M checker (tools/analysis/modelcheck.py; docs/static_analysis.md).
+
+A *spec* is a small executable model of one of the repo's hand-written
+distributed protocols, written next to the real code it mirrors:
+
+- **states** are hashable values (tuples of tuples — never dicts);
+- **actions** are named, guarded transitions (``Action``); an action's
+  ``apply`` may return ONE successor or a LIST of successors
+  (nondeterminism, e.g. a crash that leaves the old or the new file);
+- **invariants** are predicates over single states (safety), and
+  **step invariants** are predicates over ``(prev, action, next)`` edges
+  (monotonicity properties like tombstone no-resurrection);
+- ``is_done`` marks states where quiescence is LEGAL — a state with no
+  enabled action that is not done is a deadlock (a lost wakeup);
+- **liveness goals** assert AG EF *goal*: from every reachable state some
+  schedule reaches the goal. Checked by backward reachability over the
+  fully-explored edge set, this is the fairness-modulo-scheduling reading
+  of "the aging escape cannot be starved": no reachable state is ever cut
+  off from progress. Only evaluated when exploration completed.
+
+Exploration (:func:`explore`) is plain BFS over ALL interleavings,
+bounded by state hashing (the visited set), never by depth guessing: the
+explorer terminates exactly when the model's state space is finite, and
+``state_cap`` is the runaway backstop (an incomplete run is an ITS-M005
+finding, not a silent pass). Every violation carries the full action
+schedule from an initial state, reconstructed from BFS parent pointers —
+the serialized counterexample ``interleave.replay_schedule`` turns into a
+deterministic regression test against the REAL classes.
+
+The four shipped specs (membership merge, DurableLog crash/replay, the
+zero-copy ring's publish/park/doorbell, QoS aging) each publish a
+``SPEC`` object plus a ``MIRRORS`` descriptor binding the model's action
+vocabulary to the real implementation's method surface — the ITS-M001
+lockstep diff that keeps models from silently rotting.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from time import perf_counter
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class Action:
+    """One named, guarded transition. ``apply(state)`` returns the
+    successor state or a list of successors (nondeterministic outcome)."""
+
+    name: str
+    guard: Callable[[tuple], bool]
+    apply: Callable[[tuple], object]
+
+
+@dataclass
+class Violation:
+    """One refuted property with its replayable counterexample."""
+
+    kind: str        # "invariant" | "step" | "deadlock" | "liveness"
+    prop: str        # property name (invariant/goal name, or the action)
+    message: str
+    schedule: List[str]  # action names from an initial state (serialized
+    #                      counterexample; replay_schedule() input)
+    state: tuple = ()
+
+
+@dataclass
+class SpecResult:
+    """Outcome of exploring one spec's full bounded state space."""
+
+    spec: str
+    states: int = 0
+    edges: int = 0
+    complete: bool = False
+    ms: float = 0.0
+    violations: List[Violation] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "spec": self.spec,
+            "states": self.states,
+            "edges": self.edges,
+            "complete": self.complete,
+            "ms": round(self.ms, 1),
+            "violations": [
+                {"kind": v.kind, "prop": v.prop, "schedule": v.schedule}
+                for v in self.violations
+            ],
+        }
+
+
+@dataclass
+class Spec:
+    """One protocol model. All callables are pure; states are hashable."""
+
+    name: str
+    doc: str
+    initial_states: Callable[[], Sequence[tuple]]
+    actions: Sequence[Action]
+    # (name, predicate(state) -> bool): must hold in EVERY reachable state.
+    invariants: Sequence[Tuple[str, Callable[[tuple], bool]]] = ()
+    # (name, predicate(prev, action_name, next) -> bool): must hold on
+    # every explored edge (monotonicity / no-resurrection properties).
+    step_invariants: Sequence[
+        Tuple[str, Callable[[tuple, str, tuple], bool]]
+    ] = ()
+    # Quiescence predicate: a state with no enabled action and
+    # ``not is_done(state)`` is a deadlock (e.g. a lost wakeup).
+    is_done: Callable[[tuple], bool] = lambda s: True
+    # (name, goal(state) -> bool): AG EF goal — every reachable state must
+    # be able to reach a goal state (checked only on complete exploration).
+    liveness: Sequence[Tuple[str, Callable[[tuple], bool]]] = ()
+    state_cap: int = 200_000
+
+
+def _schedule_to(parent: Dict[tuple, Optional[Tuple[tuple, str]]],
+                 state: tuple) -> List[str]:
+    """Reconstruct the action schedule from an initial state via the BFS
+    parent pointers (shortest counterexample by construction)."""
+    names: List[str] = []
+    cur: Optional[tuple] = state
+    while cur is not None:
+        link = parent[cur]
+        if link is None:
+            break
+        prev, action = link
+        names.append(action)
+        cur = prev
+    return list(reversed(names))
+
+
+def explore(spec: Spec, max_violations: int = 3) -> SpecResult:
+    """BFS over every interleaving of ``spec``'s actions, bounded by state
+    hashing. Collects up to ``max_violations`` safety/deadlock violations
+    (exploration stops early once reached: a broken model need not finish
+    its — possibly unbounded — mutated state space); liveness goals are
+    evaluated afterwards, only when exploration completed violation-free."""
+    t0 = perf_counter()
+    res = SpecResult(spec=spec.name)
+    parent: Dict[tuple, Optional[Tuple[tuple, str]]] = {}
+    edges: List[Tuple[tuple, str, tuple]] = []
+    queue: deque = deque()
+    for s in spec.initial_states():
+        if s not in parent:
+            parent[s] = None
+            queue.append(s)
+
+    def violated(kind: str, prop: str, message: str, state: tuple):
+        res.violations.append(Violation(
+            kind=kind, prop=prop, message=message,
+            schedule=_schedule_to(parent, state), state=state,
+        ))
+
+    capped = False
+    while queue and len(res.violations) < max_violations:
+        state = queue.popleft()
+        for name, pred in spec.invariants:
+            if not pred(state):
+                violated("invariant", name,
+                         f"invariant {name!r} violated", state)
+        if len(res.violations) >= max_violations:
+            break
+        enabled = 0
+        for action in spec.actions:
+            if not action.guard(state):
+                continue
+            enabled += 1
+            nxt = action.apply(state)
+            successors = nxt if isinstance(nxt, list) else [nxt]
+            for succ in successors:
+                for name, pred in spec.step_invariants:
+                    if not pred(state, action.name, succ):
+                        # Anchor the counterexample at the PREV state and
+                        # append the offending action by hand (succ may be
+                        # a brand-new state with no parent entry yet).
+                        v = Violation(
+                            kind="step", prop=name,
+                            message=f"step invariant {name!r} violated by "
+                                    f"action {action.name!r}",
+                            schedule=_schedule_to(parent, state)
+                            + [action.name],
+                            state=succ,
+                        )
+                        res.violations.append(v)
+                if succ not in parent:
+                    if len(parent) >= spec.state_cap:
+                        capped = True
+                        continue
+                    parent[succ] = (state, action.name)
+                    queue.append(succ)
+                edges.append((state, action.name, succ))
+        if enabled == 0 and not spec.is_done(state):
+            violated(
+                "deadlock", "deadlock",
+                "no action enabled in a non-final state (lost wakeup / "
+                "stuck backpressure)", state,
+            )
+    res.states = len(parent)
+    res.edges = len(edges)
+    res.complete = not capped and not queue and not res.violations
+    # Liveness (AG EF goal): backward reachability from the goal set over
+    # the explored edges; any reachable state outside the backward set can
+    # NEVER reach the goal — starvation, with the schedule to prove it.
+    if res.complete:
+        rev: Dict[tuple, List[tuple]] = {}
+        for src, _a, dst in edges:
+            rev.setdefault(dst, []).append(src)
+        for goal_name, goal in spec.liveness:
+            can_reach = {s for s in parent if goal(s)}
+            frontier = deque(can_reach)
+            while frontier:
+                s = frontier.popleft()
+                for p in rev.get(s, ()):
+                    if p not in can_reach:
+                        can_reach.add(p)
+                        frontier.append(p)
+            for s in parent:
+                if s not in can_reach:
+                    violated(
+                        "liveness", goal_name,
+                        f"state cannot reach liveness goal {goal_name!r} "
+                        "by any schedule", s,
+                    )
+                    break
+        if res.violations:
+            res.complete = False
+    res.ms = (perf_counter() - t0) * 1e3
+    res.violations = res.violations[:max_violations]
+    return res
+
+
+def all_specs() -> List[Tuple[Spec, dict]]:
+    """The shipped (spec, mirrors) pairs, import-cycle-free: spec modules
+    import only this framework module."""
+    from . import durable_log_spec, membership_spec, qos_spec, ring_spec
+
+    return [
+        (membership_spec.SPEC, membership_spec.MIRRORS),
+        (durable_log_spec.SPEC, durable_log_spec.MIRRORS),
+        (ring_spec.SPEC, ring_spec.MIRRORS),
+        (qos_spec.SPEC, qos_spec.MIRRORS),
+    ]

@@ -1,0 +1,280 @@
+"""ITS-M spec: DurableLog crash/replay
+(infinistore_tpu/membership.py ``DurableLog``).
+
+The model drives one fixed journal script — the record vocabulary the
+cluster actually writes (root adds, a reshard ``plan``, per-root
+``migrated`` marks, a ``drop`` tombstone, the plan's ``fin``) — through
+every crash point the framing allows:
+
+- ``append``: the next script record lands as an intact frame, or as a
+  frame whose payload will fail its crc at replay (``append_badcrc`` —
+  bit rot / a torn mid-frame rewrite);
+- ``crash``: the process dies now; ``crash_torn`` additionally leaves
+  the NEXT record as a truncated frame (the write in flight at death);
+- ``compact`` (end of script): the atomic snapshot rewrite — its crash
+  outcomes are exactly ``os.replace``'s: the OLD file intact or the NEW
+  file intact, never a mix;
+- ``replay``: parse the surviving file with the real replay policy
+  (stop at the first torn frame, skip bad-checksum frames, apply in
+  order).
+
+The oracle is an independent reference interpreter over the *durable
+prefix* (intact frames before the first torn one, bad-crc frames
+skipped). Explored properties:
+
+- **replay-matches-durable-prefix**: the replayed summary equals the
+  reference semantics — in particular a dropped root NEVER resurrects
+  (the ``drop`` tombstone is last-record-wins);
+- **no-root-resurrection**: stated independently of the interpreter —
+  if a durable ``drop r`` has no later durable ``root r``, then ``r``
+  is not live after replay;
+- **reshard-debt-analytic**: the resumed reshard debt equals the
+  analytic delta — planned roots minus durable ``migrated`` marks, zero
+  once the ``fin`` landed;
+- **compact-preserves-semantics** (step invariant): a compacted file
+  replays to the same summary as the file it replaced.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from . import Action, Spec
+
+# Script ops: ("root", r) add; ("plan", epoch, roots) reshard plan;
+# ("migrated", epoch, r) one root done; ("drop", r) tombstone;
+# ("fin", epoch) plan finalized.
+SCRIPT: Tuple[tuple, ...] = (
+    ("root", "r1"),
+    ("root", "r2"),
+    ("plan", 2, ("r1", "r2")),
+    ("migrated", 2, "r1"),
+    ("drop", "r1"),
+    ("fin", 2),
+)
+
+# Frame: ("ok" | "badcrc" | "torn", op).
+# State: (phase, script_idx, file_frames, summary)
+#   phase: "run" | "crashed" | "replayed" | "compacted"
+#   summary: () until replayed, then the replayed reference tuple.
+PH, IDX, FILE, SUM = range(4)
+
+
+def initial_states() -> List[tuple]:
+    return [("run", 0, (), ())]
+
+
+# -- reference semantics -----------------------------------------------------
+
+def durable_prefix(frames: tuple) -> tuple:
+    """Intact frames the real replay would parse: stop at the first torn
+    frame (nothing after a broken length prefix can be delimited), skip
+    bad-checksum frames (the length prefix still delimits them)."""
+    out = []
+    for kind, op in frames:
+        if kind == "torn":
+            break
+        if kind == "badcrc":
+            continue
+        out.append(op)
+    return tuple(out)
+
+
+def interpret(ops: tuple) -> tuple:
+    """Reference interpreter: (live_roots, open_plan_epoch, debt_roots).
+    Last record wins per key; a plan's debt shrinks per ``migrated`` and
+    collapses at ``fin``."""
+    live: List[str] = []
+    plan_epoch = 0
+    debt: List[str] = []
+    for op in ops:
+        if op[0] == "root":
+            if op[1] not in live:
+                live.append(op[1])
+        elif op[0] == "drop":
+            if op[1] in live:
+                live.remove(op[1])
+        elif op[0] == "plan":
+            plan_epoch = op[1]
+            debt = list(op[2])
+        elif op[0] == "migrated":
+            if op[1] == plan_epoch and op[2] in debt:
+                debt.remove(op[2])
+        elif op[0] == "fin":
+            if op[1] == plan_epoch:
+                plan_epoch = 0
+                debt = []
+    return (tuple(sorted(live)), plan_epoch, tuple(sorted(debt)))
+
+
+def model_replay(frames: tuple) -> tuple:
+    """The model's mirror of DurableLog.replay + the cluster's record
+    application: torn tail discarded, bad checksum skipped, append order
+    preserved. (The seeded ITS-M tests mutate THIS to e.g. resurrect
+    past a torn cut; the invariants below then fire.)"""
+    return interpret(durable_prefix(frames))
+
+
+def snapshot_ops(frames: tuple) -> tuple:
+    """The compaction snapshot: the current semantics re-serialized as a
+    minimal record sequence (live roots, the open plan + residual debt),
+    tombstones and superseded increments discarded."""
+    live, plan_epoch, debt = model_replay(frames)
+    ops: List[tuple] = [("root", r) for r in live]
+    if plan_epoch:
+        ops.append(("plan", plan_epoch, debt))
+    return tuple(ops)
+
+
+# -- actions -----------------------------------------------------------------
+
+def _next_op(state: tuple) -> tuple:
+    return SCRIPT[state[IDX]]
+
+
+ACTIONS = (
+    Action(
+        name="append",
+        guard=lambda s: s[PH] == "run" and s[IDX] < len(SCRIPT),
+        apply=lambda s: (
+            "run", s[IDX] + 1, s[FILE] + (("ok", _next_op(s)),), (),
+        ),
+    ),
+    Action(
+        name="append_badcrc",
+        guard=lambda s: s[PH] == "run" and s[IDX] < len(SCRIPT),
+        apply=lambda s: (
+            "run", s[IDX] + 1, s[FILE] + (("badcrc", _next_op(s)),), (),
+        ),
+    ),
+    Action(
+        name="crash",
+        guard=lambda s: s[PH] == "run",
+        apply=lambda s: ("crashed", s[IDX], s[FILE], ()),
+    ),
+    Action(
+        name="crash_torn",
+        guard=lambda s: s[PH] == "run" and s[IDX] < len(SCRIPT),
+        apply=lambda s: (
+            "crashed", s[IDX] + 1, s[FILE] + (("torn", _next_op(s)),), (),
+        ),
+    ),
+    # Atomic compaction at end of script: tmp file + fsync + os.replace.
+    # Crash outcomes are old-file OR new-file, never a mix.
+    Action(
+        name="compact",
+        guard=lambda s: s[PH] == "run" and s[IDX] == len(SCRIPT),
+        apply=lambda s: [
+            ("crashed", s[IDX], s[FILE], ()),  # died before replace
+            ("crashed", s[IDX],
+             tuple(("ok", op) for op in snapshot_ops(s[FILE])), ()),
+            ("compacted", s[IDX],
+             tuple(("ok", op) for op in snapshot_ops(s[FILE])), ()),
+        ],
+    ),
+    Action(
+        name="replay",
+        guard=lambda s: s[PH] == "crashed",
+        apply=lambda s: ("replayed", s[IDX], s[FILE], model_replay(s[FILE])),
+    ),
+)
+
+
+# -- invariants --------------------------------------------------------------
+
+def inv_replay_matches_prefix(state: tuple) -> bool:
+    if state[PH] != "replayed":
+        return True
+    return state[SUM] == interpret(durable_prefix(state[FILE]))
+
+
+def inv_no_root_resurrection(state: tuple) -> bool:
+    """A durable drop with no later durable re-add keeps the root dead —
+    stated straight from the frames, independent of the interpreter."""
+    if state[PH] != "replayed":
+        return True
+    prefix = durable_prefix(state[FILE])
+    live = set(state[SUM][0])
+    for i, op in enumerate(prefix):
+        if op[0] != "drop":
+            continue
+        readded = any(
+            later[0] == "root" and later[1] == op[1]
+            for later in prefix[i + 1:]
+        )
+        if not readded and op[1] in live:
+            return False
+    return True
+
+
+def inv_debt_analytic(state: tuple) -> bool:
+    """Resumed reshard debt == planned roots minus durable migrated marks
+    (empty once the fin landed) — the analytic delta a restart resumes."""
+    if state[PH] != "replayed":
+        return True
+    prefix = durable_prefix(state[FILE])
+    plan_epoch, planned = 0, ()
+    migrated = set()
+    finned = False
+    for op in prefix:
+        if op[0] == "plan":
+            plan_epoch, planned = op[1], op[2]
+            migrated = set()
+            finned = False
+        elif op[0] == "migrated" and op[1] == plan_epoch:
+            migrated.add(op[2])
+        elif op[0] == "fin" and op[1] == plan_epoch:
+            finned = True
+    expect = () if finned or not plan_epoch else tuple(
+        sorted(set(planned) - migrated)
+    )
+    return state[SUM][2] == expect
+
+
+def step_compact_preserves(prev: tuple, action: str, nxt: tuple) -> bool:
+    """Every compact outcome (old file, new file) replays to the same
+    summary the pre-compact file had — os.replace atomicity + snapshot
+    fidelity."""
+    if action != "compact":
+        return True
+    return model_replay(nxt[FILE]) == model_replay(prev[FILE])
+
+
+SPEC = Spec(
+    name="durable_log",
+    doc="crash at every frame boundary: replay == durable-prefix "
+        "semantics, drop never resurrects, reshard debt analytic, "
+        "compaction atomic (membership.DurableLog)",
+    initial_states=initial_states,
+    actions=ACTIONS,
+    invariants=(
+        ("replay-matches-durable-prefix", inv_replay_matches_prefix),
+        ("no-root-resurrection", inv_no_root_resurrection),
+        ("reshard-debt-analytic", inv_debt_analytic),
+    ),
+    step_invariants=(
+        ("compact-preserves-semantics", step_compact_preserves),
+    ),
+    is_done=lambda s: s[PH] in ("replayed", "compacted"),
+)
+
+
+MIRRORS = {
+    "kind": "py_class",
+    "file": "infinistore_tpu/membership.py",
+    "cls": "DurableLog",
+    "actions": {
+        "append": "append",
+        "append_badcrc": "append",
+        "crash": "append",       # a crash is the absence of the next append
+        "crash_torn": "append",  # ... with the in-flight frame truncated
+        "compact": "compact",
+        "replay": "replay",
+    },
+    "exempt": {
+        "close": "clean shutdown == crash with a flushed tail; subsumed "
+                 "by the crash action",
+        "size_bytes": "observability",
+        "status": "observability",
+    },
+}

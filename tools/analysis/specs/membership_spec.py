@@ -1,0 +1,278 @@
+"""ITS-M spec: the gossip membership merge lattice
+(infinistore_tpu/membership.py ``Membership``).
+
+Three peers gossip their knowledge of ONE contested member id ``x`` (the
+steady members are constant and carry no merge information). A peer's
+knowledge is the latest incarnation ``(state, since_epoch)`` it holds —
+exactly what ``Membership._latest_remote`` reduces a payload to — plus
+its epoch. Transitions mirror the real entry points (``add_member``,
+``remove_member``, ``mark_dead``, ``finalize_transitions``, re-add after
+a terminal tombstone) with small global budgets so epochs — and the
+state space — stay finite; ``exchange i<-j`` applies the
+``merge_apply`` lattice join (newest incarnation wins outright; within
+one incarnation the state-rank order decides, so terminal knowledge
+dominates stale liveness).
+
+Explored properties:
+
+- **join-commutes / join-idempotent** (invariants): the pairwise join the
+  merge applies is order-insensitive and self-absorbing in EVERY
+  reachable state — the algebra ``merge_apply``'s docstring promises.
+- **full-exchange-converges** (invariant): from every reachable state, a
+  bounded all-pairs exchange fixpoint leaves all three peers with
+  identical ``(view, epoch)`` — convergence without coordination.
+- **no-resurrection** (step invariant): no exchange moves a peer's entry
+  except per ``_beats`` — in particular a DEAD/REMOVED tombstone is never
+  replaced by a readable state of the SAME incarnation, and a re-add
+  (the legitimate resurrection) always carries a strictly newer
+  ``since_epoch``.
+- **epoch-monotone** (step invariant): no action ever lowers a peer's
+  epoch.
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import List, Optional, Tuple
+
+from . import Action, Spec
+
+# State ranks copied from Membership._STATE_RANK; the ITS-M001 lockstep
+# diff (modelcheck) pins the mirrored class surface, and the replay tests
+# (tests/test_modelcheck.py) drive the REAL class through these schedules.
+JOINING, ACTIVE, LEAVING, DEAD, REMOVED = "J", "A", "L", "D", "R"
+RANK = {JOINING: 1, ACTIVE: 2, LEAVING: 3, DEAD: 4, REMOVED: 5}
+TERMINAL = (DEAD, REMOVED)
+
+# Entry: (state, since_epoch) or None (peer has never heard of x).
+Entry = Optional[Tuple[str, int]]
+# Peer: (entry, epoch). Global state:
+#   ((peer0, peer1, peer2), (budget_add, budget_remove, budget_dead,
+#                            budget_readd, budget_finalize))
+N_PEERS = 3
+
+
+def beats(a: Entry, b: Entry) -> bool:
+    """Does b supersede a? (Membership._beats, None = unknown.)"""
+    if b is None:
+        return False
+    if a is None:
+        return True
+    if b[1] != a[1]:
+        return b[1] > a[1]
+    return RANK[b[0]] > RANK[a[0]]
+
+
+def join(a: Entry, b: Entry) -> Entry:
+    return b if beats(a, b) else a
+
+
+def initial_states() -> List[tuple]:
+    peers = tuple((None, 1) for _ in range(N_PEERS))
+    return [(peers, (1, 1, 1, 1, 2))]
+
+
+def _mutate(state: tuple, i: int, new_state: str, spend: int) -> tuple:
+    """Local transition at peer i: entry -> (new_state, epoch+1), epoch
+    bump — the _mutate/epoch discipline of the real class."""
+    peers, budgets = state
+    entry, epoch = peers[i]
+    new_peers = list(peers)
+    new_peers[i] = ((new_state, epoch + 1), epoch + 1)
+    new_budgets = list(budgets)
+    new_budgets[spend] -= 1
+    return (tuple(new_peers), tuple(new_budgets))
+
+
+def _entry(state: tuple, i: int) -> Entry:
+    return state[0][i][0]
+
+
+def _make_actions() -> List[Action]:
+    actions: List[Action] = []
+    for i in range(N_PEERS):
+        # add_member: rejected for a live entry; unknown id only.
+        actions.append(Action(
+            name=f"add@{i}",
+            guard=lambda s, i=i: s[1][0] > 0 and _entry(s, i) is None,
+            apply=lambda s, i=i: _mutate(s, i, JOINING, 0),
+        ))
+        # remove_member: JOINING/ACTIVE -> LEAVING (graceful drain; the
+        # last-placement-member refusal concerns the steady members, which
+        # always remain in placement here).
+        actions.append(Action(
+            name=f"remove@{i}",
+            guard=lambda s, i=i: (
+                s[1][1] > 0
+                and _entry(s, i) is not None
+                and _entry(s, i)[0] in (JOINING, ACTIVE)
+            ),
+            apply=lambda s, i=i: _mutate(s, i, LEAVING, 1),
+        ))
+        # mark_dead: any non-terminal -> DEAD.
+        actions.append(Action(
+            name=f"mark_dead@{i}",
+            guard=lambda s, i=i: (
+                s[1][2] > 0
+                and _entry(s, i) is not None
+                and _entry(s, i)[0] not in TERMINAL
+            ),
+            apply=lambda s, i=i: _mutate(s, i, DEAD, 2),
+        ))
+        # add_member on a tombstoned id: the legitimate re-add — a NEW
+        # incarnation whose since_epoch beats the tombstone.
+        actions.append(Action(
+            name=f"readd@{i}",
+            guard=lambda s, i=i: (
+                s[1][3] > 0
+                and _entry(s, i) is not None
+                and _entry(s, i)[0] in TERMINAL
+            ),
+            apply=lambda s, i=i: _mutate(s, i, JOINING, 3),
+        ))
+        # finalize_transitions: JOINING -> ACTIVE, LEAVING -> REMOVED.
+        actions.append(Action(
+            name=f"finalize@{i}",
+            guard=lambda s, i=i: (
+                s[1][4] > 0
+                and _entry(s, i) is not None
+                and _entry(s, i)[0] in (JOINING, LEAVING)
+            ),
+            apply=lambda s, i=i: _mutate(
+                s, i, ACTIVE if _entry(s, i)[0] == JOINING else REMOVED, 4,
+            ),
+        ))
+    for i, j in product(range(N_PEERS), repeat=2):
+        if i == j:
+            continue
+        # merge_apply at peer i of peer j's view: lattice join of the
+        # entry, epoch = max(local, remote).
+        def exchange(s: tuple, i=i, j=j) -> tuple:
+            peers, budgets = s
+            (ei, epi), (ej, epj) = peers[i], peers[j]
+            new_peers = list(peers)
+            new_peers[i] = (join(ei, ej), max(epi, epj))
+            return (tuple(new_peers), budgets)
+
+        actions.append(Action(
+            name=f"exchange@{i}<-{j}",
+            guard=lambda s: True,
+            apply=exchange,
+        ))
+    return actions
+
+
+# -- invariants --------------------------------------------------------------
+
+def inv_join_commutes(state: tuple) -> bool:
+    entries = [_entry(state, i) for i in range(N_PEERS)]
+    return all(
+        join(a, b) == join(b, a) for a in entries for b in entries
+    )
+
+
+def inv_join_idempotent(state: tuple) -> bool:
+    return all(
+        join(_entry(state, i), _entry(state, i)) == _entry(state, i)
+        for i in range(N_PEERS)
+    )
+
+
+def inv_converges(state: tuple) -> bool:
+    """A bounded all-pairs exchange fixpoint from here leaves every peer
+    identical — the convergence promise of commutative+idempotent joins."""
+    peers = list(state[0])
+    for _ in range(2 * N_PEERS):
+        changed = False
+        for i, j in product(range(N_PEERS), repeat=2):
+            if i == j:
+                continue
+            (ei, epi), (ej, epj) = peers[i], peers[j]
+            merged = (join(ei, ej), max(epi, epj))
+            if merged != peers[i]:
+                peers[i] = merged
+                changed = True
+        if not changed:
+            break
+    return len(set(peers)) == 1
+
+
+def step_no_resurrection(prev: tuple, action: str, nxt: tuple) -> bool:
+    """Entries only move forward per ``beats`` on exchange edges; a
+    terminal tombstone is replaced by a READABLE state only with a
+    strictly newer incarnation. Within one incarnation the only legal
+    move out of a tombstone is the terminal rank advance DEAD ->
+    REMOVED (concurrent mark_dead/finalize at the same epoch both
+    produce terminal knowledge; the rank order picks REMOVED on every
+    peer deterministically)."""
+    if not action.startswith("exchange"):
+        return True
+    for i in range(N_PEERS):
+        a, b = _entry(prev, i), _entry(nxt, i)
+        if a == b:
+            continue
+        if not beats(a, b):
+            return False
+        if (a is not None and a[0] in TERMINAL
+                and b is not None and b[0] not in TERMINAL
+                and b[1] <= a[1]):
+            return False  # resurrection within the dead incarnation
+    return True
+
+
+def step_epoch_monotone(prev: tuple, action: str, nxt: tuple) -> bool:
+    return all(
+        nxt[0][i][1] >= prev[0][i][1] for i in range(N_PEERS)
+    )
+
+
+SPEC = Spec(
+    name="membership_merge",
+    doc="gossip lattice join: commutes/idempotent/converges; tombstone "
+        "no-resurrection; epoch monotone (membership.Membership)",
+    initial_states=initial_states,
+    actions=tuple(_make_actions()),
+    invariants=(
+        ("join-commutes", inv_join_commutes),
+        ("join-idempotent", inv_join_idempotent),
+        ("full-exchange-converges", inv_converges),
+    ),
+    step_invariants=(
+        ("no-resurrection", step_no_resurrection),
+        ("epoch-monotone", step_epoch_monotone),
+    ),
+    # Exchanges are always enabled: quiescence never occurs, so any state
+    # is a legal stopping point.
+    is_done=lambda s: True,
+)
+
+
+# ITS-M001 lockstep: the model's action vocabulary against the REAL class
+# surface. ``actions`` maps each model action family to the method it
+# mirrors; ``exempt`` lists real public methods deliberately outside the
+# model, each with the audit reason.
+MIRRORS = {
+    "kind": "py_class",
+    "file": "infinistore_tpu/membership.py",
+    "cls": "Membership",
+    "actions": {
+        "add": "add_member",
+        "readd": "add_member",
+        "remove": "remove_member",
+        "mark_dead": "mark_dead",
+        "finalize": "finalize_transitions",
+        "exchange": "merge_apply",
+    },
+    "exempt": {
+        "view": "read-only snapshot accessor (no transition)",
+        "settled": "derived predicate over the view",
+        "prev_placement": "derived read-failover accessor",
+        "owns_transition": "derived originator flag",
+        "index_of": "entry-index lookup (no transition)",
+        "merge_plan": "dry run of merge_apply's delta (same join, no "
+                      "state change)",
+        "restore": "construction-time journal install — exercised by the "
+                   "durable_log spec's replay path",
+        "status": "observability snapshot",
+    },
+}

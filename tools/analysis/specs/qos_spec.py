@@ -1,0 +1,133 @@
+"""ITS-M spec: QoS aging / starvation bound
+(native/include/its/server.h two-level fg/bg slice scheduler;
+docs/qos.md).
+
+The server's continuation scheduler runs foreground slices whenever
+foreground work is pending and defers background (``bg_must_defer``)
+behind a cooldown — EXCEPT that a time-based aging escape
+(``bg_aging_us``) forces one background slice per aging window no
+matter how hard foreground floods. The model abstracts wall-clock into
+scheduler passes: each foreground pass under contention ages the
+deferred background work by one tick; once the age reaches the bound,
+``bg_must_defer`` turns false and the next pass MUST run background.
+
+Nondeterminism: background ops arrive over time (budgeted), so the
+explorer covers floods hitting an empty bg queue, arrivals mid-flood,
+and back-to-back aged slices. The foreground flood itself is permanent
+by construction — the adversary the bound is stated against.
+
+Explored properties:
+
+- **aging-bound** (invariant): deferral age never exceeds the bound —
+  i.e. a permanent foreground flood cannot starve background past
+  ``bg_aging_us`` (ages saturate one past the bound so a broken model
+  stays finite and the violation state is reachable);
+- **aged-slices-progress** (step invariant): an aged background slice
+  always consumes a background op and resets the age — the escape does
+  real work, it does not just clear the clock;
+- **bg-drains** (liveness, AG EF): from every reachable state some
+  schedule finishes all background ops — the escape suffices for
+  progress with no cooperation from foreground.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Action, Spec
+
+AGING_BOUND = 3   # abstract ticks of bg_aging_us
+BG_OPS = 2        # background ops queued at start
+BG_ARRIVALS = 1   # additional bg arrivals mid-flood (budget)
+
+# State: (bg_remaining, bg_wait, bg_arrival_budget, aged_count)
+BG, WAIT, ARR, AGED = range(4)
+
+
+def initial_states() -> List[tuple]:
+    return [(BG_OPS, 0, BG_ARRIVALS, 0)]
+
+
+def must_run_bg(s: tuple) -> bool:
+    """bg_must_defer() == false via the aging escape: deferred work aged
+    past the bound forces the next pass to run one background slice."""
+    return s[BG] > 0 and s[WAIT] >= AGING_BOUND
+
+
+ACTIONS = (
+    # One scheduler pass that picks FOREGROUND (the flood always has fg
+    # pending). Deferring pending background work ages it one tick;
+    # saturate one past the bound so a mutated model stays finite.
+    Action(
+        name="pass_fg",
+        guard=lambda s: not must_run_bg(s),
+        apply=lambda s: (
+            s[BG],
+            min(s[WAIT] + 1, AGING_BOUND + 1) if s[BG] > 0 else 0,
+            s[ARR], s[AGED],
+        ),
+    ),
+    # The aging escape: the pass runs ONE background slice, consumes a
+    # background op, resets the deferral clock.
+    Action(
+        name="pass_bg_aged",
+        guard=must_run_bg,
+        apply=lambda s: (s[BG] - 1, 0, s[ARR], s[AGED] + 1),
+    ),
+    # A new background op arrives mid-flood (budgeted nondeterminism).
+    Action(
+        name="bg_arrive",
+        guard=lambda s: s[ARR] > 0,
+        apply=lambda s: (s[BG] + 1, s[WAIT], s[ARR] - 1, s[AGED]),
+    ),
+)
+
+
+def inv_aging_bound(s: tuple) -> bool:
+    return s[WAIT] <= AGING_BOUND
+
+
+def step_aged_progress(prev: tuple, action: str, nxt: tuple) -> bool:
+    if action != "pass_bg_aged":
+        return True
+    return nxt[BG] == prev[BG] - 1 and nxt[WAIT] == 0
+
+
+SPEC = Spec(
+    name="qos_aging",
+    doc="permanent fg flood cannot starve bg past the aging bound; the "
+        "escape does real bg work and always drains (its/server.h)",
+    initial_states=initial_states,
+    actions=ACTIONS,
+    invariants=(
+        ("aging-bound", inv_aging_bound),
+    ),
+    step_invariants=(
+        ("aged-slices-progress", step_aged_progress),
+    ),
+    # pass_fg is enabled in every non-escape state, so quiescence never
+    # occurs under the flood.
+    is_done=lambda s: True,
+    liveness=(
+        ("bg-drains", lambda s: s[BG] == 0 and s[ARR] == 0),
+    ),
+)
+
+
+MIRRORS = {
+    "kind": "cpp_functions",
+    "file": "native/include/its/server.h",
+    # The QoS scheduling surface: the cont-pass family + the bg_* policy
+    # predicates (field initializers carry no '(' and do not match).
+    "pattern": r"\b(run_cont_pass|run_one_slice|note_op|bg_[a-z0-9_]+)"
+               r"\s*\(",
+    "actions": {
+        "pass_fg": "run_cont_pass",
+        "pass_bg_aged": "run_one_slice",
+        "bg_arrive": "note_op",
+    },
+    "exempt": {
+        "bg_must_defer": "mirrored as the must_run_bg guard predicate "
+                         "(the pass_fg/pass_bg_aged action split)",
+    },
+}

@@ -1,0 +1,310 @@
+"""ITS-M spec: the zero-copy descriptor ring's publish / park / doorbell
+discipline (native/include/its/ring.h; docs/zero_copy.md).
+
+Three actors over a 1-slot SQ and 1-slot CQ, two ops end to end:
+
+- **producer** (the client's submit path): write the slot record and its
+  generation stamp (``gen = seq + 1``, release), THEN publish the tail
+  (release), THEN fence + ``ring_flag_take`` — if the consumer's park
+  flag was set, exactly one doorbell wakes it. Backpressure: no free SQ
+  slot means the submit action is simply not enabled (the real producer
+  waits on head).
+- **server** (SQ consumer / CQ producer): consume only when the
+  acquire-loaded tail shows work; park via the Dekker pairing — set the
+  seq_cst ``sq_waiting`` flag, RE-CHECK the tail, only then sleep.
+  Completions mirror the producer discipline on the CQ.
+- **reaper** (the client's CQ consumer): same consume/park protocol
+  against the CQ flag.
+
+Model granularity: each release-store (record+gen, tail) is its own
+atomic action, so every interleaving of "record written but tail not
+yet published" with both consumers is explored; empty-observation, flag
+set and tail re-check are separate actions, so the classic lost-wakeup
+window (publish+doorbell BETWEEN flag-set and sleep, or before
+flag-set) is explored too. The doorbell itself is a SOCKET FRAME
+(kOpRingDoorbell / kStatusRingEvent; ring.h's doze/wake comment): a
+frame posted before the consumer blocks leaves the socket readable and
+epoll returns immediately, so the wake channel is sticky — modeled as
+the ``s_wake``/``r_wake`` tokens a park re-check drains. Dropping that
+stickiness (or the re-check) makes exploration find the stranded-parker
+schedule: a stale doorbell for an already-consumed publish takes the
+freshly-set flag, the "wake" hits a not-yet-sleeping consumer, and the
+consumer then sleeps with its flag down, undoorbellable.
+
+Explored properties:
+
+- **publish-order** (invariant): ``tail <= gen_written`` on both rings —
+  no slot is ever visible before its record+gen landed (no CQE consumed
+  before publish);
+- **consume-order** (invariant): ``head <= tail`` on both rings;
+- **parked-flag-consistent** (invariant): a parked actor still has its
+  flag set (the doorbell that clears it also wakes) and never sleeps
+  past a pending doorbell frame;
+- **deadlock** (built-in): no enabled action in a non-final state — a
+  dropped re-check or a lost doorbell strands a parked consumer behind
+  full-ring backpressure, and BFS finds the exact schedule;
+- **all-ops-complete** (liveness, AG EF): from every reachable state
+  some schedule reaps both ops — backpressure never wedges the pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from . import Action, Spec
+
+N_OPS = 2      # ops submitted end to end
+SQ_CAP = 1     # SQ slots (1 => submit backpressure is exercised)
+CQ_CAP = 1     # CQ slots
+
+# State tuple indices. Counters are cumulative sequence numbers (the
+# real ring's monotonically increasing seq space); pc_* are tiny
+# per-actor program counters. s_wake/r_wake model the doorbell SOCKET
+# FRAME in flight: the real doorbell is a kOpRingDoorbell /
+# kStatusRingEvent message, so a doorbell that lands before the consumer
+# blocks leaves the socket readable and the consumer's epoll_wait
+# returns immediately — the wake channel is STICKY, which is exactly
+# what makes the stale-doorbell race (flag taken between the consumer's
+# flag-set and its sleep) benign.
+(SQ_GEN, SQ_TAIL, SQ_HEAD, CQ_GEN, CQ_TAIL, CQ_HEAD,
+ SQ_FLAG, CQ_FLAG, S_PARKED, R_PARKED, S_WAKE, R_WAKE,
+ PC_P, PC_S, PC_R) = range(15)
+
+IDLE, WROTE, PUBLISHED = "idle", "wrote", "published"
+PARKING = "parking"
+
+
+def initial_states() -> List[tuple]:
+    return [(0, 0, 0, 0, 0, 0, 0, 0, False, False, False, False,
+             IDLE, IDLE, IDLE)]
+
+
+def _set(state: tuple, **kv) -> tuple:
+    names = {
+        "sq_gen": SQ_GEN, "sq_tail": SQ_TAIL, "sq_head": SQ_HEAD,
+        "cq_gen": CQ_GEN, "cq_tail": CQ_TAIL, "cq_head": CQ_HEAD,
+        "sq_flag": SQ_FLAG, "cq_flag": CQ_FLAG,
+        "s_parked": S_PARKED, "r_parked": R_PARKED,
+        "s_wake": S_WAKE, "r_wake": R_WAKE,
+        "pc_p": PC_P, "pc_s": PC_S, "pc_r": PC_R,
+    }
+    out = list(state)
+    for k, v in kv.items():
+        out[names[k]] = v
+    return tuple(out)
+
+
+ACTIONS = (
+    # -- producer: submit path ----------------------------------------------
+    Action(  # write record + gen stamp (release-store #1)
+        name="p_write_gen",
+        guard=lambda s: (
+            s[PC_P] == IDLE and s[SQ_GEN] < N_OPS
+            and s[SQ_GEN] - s[SQ_HEAD] < SQ_CAP   # a free SQ slot
+        ),
+        apply=lambda s: _set(s, sq_gen=s[SQ_GEN] + 1, pc_p=WROTE),
+    ),
+    Action(  # publish tail (release-store #2, after the gen stamp)
+        name="p_publish_tail",
+        guard=lambda s: s[PC_P] == WROTE,
+        apply=lambda s: _set(s, sq_tail=s[SQ_GEN], pc_p=PUBLISHED),
+    ),
+    Action(  # fence + ring_flag_take: exactly one doorbell if parked flag
+        #        set. The doorbell is a socket frame: it wakes a sleeping
+        #        consumer directly, and a consumer that has not yet slept
+        #        finds the frame waiting (sticky wake token).
+        name="p_doorbell",
+        guard=lambda s: s[PC_P] == PUBLISHED,
+        apply=lambda s: _set(
+            s, pc_p=IDLE,
+            **(
+                {"sq_flag": 0, "s_parked": False, "s_wake": False}
+                if s[SQ_FLAG] and s[S_PARKED]
+                else {"sq_flag": 0, "s_wake": True} if s[SQ_FLAG]
+                else {}
+            ),
+        ),
+    ),
+    # -- server: SQ consume, CQ produce, park -------------------------------
+    Action(  # acquire-load tail, gen matches -> consume one descriptor
+        name="s_consume_sqe",
+        guard=lambda s: (
+            s[PC_S] == IDLE and not s[S_PARKED]
+            and s[SQ_TAIL] > s[SQ_HEAD]
+        ),
+        apply=lambda s: _set(s, sq_head=s[SQ_HEAD] + 1, pc_s="have_op"),
+    ),
+    Action(  # write CQE record + gen (release-store #1 on the CQ)
+        name="s_write_cqe",
+        guard=lambda s: (
+            s[PC_S] == "have_op" and s[CQ_GEN] - s[CQ_HEAD] < CQ_CAP
+        ),
+        apply=lambda s: _set(s, cq_gen=s[CQ_GEN] + 1, pc_s="cq_wrote"),
+    ),
+    Action(  # publish CQ tail (release-store #2)
+        name="s_publish_cq_tail",
+        guard=lambda s: s[PC_S] == "cq_wrote",
+        apply=lambda s: _set(s, cq_tail=s[CQ_GEN], pc_s="cq_published"),
+    ),
+    Action(  # fence + flag_take on the reaper's park flag (sticky, as above)
+        name="s_doorbell",
+        guard=lambda s: s[PC_S] == "cq_published",
+        apply=lambda s: _set(
+            s, pc_s=IDLE,
+            **(
+                {"cq_flag": 0, "r_parked": False, "r_wake": False}
+                if s[CQ_FLAG] and s[R_PARKED]
+                else {"cq_flag": 0, "r_wake": True} if s[CQ_FLAG]
+                else {}
+            ),
+        ),
+    ),
+    Action(  # park step 0: the poll loop observes an empty SQ and decides
+        #        to park (the decision and the flag store are NOT atomic —
+        #        this window is where a publish+doorbell can slip in)
+        name="s_observe_empty",
+        guard=lambda s: (
+            s[PC_S] == IDLE and not s[S_PARKED] and s[SQ_FLAG] == 0
+            and s[SQ_TAIL] == s[SQ_HEAD] and s[SQ_HEAD] < N_OPS
+        ),
+        apply=lambda s: _set(s, pc_s="saw_empty"),
+    ),
+    Action(  # park step 1: seq_cst store of the waiting flag
+        name="s_park_set_flag",
+        guard=lambda s: s[PC_S] == "saw_empty",
+        apply=lambda s: _set(s, sq_flag=1, pc_s=PARKING),
+    ),
+    Action(  # park step 2: the Dekker RE-CHECK of the tail, then sleep.
+        #        A pending doorbell frame (stale flag_take between our
+        #        flag-set and here) makes the sleep return immediately:
+        #        modeled as bailing out and draining the wake token.
+        name="s_park_recheck",
+        guard=lambda s: s[PC_S] == PARKING,
+        apply=lambda s: (
+            _set(s, sq_flag=0, s_wake=False, pc_s=IDLE)  # insta-wake
+            if s[S_WAKE]
+            else _set(s, sq_flag=0, pc_s=IDLE)           # work arrived: bail
+            if s[SQ_TAIL] > s[SQ_HEAD]
+            else _set(s, s_parked=True, pc_s=IDLE)       # really sleep
+        ),
+    ),
+    # -- reaper: CQ consume, park -------------------------------------------
+    Action(
+        name="r_reap_cqe",
+        guard=lambda s: (
+            s[PC_R] == IDLE and not s[R_PARKED]
+            and s[CQ_TAIL] > s[CQ_HEAD]
+        ),
+        apply=lambda s: _set(s, cq_head=s[CQ_HEAD] + 1),
+    ),
+    Action(
+        name="r_observe_empty",
+        guard=lambda s: (
+            s[PC_R] == IDLE and not s[R_PARKED] and s[CQ_FLAG] == 0
+            and s[CQ_TAIL] == s[CQ_HEAD] and s[CQ_HEAD] < N_OPS
+        ),
+        apply=lambda s: _set(s, pc_r="saw_empty"),
+    ),
+    Action(
+        name="r_park_set_flag",
+        guard=lambda s: s[PC_R] == "saw_empty",
+        apply=lambda s: _set(s, cq_flag=1, pc_r=PARKING),
+    ),
+    Action(
+        name="r_park_recheck",
+        guard=lambda s: s[PC_R] == PARKING,
+        apply=lambda s: (
+            _set(s, cq_flag=0, r_wake=False, pc_r=IDLE)
+            if s[R_WAKE]
+            else _set(s, cq_flag=0, pc_r=IDLE)
+            if s[CQ_TAIL] > s[CQ_HEAD]
+            else _set(s, r_parked=True, pc_r=IDLE)
+        ),
+    ),
+)
+
+
+def inv_publish_order(s: tuple) -> bool:
+    return s[SQ_TAIL] <= s[SQ_GEN] and s[CQ_TAIL] <= s[CQ_GEN]
+
+
+def inv_consume_order(s: tuple) -> bool:
+    return s[SQ_HEAD] <= s[SQ_TAIL] and s[CQ_HEAD] <= s[CQ_TAIL]
+
+
+def inv_parked_flag(s: tuple) -> bool:
+    # A sleeping actor's flag stays set until the (atomic) flag_take that
+    # also wakes it — a parked actor with a cleared flag can never be
+    # doorbelled again. And no actor sleeps past a pending doorbell
+    # frame: the recheck's insta-wake consumes it before parking.
+    if s[S_PARKED] and (s[SQ_FLAG] == 0 or s[S_WAKE]):
+        return False
+    if s[R_PARKED] and (s[CQ_FLAG] == 0 or s[R_WAKE]):
+        return False
+    return True
+
+
+def is_done(s: tuple) -> bool:
+    # Clean quiescence: both ops reaped and every actor's pc back at idle
+    # (parked-while-no-more-work never happens here because the park
+    # guards stop at N_OPS; mid-protocol pcs with no enabled action are
+    # exactly the lost-wakeup states).
+    return s[CQ_HEAD] == N_OPS and (s[PC_P], s[PC_S], s[PC_R]) == (
+        IDLE, IDLE, IDLE,
+    )
+
+
+SPEC = Spec(
+    name="ring_sq_cq",
+    doc="publish/park/doorbell: no CQE before publish, Dekker re-check "
+        "has no lost wakeup, backpressure never deadlocks (its/ring.h)",
+    initial_states=initial_states,
+    actions=ACTIONS,
+    invariants=(
+        ("publish-order", inv_publish_order),
+        ("consume-order", inv_consume_order),
+        ("parked-flag-consistent", inv_parked_flag),
+    ),
+    is_done=is_done,
+    liveness=(
+        ("all-ops-reaped", lambda s: s[CQ_HEAD] == N_OPS),
+    ),
+)
+
+
+MIRRORS = {
+    "kind": "cpp_functions",
+    "file": "native/include/its/ring.h",
+    # One capture group: the function-name family the model must track.
+    "pattern": r"\b(ring_[a-z0-9_]+)\s*\(",
+    "actions": {
+        "p_write_gen": "ring_store_rel",
+        "p_publish_tail": "ring_store_rel",
+        "p_doorbell": "ring_flag_take",
+        "s_consume_sqe": "ring_load_acq",
+        "s_write_cqe": "ring_store_rel",
+        "s_publish_cq_tail": "ring_store_rel",
+        "s_doorbell": "ring_flag_take",
+        "s_observe_empty": "ring_load_acq",
+        "s_park_set_flag": "ring_flag_park",
+        "s_park_recheck": "ring_flag_clear",
+        "r_reap_cqe": "ring_load_acq",
+        "r_observe_empty": "ring_load_acq",
+        "r_park_set_flag": "ring_flag_park",
+        "r_park_recheck": "ring_flag_clear",
+    },
+    # Every ring_* name in the header must be covered or exempted.
+    "exempt": {
+        "ring_fence": "modeled implicitly: doorbell actions read the "
+                      "flag AFTER the tail store (the fence's ordering)",
+        "ring_align64": "layout geometry, no concurrency",
+        "ring_sq_off": "layout geometry, no concurrency",
+        "ring_cq_off": "layout geometry, no concurrency",
+        "ring_meta_off": "layout geometry, no concurrency",
+        "ring_segment_bytes": "layout geometry, no concurrency",
+        "ring_view_init": "attach-time geometry validation",
+        "ring_poll_budget": "adaptive poll pacing (performance, not "
+                            "safety; bench-gated)",
+        "ring_gap_note": "adaptive poll pacing (performance, not safety)",
+    },
+}
